@@ -1,0 +1,31 @@
+"""paddlebox_tpu — a TPU-native framework with the capabilities of PaddleBox.
+
+PaddleBox (Baidu's PaddlePaddle fork) trains ultra-large-scale CTR models on a
+GPU parameter server: slot-based samples, pass (day/hour) based training, an
+HBM/mem/SSD tiered sparse table, online AUC, and base+delta model publishing.
+
+This package re-expresses that vertical slice TPU-first on JAX/XLA/Pallas:
+
+- ``data``      slot sample parsing, columnar ragged batches, pass-scoped
+                datasets with preload overlap and global shuffle
+                (reference: paddle/fluid/framework/{data_feed,data_set}.*)
+- ``table``     the open sparse table: host tiered store + pass working set
+                (reference: closed libbox_ps.so behind fleet/box_wrapper.*)
+- ``ops``       pull/push sparse, fused seqpool+CVM, cvm, rank_attention,
+                batch_fc (reference: paddle/fluid/operators/*)
+- ``parallel``  device meshes, sharded-table all-to-all pull/push, dense
+                K-step sync (reference: NCCL/MPI collective stack)
+- ``metrics``   online AUC / metric registry (reference: BasicAucCalculator)
+- ``models``    CTR model zoo: LR, Wide&Deep, DeepFM, DCN, MMoE
+- ``train``     BoxWrapper/BoxHelper-parity pass lifecycle + trainers
+- ``utils``     fs/hdfs IO, timers, monitor stats
+
+Design note (TPU-first, not a port): keys are remapped host-side to dense
+pass-local row indices while batches are packed, so every device-side sparse
+op is a static-shape gather/scatter over a mesh-sharded HBM array — no
+device hash tables, no dynamic shapes, XLA-friendly end to end.
+"""
+
+__version__ = "0.1.0"
+
+from paddlebox_tpu import config  # noqa: F401

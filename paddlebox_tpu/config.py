@@ -1,0 +1,88 @@
+"""Global flag registry with environment passthrough.
+
+Parity with the reference's gflags knobs (paddle/fluid/platform/flags.cc:477-607
+defines the padbox_* family; global_value_getter_setter.cc exposes runtime
+get/set). Flags are declared once with a type and default; the environment
+variable ``PBOX_<UPPER_NAME>`` overrides the default at first read.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_lock = threading.Lock()
+_defs: Dict[str, tuple] = {}  # name -> (type_fn, default, help)
+_values: Dict[str, Any] = {}
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    type_fn: Callable
+    if isinstance(default, bool):
+        type_fn = _parse_bool
+    elif isinstance(default, int):
+        type_fn = int
+    elif isinstance(default, float):
+        type_fn = float
+    else:
+        type_fn = str
+    with _lock:
+        _defs[name] = (type_fn, default, help)
+
+
+def get_flag(name: str) -> Any:
+    with _lock:
+        if name in _values:
+            return _values[name]
+        if name not in _defs:
+            raise KeyError(f"undefined flag: {name}")
+        type_fn, default, _ = _defs[name]
+        env = os.environ.get("PBOX_" + name.upper())
+        val = type_fn(env) if env is not None else default
+        _values[name] = val
+        return val
+
+
+def set_flag(name: str, value: Any) -> None:
+    with _lock:
+        if name not in _defs:
+            raise KeyError(f"undefined flag: {name}")
+        type_fn, _, _ = _defs[name]
+        _values[name] = type_fn(value)
+
+
+def all_flags() -> Dict[str, Any]:
+    with _lock:
+        names = list(_defs)
+    return {n: get_flag(n) for n in names}
+
+
+# --- data pipeline (reference: flags.cc padbox_* family) ---
+define_flag("dataset_shuffle_thread_num", 10, "threads for global shuffle")
+define_flag("dataset_merge_thread_num", 10, "threads for merge/working-set build")
+define_flag("record_pool_max_size", 50_000_000, "SlotRecord pool cap (reference: padbox_record_pool_max_size)")
+define_flag("slot_pool_thread_num", 1, "recycle threads for record pool")
+define_flag("data_read_buffer_mb", 16, "file read buffer size")
+define_flag("enable_ins_parser_file", False, "allow per-file parser plugin")
+define_flag("sample_rate", 1.0, "line sampling rate on read (BufferedLineFileReader parity)")
+
+# --- sparse table ---
+define_flag("sparse_table_shard_bits", 6, "log2 host shards in the tiered store")
+define_flag("enable_pullpush_dedup_keys", True, "dedup keys across slots before pull (reference flags.cc:603)")
+define_flag("embedx_threshold", 10.0, "show threshold before embedx becomes active (pslib semantics)")
+define_flag("pull_embedx_scale", 1.0, "scale applied to pulled embedx (reference: BoxWrapper scale)")
+
+# --- batch / device ---
+define_flag("batch_pad_quantile", 1.0, "key-bucket padding quantile for static shapes")
+define_flag("batch_bucket_rounding", 2048, "flat key-count buckets rounded to multiples of this")
+define_flag("enable_dense_nccl_barrier", False, "barrier before dense sync (reference flags.cc:597)")
+
+# --- metrics ---
+define_flag("auc_num_buckets", 1_000_000, "AUC wuauc bucket table size (reference box_wrapper.h:61)")

@@ -1,0 +1,13 @@
+from paddlebox_tpu.data.slot_schema import SlotSchema, SlotInfo
+from paddlebox_tpu.data.slot_record import SlotRecord, SlotBatch, build_batch
+from paddlebox_tpu.data.parser import parse_line, parse_logkey
+
+__all__ = [
+    "SlotSchema",
+    "SlotInfo",
+    "SlotRecord",
+    "SlotBatch",
+    "build_batch",
+    "parse_line",
+    "parse_logkey",
+]

@@ -1,0 +1,121 @@
+"""Slot-format text line parser.
+
+Line format (parity with SlotPaddleBoxDataFeed::ParseOneInstance,
+data_feed.cc:2951-3061):
+
+    [1 <ins_id>] [1 <logkey>] {<num> <v0> <v1> ...} per slot in schema order
+
+- every slot present with its count first; count must be nonzero (pad in the
+  data generator)
+- uint64 slots drop 0-valued feasigns unless the slot is dense
+- float slots drop |v| < 1e-6 unless dense
+- logkey is a hex string: cmatch = [11:14), rank = [14:16), search_id = [16:32)
+  (parser_log_key, data_feed.cc:2940-2948)
+
+A record with zero remaining uint64 feasigns is rejected (returns None), same
+as the reference's ``return (uint64_total_slot_num > 0)``.
+
+Custom parsers: the reference loads user ``.so`` plugins via dlopen
+(SlotInsParserMgr data_feed.cc:2594-2655). Here a plugin is any callable
+``(line: str, schema) -> SlotRecord | None`` registered with
+``register_parser``; the C++ fast path lives in utils/_native (same contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.data.slot_schema import SlotSchema
+
+_parsers: Dict[str, Callable] = {}
+
+
+def register_parser(name: str, fn: Callable) -> None:
+    _parsers[name] = fn
+
+
+def get_parser(name: str) -> Callable:
+    return _parsers[name]
+
+
+def parse_logkey(log_key: str):
+    """-> (search_id, cmatch, rank). Hex sub-fields per the reference layout."""
+    search_id = int(log_key[16:32], 16)
+    cmatch = int(log_key[11:14], 16)
+    rank = int(log_key[14:16], 16)
+    return search_id, cmatch, rank
+
+
+def parse_line(line: str, schema: SlotSchema) -> Optional[SlotRecord]:
+    try:
+        return _parse_line(line, schema)
+    except IndexError:
+        raise ValueError(f"truncated slot line (ran out of tokens): {line[:120]!r}")
+
+
+def _parse_line(line: str, schema: SlotSchema) -> Optional[SlotRecord]:
+    toks = line.split()
+    pos = 0
+    ins_id = ""
+    search_id = cmatch = rank = 0
+    if schema.parse_ins_id:
+        if toks[pos] != "1":
+            raise ValueError(f"expected ins_id count 1, got {toks[pos]}")
+        ins_id = toks[pos + 1]
+        pos += 2
+    if schema.parse_logkey:
+        if toks[pos] != "1":
+            raise ValueError(f"expected logkey count 1, got {toks[pos]}")
+        log_key = toks[pos + 1]
+        search_id, cmatch, rank = parse_logkey(log_key)
+        ins_id = log_key
+        pos += 2
+
+    u_vals: list = []
+    u_offsets = np.zeros(schema.num_sparse + 1, dtype=np.uint32)
+    f_vals: list = []
+    f_offsets = np.zeros(schema.num_float + 1, dtype=np.uint32)
+    u_slot = f_slot = 0
+    for info in schema.slots:
+        num = int(toks[pos])
+        if num == 0:
+            raise ValueError(
+                "slot value count can not be zero; pad it in the data generator "
+                f"(slot {info.name}, line {line[:80]!r})"
+            )
+        vals = toks[pos + 1 : pos + 1 + num]
+        pos += 1 + num
+        if not info.used:
+            continue
+        if info.type == "float":
+            for t in vals:
+                v = float(t)
+                if abs(v) < 1e-6 and not info.dense:
+                    continue
+                f_vals.append(v)
+            f_slot += 1
+            f_offsets[f_slot] = len(f_vals)
+        else:
+            for t in vals:
+                k = int(t)
+                if k == 0 and not info.dense:
+                    continue
+                u_vals.append(k)
+            u_slot += 1
+            u_offsets[u_slot] = len(u_vals)
+
+    if not u_vals:
+        return None
+    return SlotRecord(
+        u64_values=np.array(u_vals, dtype=np.uint64),
+        u64_offsets=u_offsets,
+        f_values=np.array(f_vals, dtype=np.float32),
+        f_offsets=f_offsets,
+        ins_id=ins_id,
+        search_id=search_id,
+        cmatch=cmatch,
+        rank=rank,
+    )

@@ -1,0 +1,3 @@
+from paddlebox_tpu.metrics.auc import AucState, auc_init, auc_update, auc_compute
+
+__all__ = ["AucState", "auc_init", "auc_update", "auc_compute"]

@@ -1,0 +1,104 @@
+"""Online AUC — bucketed calculator, device-resident.
+
+Parity with BasicAucCalculator (box_wrapper.h:61-138): predictions hash into
+``n_buckets`` pos/neg count tables (reference uses 1e6 doubles, CPU or GPU
+collection via cuda_add_data box_wrapper.cu:1581); AUC plus bucket_error,
+MAE, RMSE, actual/predicted CTR derive from the tables.
+
+TPU-native shape: the state is two int32 bucket tables updated by scatter-add
+*inside* the jitted train step (no host sync per step, exact counts to 2^31
+per bucket); multi-device reduction is one psum at read time
+(collect_data_nccl parity, box_wrapper.h:129). Every derived statistic —
+including MAE/RMSE/predicted CTR — integrates over the bucket tables in f64
+on the host at pass end, so nothing accumulates in f32 (the reference keeps
+doubles for the same reason; with 1e6 buckets the center-of-bucket
+approximation error is <1e-6, far below metric noise).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class AucState(NamedTuple):
+    pos: jnp.ndarray  # int32 [n_buckets] click counts per prediction bucket
+    neg: jnp.ndarray  # int32 [n_buckets] non-click counts
+
+
+def auc_init(n_buckets: int = 1_000_000) -> AucState:
+    return AucState(
+        pos=jnp.zeros((n_buckets,), jnp.int32),
+        neg=jnp.zeros((n_buckets,), jnp.int32),
+    )
+
+
+def auc_update(
+    state: AucState,
+    preds: jnp.ndarray,  # f32 [B] in [0, 1]
+    labels: jnp.ndarray,  # f32 [B] 0/1
+    mask: jnp.ndarray | None = None,  # [B] 1 = count this sample
+) -> AucState:
+    """Jit-safe accumulate (add_data/cuda_add_data parity)."""
+    n_buckets = state.pos.shape[0]
+    if mask is None:
+        imask = jnp.ones(preds.shape, jnp.int32)
+    else:
+        imask = mask.astype(jnp.int32)
+    bucket = jnp.clip((preds * n_buckets).astype(jnp.int32), 0, n_buckets - 1)
+    ilab = (labels > 0.5).astype(jnp.int32)
+    return AucState(
+        pos=state.pos.at[bucket].add(ilab * imask),
+        neg=state.neg.at[bucket].add((1 - ilab) * imask),
+    )
+
+
+def auc_psum(state: AucState, axis_name: str) -> AucState:
+    """Cross-device reduction (collect_data_nccl + MPI parity)."""
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), state)
+
+
+def auc_compute(state: AucState) -> Dict[str, float]:
+    """Host-side f64 integration (BasicAucCalculator::compute parity)."""
+    pos = np.asarray(state.pos, dtype=np.float64)
+    neg = np.asarray(state.neg, dtype=np.float64)
+    n_buckets = len(pos)
+    center = (np.arange(n_buckets, dtype=np.float64) + 0.5) / n_buckets
+
+    # AUC = P(score_pos > score_neg): for each negative bucket, count
+    # positives in strictly higher buckets + half of same-bucket ties
+    tot_pos = np.cumsum(pos)
+    p, n = tot_pos[-1], np.sum(neg)
+    pos_above = p - tot_pos
+    area = np.sum(neg * (pos_above + pos / 2.0))
+    auc = float(area / (p * n)) if p > 0 and n > 0 else 0.5
+
+    # bucket error: impression-weighted |predicted - actual| ctr over
+    # buckets with enough traffic
+    show = pos + neg
+    keep = show > 8
+    if keep.any():
+        rel = np.abs(center[keep] - pos[keep] / show[keep])
+        bucket_error = float(np.sum(rel * show[keep]) / np.sum(show[keep]))
+    else:
+        bucket_error = 0.0
+
+    count = float(p + n)
+    safe = max(count, 1.0)
+    pred_sum = float(np.sum(center * show))
+    # label 1 -> |pred-label| = 1-pred ; label 0 -> pred
+    abserr = float(np.sum(pos * (1.0 - center) + neg * center))
+    sqrerr = float(np.sum(pos * (1.0 - center) ** 2 + neg * center**2))
+    return {
+        "auc": auc,
+        "bucket_error": bucket_error,
+        "mae": abserr / safe,
+        "rmse": float(np.sqrt(sqrerr / safe)),
+        "actual_ctr": float(p) / safe,
+        "predicted_ctr": pred_sum / safe,
+        "copc": float(p) / max(pred_sum, 1e-12),
+        "ins_num": count,
+    }

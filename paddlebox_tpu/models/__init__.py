@@ -1,0 +1,12 @@
+from paddlebox_tpu.models.layers import mlp_init, mlp_apply, linear_init, linear_apply
+from paddlebox_tpu.models.lr import LogisticRegression
+from paddlebox_tpu.models.deepfm import DeepFM
+
+__all__ = [
+    "mlp_init",
+    "mlp_apply",
+    "linear_init",
+    "linear_apply",
+    "LogisticRegression",
+    "DeepFM",
+]

@@ -1,0 +1,31 @@
+"""CTR model interface.
+
+A model consumes the per-slot pooled+CVM'd features (output of
+fused_seqpool_cvm: ``[batch, num_slots, feat_width]`` where
+``feat_width = cvm_offset + embedx_dim`` in the join phase) plus an optional
+dense float block, and produces one logit per instance (or per task).
+
+This replaces the reference's static-graph model building
+(fluid.layers._pull_box_sparse + fused_seqpool_cvm + fc stacks,
+python/paddle/fluid/layers/nn.py:680, contrib/layers/nn.py:1337-2350) with
+plain init/apply pairs over pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+import jax.numpy as jnp
+
+
+class CTRModel(Protocol):
+    num_slots: int
+    feat_width: int
+    dense_dim: int
+
+    def init(self, rng) -> Any:  # params pytree
+        ...
+
+    def apply(self, params: Any, slot_feats: jnp.ndarray, dense: jnp.ndarray | None) -> jnp.ndarray:
+        """-> logits [batch] (or [batch, n_tasks] for multi-task models)."""
+        ...

@@ -1,0 +1,70 @@
+"""DeepFM — the flagship benchmark model (BASELINE.md config 3).
+
+Consumes pooled slot records [B, S, F] with F = cvm_offset + embedx_dim:
+- first order: the embed_w column summed over slots (the pulled LR weight)
+- FM second order over the embedx block: 0.5 * ((Σ_s v)² − Σ_s v²)
+- deep tower: MLP over [flattened slot feats ; dense floats]
+
+All three are batched matmul/reduction shapes that map straight onto the
+MXU; no per-slot small ops.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import linear_apply, linear_init, mlp_apply, mlp_init
+
+
+class DeepFM:
+    def __init__(
+        self,
+        num_slots: int,
+        feat_width: int,
+        embedx_dim: int,
+        dense_dim: int = 0,
+        hidden: Sequence[int] = (512, 256, 128),
+        embed_w_col: int = 2,
+    ):
+        self.num_slots = num_slots
+        self.feat_width = feat_width
+        self.embedx_dim = embedx_dim
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+        self.embed_w_col = embed_w_col
+
+    def init(self, rng):
+        k_mlp, k_out, k_dense = jax.random.split(rng, 3)
+        in_dim = self.num_slots * self.feat_width + self.dense_dim
+        mlp = mlp_init(k_mlp, in_dim, self.hidden)
+        params = {
+            "mlp": mlp,
+            "out": linear_init(k_out, self.hidden[-1], 1),
+            "b": jnp.zeros(()),
+        }
+        if self.dense_dim:
+            params["dense_lin"] = linear_init(k_dense, self.dense_dim, 1)
+        return params
+
+    def apply(self, params, slot_feats, dense=None):
+        B = slot_feats.shape[0]
+        co = self.feat_width - self.embedx_dim
+        first = jnp.sum(slot_feats[:, :, self.embed_w_col], axis=1)  # [B]
+
+        v = slot_feats[:, :, co:]  # [B, S, D] embedx block
+        sum_v = jnp.sum(v, axis=1)
+        fm = 0.5 * jnp.sum(sum_v * sum_v - jnp.sum(v * v, axis=1), axis=1)  # [B]
+
+        deep_in = slot_feats.reshape(B, -1)
+        if self.dense_dim and dense is not None:
+            deep_in = jnp.concatenate([deep_in, dense], axis=1)
+        h = mlp_apply(params["mlp"], deep_in, final_activation=True)
+        deep = linear_apply(params["out"], h)[:, 0]
+
+        logit = params["b"] + first + fm + deep
+        if self.dense_dim and dense is not None:
+            logit = logit + linear_apply(params["dense_lin"], dense)[:, 0]
+        return logit

@@ -1,0 +1,54 @@
+"""Minimal functional NN layers for the CTR dense towers.
+
+Plain pytree params + pure apply functions — everything stays jit/grad/shard
+friendly with zero framework ceremony. Matmuls are kept batched and wide so
+XLA tiles them onto the MXU; bf16 activation compute with fp32 params is the
+default precision recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def linear_init(rng, in_dim: int, out_dim: int, scale: str = "xavier") -> Dict[str, Any]:
+    wkey, _ = jax.random.split(rng)
+    if scale == "xavier":
+        s = jnp.sqrt(2.0 / (in_dim + out_dim))
+    else:
+        s = 0.01
+    return {
+        "w": jax.random.normal(wkey, (in_dim, out_dim), jnp.float32) * s,
+        "b": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def linear_apply(p: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    return x @ p["w"] + p["b"]
+
+
+def mlp_init(rng, in_dim: int, hidden: Sequence[int]) -> List[Dict[str, Any]]:
+    layers = []
+    dims = [in_dim, *hidden]
+    for i in range(len(hidden)):
+        rng, sub = jax.random.split(rng)
+        layers.append(linear_init(sub, dims[i], dims[i + 1]))
+    return layers
+
+
+def mlp_apply(
+    layers: List[Dict[str, Any]],
+    x: jnp.ndarray,
+    final_activation: bool = False,
+    compute_dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """ReLU MLP; activations in bf16 (MXU-native), params fp32."""
+    h = x.astype(compute_dtype)
+    for i, p in enumerate(layers):
+        h = h @ p["w"].astype(compute_dtype) + p["b"].astype(compute_dtype)
+        if i < len(layers) - 1 or final_activation:
+            h = jax.nn.relu(h)
+    return h.astype(jnp.float32)

@@ -1,0 +1,34 @@
+"""Logistic regression over slot features — the smallest CTR config.
+
+(BASELINE.md config 1: LR on Criteo-Kaggle.) The sparse first-order weight is
+the table's embed_w column (index cvm_offset-1 of the pulled record), summed
+per instance by the seqpool; the model just adds a dense linear + bias.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import linear_apply, linear_init
+
+
+class LogisticRegression:
+    def __init__(self, num_slots: int, feat_width: int, dense_dim: int = 0, embed_w_col: int = 2):
+        self.num_slots = num_slots
+        self.feat_width = feat_width
+        self.dense_dim = dense_dim
+        self.embed_w_col = embed_w_col
+
+    def init(self, rng):
+        params = {"b": jnp.zeros(())}
+        if self.dense_dim:
+            params["dense"] = linear_init(rng, self.dense_dim, 1)
+        return params
+
+    def apply(self, params, slot_feats, dense=None):
+        first_order = jnp.sum(slot_feats[:, :, self.embed_w_col], axis=1)
+        logit = first_order + params["b"]
+        if self.dense_dim and dense is not None:
+            logit = logit + linear_apply(params["dense"], dense)[:, 0]
+        return logit

@@ -1,0 +1,9 @@
+from paddlebox_tpu.ops.pull_push import pull_sparse_rows, push_sparse_rows
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm, cvm_transform
+
+__all__ = [
+    "pull_sparse_rows",
+    "push_sparse_rows",
+    "fused_seqpool_cvm",
+    "cvm_transform",
+]

@@ -1,0 +1,102 @@
+"""Device-side sparse pull/push over the pass working-set table.
+
+TPU-native replacement for the reference's pull/push hot path
+(PullSparseCase/PushSparseGradCase, box_wrapper_impl.h:25-253, kernels in
+box_wrapper.cu): keys were already remapped host-side to dense row ids, so
+
+- pull  = gather rows + embedx activity gating + scale     (static shapes)
+- push  = vectorized sparse-AdaGrad column math + one scatter back
+
+Both run *inside* the jitted train step; the optimizer lives on device, not
+in a parameter server. The table row layout is ``ValueLayout``:
+``[show, clk, extras..., embed_w, embedx[D], embed_g2, embedx_g2]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
+from paddlebox_tpu.table.value_layout import ValueLayout
+
+
+def pull_sparse_rows(
+    table: jnp.ndarray,  # [rows, width]
+    rows: jnp.ndarray,  # int32 [U] (deduped, padded with the padding row)
+    layout: ValueLayout,
+    embedx_threshold: float,
+    scale: float = 1.0,
+) -> jnp.ndarray:
+    """Gather pull records [U, pull_width] = [show, clk, .., embed_w, embedx].
+
+    embedx columns are zeroed for keys whose show count has not reached the
+    activation threshold — the open analog of the closed lib's
+    ``embedding_size > 0`` signal consumed by PullCopy (box_wrapper.cu:54-63).
+    """
+    picked = jnp.take(table, rows, axis=0)  # [U, width]
+    cvm_block = picked[:, : layout.cvm_offset]
+    embedx = picked[:, layout.embedx_col : layout.embedx_col + layout.embedx_dim]
+    active = (picked[:, layout.SHOW] >= embedx_threshold)[:, None]
+    embedx = jnp.where(active, embedx * scale, 0.0)
+    return jnp.concatenate([cvm_block, embedx], axis=1)
+
+
+def push_sparse_rows(
+    table: jnp.ndarray,  # [rows, width]
+    rows: jnp.ndarray,  # int32 [U] deduped rows (padding row allowed)
+    grads: jnp.ndarray,  # [U, pull_width] d(loss)/d(pull record)
+    show_counts: jnp.ndarray,  # f32 [U] occurrences of the key in this batch
+    clk_counts: jnp.ndarray,  # f32 [U] summed clicks over those occurrences
+    layout: ValueLayout,
+    opt: SparseOptimizerConfig,
+    lr_scale: jnp.ndarray | float = 1.0,  # scalar or [U] slot-lr multiplier
+) -> jnp.ndarray:
+    """Apply sparse AdaGrad + counter updates; returns the new table.
+
+    Mirrors the closed PushSparseGPU contract (push record = show, clk,
+    grads; box_wrapper.cu PushCopy fills show/clk from the batch) with the
+    optimizer semantics documented in table/optimizers.py.
+    """
+    old = jnp.take(table, rows, axis=0)  # [U, width]
+    co, D = layout.cvm_offset, layout.embedx_dim
+
+    show = old[:, layout.SHOW] + show_counts
+    clk = old[:, layout.CLK] + clk_counts
+
+    # --- embed_w (+ any conv/pcoc extras: cols 2..cvm_offset) scalar adagrad.
+    # grads[:, :2] correspond to the show/clk passthrough columns of the pull
+    # record; they receive CVM-transform gradients in principle, but counters
+    # are PS statistics, not weights — the reference likewise ignores them.
+    w_grad = grads[:, 2:co]  # [U, co-2] (embed_w last)
+    g2_e = old[:, layout.embed_g2_col] + jnp.sum(w_grad * w_grad, axis=1)
+    scale_e = jnp.sqrt(opt.initial_g2sum / (opt.initial_g2sum + g2_e))
+    step_e = (opt.embed_lr * lr_scale * scale_e)[:, None] * w_grad
+    new_w = old[:, 2:co] - step_e
+    new_w = jnp.clip(new_w, -opt.weight_bounds, opt.weight_bounds)
+
+    # --- embedx vector adagrad with one shared g2 scalar (mean energy)
+    x_grad = grads[:, co : co + D]
+    active = (old[:, layout.SHOW] >= opt.embedx_threshold)[:, None]
+    x_grad = jnp.where(active, x_grad, 0.0)
+    g2_x = old[:, layout.embedx_g2_col] + jnp.mean(x_grad * x_grad, axis=1)
+    scale_x = jnp.sqrt(opt.initial_g2sum / (opt.initial_g2sum + g2_x))
+    new_x = old[:, co : co + D] - (opt.embedx_lr * lr_scale * scale_x)[:, None] * x_grad
+    new_x = jnp.clip(new_x, -opt.weight_bounds, opt.weight_bounds)
+
+    new_rows = jnp.concatenate(
+        [
+            show[:, None],
+            clk[:, None],
+            new_w,
+            new_x,
+            g2_e[:, None],
+            g2_x[:, None],
+        ],
+        axis=1,
+    )
+    # Scatter the *delta* with add-semantics: with host dedup rows are unique
+    # and this equals a set; without dedup (enable_pullpush_dedup_keys=0) a
+    # key occurring in several slots contributes each occurrence's update
+    # deterministically (sequential-push semantics) instead of last-write-wins.
+    return table.at[rows].add(new_rows - old)

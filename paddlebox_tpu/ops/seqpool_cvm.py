@@ -1,0 +1,91 @@
+"""Fused sequence-pool + CVM over ragged slot batches.
+
+Parity with the reference's fused_seqpool_cvm op family
+(operators/fused/fused_seqpool_cvm_op.cu): per (slot, instance) sum-pool of
+the pulled key records, then the CVM transform on the leading show/click
+columns:
+
+    out[0] = log(show_sum + 1)
+    out[1] = log(clk_sum + 1) - log(show_sum + 1)        (join phase, use_cvm)
+    out[2:] passthrough
+  or, update phase (use_cvm=False): strip the first two columns
+  (FusedCVMKernelNoCVM, fused_seqpool_cvm_op.cu:166-182).
+
+Options mirrored: pad_value, need_filter (drop keys failing
+(show-clk)*show_coeff + clk*clk_coeff >= threshold, :90-118), clk_filter
+(join with show only, :145-164), quant_ratio (round(v*q)/q, :60-88),
+embed_threshold_filter variant (`_with_diff_thres`).
+
+The ragged pooling is a segment-sum over host-precomputed segment ids
+(slot * batch + ins), which XLA lowers to a single scatter-add — the
+device-side bookkeeping the reference does in CUDA lives in the host packer
+here. Autodiff provides the backward (the reference hand-writes it).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def cvm_transform(pooled: jnp.ndarray, use_cvm: bool = True) -> jnp.ndarray:
+    """CVM on pooled records [..., width]: show/clk -> log CTR features.
+
+    Parity: cvm_op (operators/cvm_op.h:26-38) and FusedCVMKernelWithCVM.
+    """
+    show = pooled[..., 0:1]
+    clk = pooled[..., 1:2]
+    log_show = jnp.log(show + 1.0)
+    log_clk = jnp.log(clk + 1.0)
+    if use_cvm:
+        return jnp.concatenate([log_show, log_clk - log_show, pooled[..., 2:]], axis=-1)
+    return pooled[..., 2:]
+
+
+def fused_seqpool_cvm(
+    records: jnp.ndarray,  # [L, width] pulled per-key records (flat, padded)
+    segments: jnp.ndarray,  # int32 [L] = slot * batch + ins; pads -> num_segments
+    num_slots: int,
+    batch_size: int,
+    use_cvm: bool = True,
+    pad_value: float = 0.0,
+    need_filter: bool = False,
+    show_coeff: float = 0.2,
+    clk_coeff: float = 1.0,
+    threshold: float = 0.96,
+    quant_ratio: Optional[int] = None,
+    clk_filter: bool = False,
+) -> jnp.ndarray:
+    """-> [batch, num_slots, out_width] pooled + CVM'd slot features.
+
+    ``segments`` may contain the value ``num_slots * batch_size`` for padded
+    entries; those rows fall into a trash segment that is dropped.
+    """
+    vals = records
+    if need_filter:
+        # key-level filter on raw show/clk (SeqPoolKernelEmbedQuantFilter)
+        keep = (vals[:, 0] - vals[:, 1]) * show_coeff + vals[:, 1] * clk_coeff >= threshold
+        vals = jnp.where(keep[:, None], vals, 0.0)
+    if quant_ratio:
+        q = float(quant_ratio)
+        head = vals[:, :2]
+        tail = jnp.round(vals[:, 2:] * q) / q
+        vals = jnp.concatenate([head, tail], axis=1)
+
+    num_segments = num_slots * batch_size
+    pooled = jax.ops.segment_sum(vals, segments, num_segments=num_segments + 1)
+    pooled = pooled[:num_segments].reshape(num_slots, batch_size, -1)
+    if pad_value != 0.0:
+        # slots with zero keys for an instance pool to pad_value, not 0
+        ones = jax.ops.segment_sum(
+            jnp.ones((records.shape[0],), records.dtype), segments, num_segments=num_segments + 1
+        )[:num_segments].reshape(num_slots, batch_size)
+        pooled = jnp.where((ones == 0)[..., None], pad_value, pooled)
+
+    out = cvm_transform(pooled, use_cvm=use_cvm)
+    if use_cvm and clk_filter:
+        # join with show only: drop the click column (col 1)
+        out = jnp.concatenate([out[..., 0:1], out[..., 2:]], axis=-1)
+    return jnp.transpose(out, (1, 0, 2))  # -> [batch, slots, width]

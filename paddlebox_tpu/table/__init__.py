@@ -1,0 +1,11 @@
+from paddlebox_tpu.table.value_layout import ValueLayout, FeatureType
+from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
+from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
+
+__all__ = [
+    "ValueLayout",
+    "FeatureType",
+    "HostSparseTable",
+    "PassWorkingSet",
+    "SparseOptimizerConfig",
+]

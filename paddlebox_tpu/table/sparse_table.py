@@ -1,0 +1,375 @@
+"""Open sparse table: host tiered store + pass-scoped device working set.
+
+The reference links a closed ``libbox_ps.so`` whose observable surface is
+BeginFeedPass/EndFeedPass/BeginPass/EndPass/PullSparseGPU/PushSparseGPU/
+SaveBase/SaveDelta (box_wrapper.cc:580-1331). This module implements that
+surface openly, re-shaped for TPU:
+
+- ``HostSparseTable``: the full 1e9..1e11-key store living in host RAM
+  (optionally spilled to disk per shard — the mem/SSD tiers), sharded by key
+  hash across ``n_shards`` locks for concurrent working-set builds.
+
+- ``PassWorkingSet``: the HBM tier. During load, every feasign of the pass is
+  fed in (PSAgent::AddKeys parity, data_set.cc:1647); ``finalize`` dedups,
+  pulls rows from the host store, and lays them out as a dense
+  ``[n_mesh_shards, capacity, width]`` fp32 array to be placed in device HBM
+  sharded over the mesh. Keys map to (mesh_shard, row) by hash, so the
+  device-side pull/push is a static-shape gather/scatter and the multi-chip
+  routing is a fixed all_to_all — the TPU-native analog of
+  PullSparseGPU/PushSparseGPU.
+
+- lookup: batch keys -> dense row ids happens host-side at pack time
+  (vectorized searchsorted over the pass's sorted key table), so no hash
+  tables ever live on device.
+
+Each mesh shard reserves its last row as the padding row (zero, never written
+back): batch padding and dropped-grad scatter both target it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
+from paddlebox_tpu.table.value_layout import ValueLayout
+
+_HASH_MULT = np.uint64(0x9E3779B97F4A7C15)
+
+
+def key_to_shard(keys: np.ndarray, n_shards: int) -> np.ndarray:
+    """Mesh/host shard of each key: multiplicative hash then modulo.
+
+    Feasigns are already hashes in production, but cheap mixing keeps
+    adversarial/test keys balanced too.
+    """
+    with np.errstate(over="ignore"):
+        mixed = keys.astype(np.uint64) * _HASH_MULT
+    return (mixed >> np.uint64(33)).astype(np.int64) % n_shards
+
+
+class _Shard:
+    """One lock-protected hash shard of the host store."""
+
+    __slots__ = ("index", "values", "lock", "touched", "width")
+
+    def __init__(self, width: int):
+        self.index: Dict[int, int] = {}
+        self.values = np.zeros((0, width), dtype=np.float32)
+        self.lock = threading.Lock()
+        self.touched: set = set()
+        self.width = width
+
+    def _grow(self, need: int) -> None:
+        cap = len(self.values)
+        if need <= cap:
+            return
+        new_cap = max(1024, cap * 2, need)
+        nv = np.zeros((new_cap, self.width), dtype=np.float32)
+        nv[:cap] = self.values
+        self.values = nv
+
+
+class HostSparseTable:
+    """Host-RAM sharded key -> fp32 row store (the mem tier of BoxPS)."""
+
+    def __init__(
+        self,
+        layout: ValueLayout,
+        opt: SparseOptimizerConfig = SparseOptimizerConfig(),
+        n_shards: int = 64,
+        seed: int = 0,
+    ):
+        self.layout = layout
+        self.opt = opt
+        self.n_shards = n_shards
+        self._shards = [_Shard(layout.width) for _ in range(n_shards)]
+        self._rng = np.random.default_rng(seed)
+        self._size = 0
+        self._size_lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self._size
+
+    def _init_rows(self, n: int) -> np.ndarray:
+        lay = self.layout
+        rows = np.zeros((n, lay.width), dtype=np.float32)
+        r = self.opt.initial_range
+        rows[:, lay.embed_w_col] = self._rng.uniform(-r, r, size=n)
+        rows[:, lay.embedx_col : lay.embedx_col + lay.embedx_dim] = self._rng.uniform(
+            -r, r, size=(n, lay.embedx_dim)
+        )
+        return rows
+
+    def pull_or_create(self, keys: np.ndarray) -> np.ndarray:
+        """Rows for unique ``keys`` (creating missing ones). [n, width]."""
+        out = np.empty((len(keys), self.layout.width), dtype=np.float32)
+        shard_ids = key_to_shard(keys, self.n_shards)
+        created = 0
+        for s in range(self.n_shards):
+            sel = np.nonzero(shard_ids == s)[0]
+            if len(sel) == 0:
+                continue
+            shard = self._shards[s]
+            with shard.lock:
+                idx = shard.index
+                # .tolist() converts uint64->int in C; dict lookups via map
+                # keep the per-key cost minimal until the C++ store lands
+                klist = keys[sel].tolist()
+                get = idx.get
+                rows = np.fromiter(
+                    (get(k, -1) for k in klist), dtype=np.int64, count=len(klist)
+                )
+                miss = np.nonzero(rows < 0)[0]
+                if len(miss):
+                    base = len(idx)
+                    shard._grow(base + len(miss))
+                    init = self._init_rows(len(miss))
+                    new_rows = base + np.arange(len(miss))
+                    for mj, j in zip(new_rows, miss):
+                        idx[klist[j]] = int(mj)
+                    shard.values[new_rows] = init
+                    rows[miss] = new_rows
+                    created += len(miss)
+                out[sel] = shard.values[rows]
+        if created:
+            with self._size_lock:
+                self._size += created
+        return out
+
+    def push(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Write back full rows for existing keys (end-of-pass flush)."""
+        shard_ids = key_to_shard(keys, self.n_shards)
+        created = 0
+        for s in range(self.n_shards):
+            sel = np.nonzero(shard_ids == s)[0]
+            if len(sel) == 0:
+                continue
+            shard = self._shards[s]
+            with shard.lock:
+                idx = shard.index
+                klist = keys[sel].tolist()
+                get = idx.get
+                trows = np.fromiter(
+                    (get(k, -1) for k in klist), dtype=np.int64, count=len(klist)
+                )
+                miss = np.nonzero(trows < 0)[0]
+                if len(miss):
+                    base = len(idx)
+                    shard._grow(base + len(miss))
+                    new_rows = base + np.arange(len(miss))
+                    for mj, j in zip(new_rows, miss):
+                        idx[klist[j]] = int(mj)
+                    trows[miss] = new_rows
+                    created += len(miss)
+                shard.values[trows] = rows[sel]
+                shard.touched.update(klist)
+        if created:
+            with self._size_lock:
+                self._size += created
+
+    def decay_and_shrink(self) -> int:
+        """Pass-boundary maintenance: decay show/clk, drop cold keys.
+
+        Returns number of keys dropped. (pslib show_click_decay_rate + shrink
+        threshold semantics; reference surfaces this as table shrink,
+        fleet_wrapper.h:258-310.)
+        """
+        lay, opt = self.layout, self.opt
+        dropped = 0
+        for shard in self._shards:
+            with shard.lock:
+                n = len(shard.index)
+                if n == 0:
+                    continue
+                vals = shard.values[:n]
+                vals[:, lay.SHOW] *= opt.show_clk_decay
+                vals[:, lay.CLK] *= opt.show_clk_decay
+                keep = vals[:, lay.SHOW] >= opt.shrink_threshold
+                if keep.all():
+                    continue
+                keys_arr = np.empty(n, dtype=np.uint64)
+                rows_arr = np.empty(n, dtype=np.int64)
+                for i, (k, r) in enumerate(shard.index.items()):
+                    keys_arr[i] = k
+                    rows_arr[i] = r
+                order = np.argsort(rows_arr)
+                keys_arr, rows_arr = keys_arr[order], rows_arr[order]
+                kept = keep[rows_arr]
+                new_vals = vals[rows_arr[kept]]
+                dropped += int((~kept).sum())
+                shard.index = {int(k): i for i, k in enumerate(keys_arr[kept])}
+                shard.values = np.zeros(
+                    (max(1024, len(shard.index)), lay.width), dtype=np.float32
+                )
+                shard.values[: len(shard.index)] = new_vals
+        with self._size_lock:
+            self._size -= dropped
+        return dropped
+
+    # --- persistence: base + delta model publishing (SaveBase/SaveDelta parity,
+    # box_wrapper.cc:1288-1331) ---
+
+    def _snapshot_shard(self, s: int, only_touched: bool):
+        """Atomically snapshot (keys, values) of a shard and clear touched.
+
+        The snapshot+clear happens under the shard lock so a concurrent
+        push() either lands in this snapshot or stays marked touched for the
+        next delta — no update can fall between and be lost.
+        """
+        shard = self._shards[s]
+        with shard.lock:
+            if only_touched:
+                items = [(k, shard.index[k]) for k in shard.touched if k in shard.index]
+            else:
+                items = list(shard.index.items())
+            keys = np.array([k for k, _ in items], dtype=np.uint64)
+            vals = (
+                shard.values[[r for _, r in items]]
+                if items
+                else np.zeros((0, self.layout.width), dtype=np.float32)
+            )
+            shard.touched.clear()
+        return keys, vals
+
+    def save_base(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        meta = {
+            "n_shards": self.n_shards,
+            "width": self.layout.width,
+            "embedx_dim": self.layout.embedx_dim,
+            "kind": "base",
+        }
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        for s in range(self.n_shards):
+            keys, vals = self._snapshot_shard(s, only_touched=False)
+            np.savez_compressed(os.path.join(path, f"shard-{s:05d}.npz"), keys=keys, values=vals)
+
+    def save_delta(self, path: str) -> int:
+        """Write only keys touched since the last save; returns count."""
+        os.makedirs(path, exist_ok=True)
+        total = 0
+        for s in range(self.n_shards):
+            keys, vals = self._snapshot_shard(s, only_touched=True)
+            total += len(keys)
+            np.savez_compressed(os.path.join(path, f"shard-{s:05d}.npz"), keys=keys, values=vals)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"n_shards": self.n_shards, "kind": "delta"}, f)
+        return total
+
+    def load(self, path: str) -> None:
+        """Load a base dir, then optionally apply deltas via ``apply_delta``."""
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        if meta["n_shards"] != self.n_shards:
+            raise ValueError("shard count mismatch on load")
+        for s in range(self.n_shards):
+            data = np.load(os.path.join(path, f"shard-{s:05d}.npz"))
+            keys, vals = data["keys"], data["values"]
+            if len(keys):
+                self.push(keys, vals)
+            self._shards[s].touched.clear()
+
+    apply_delta = load  # a delta dir has the same format; push() upserts
+
+
+class PassWorkingSet:
+    """The HBM tier: dense pass-local table built from the pass's unique keys.
+
+    Life cycle (BeginFeedPass .. EndPass parity):
+      add_keys (during load, many threads) -> finalize() -> device array up
+      -> train steps gather/scatter rows -> writeback(updated_array) -> host.
+    """
+
+    def __init__(self, n_mesh_shards: int = 1):
+        self.n_mesh_shards = n_mesh_shards
+        self._key_chunks: List[np.ndarray] = []
+        self._lock = threading.Lock()
+        self._finalized = False
+        # set by finalize():
+        self.sorted_keys: Optional[np.ndarray] = None  # uint64 [n]
+        self.row_of_sorted: Optional[np.ndarray] = None  # int64 [n] global rows
+        self.capacity = 0  # rows per mesh shard (incl. padding row)
+        self.n_keys = 0
+
+    def add_keys(self, keys: np.ndarray) -> None:
+        """Feed feasigns seen in loaded records (PSAgent::AddKeys parity)."""
+        if self._finalized:
+            raise RuntimeError("working set already finalized")
+        if len(keys):
+            with self._lock:
+                self._key_chunks.append(np.unique(keys.astype(np.uint64)))
+
+    def finalize(
+        self, table: HostSparseTable, round_to: int = 512
+    ) -> np.ndarray:
+        """Dedup keys, pull host rows, lay out [n_mesh_shards, cap, width].
+
+        The returned array is what gets device_put with a mesh sharding on
+        axis 0. Row (s, cap-1) of every shard is the reserved padding row.
+        """
+        with self._lock:
+            if self._key_chunks:
+                all_keys = np.unique(np.concatenate(self._key_chunks))
+            else:
+                all_keys = np.zeros(0, dtype=np.uint64)
+            self._key_chunks = []
+        self.n_keys = len(all_keys)
+        ns = self.n_mesh_shards
+        shard_ids = key_to_shard(all_keys, ns)
+        counts = np.bincount(shard_ids, minlength=ns)
+        # +1 reserves the padding row; round for stable compiled shapes
+        cap = int(counts.max()) + 1 if len(all_keys) else 1
+        cap = -(-cap // round_to) * round_to
+        self.capacity = cap
+
+        # stable order: group by shard, rank within shard
+        order = np.argsort(shard_ids, kind="stable")
+        rank_in_shard = np.empty(len(all_keys), dtype=np.int64)
+        start = 0
+        for s in range(ns):
+            c = int(counts[s])
+            rank_in_shard[order[start : start + c]] = np.arange(c)
+            start += c
+        global_rows = shard_ids * cap + rank_in_shard
+
+        self.sorted_keys = all_keys  # np.unique output is sorted
+        self.row_of_sorted = global_rows
+
+        rows = table.pull_or_create(all_keys) if len(all_keys) else np.zeros(
+            (0, table.layout.width), dtype=np.float32
+        )
+        dev = np.zeros((ns, cap, table.layout.width), dtype=np.float32)
+        dev.reshape(ns * cap, -1)[global_rows] = rows
+        self._finalized = True
+        self._table = table
+        return dev
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Batch keys -> global row ids (int32). Keys must be in the pass."""
+        pos = np.searchsorted(self.sorted_keys, keys.astype(np.uint64))
+        pos = np.minimum(pos, len(self.sorted_keys) - 1)
+        if not np.all(self.sorted_keys[pos] == keys):
+            missing = keys[self.sorted_keys[pos] != keys]
+            raise KeyError(
+                f"{len(missing)} batch keys not in pass working set (e.g. {missing[:5]})"
+            )
+        return self.row_of_sorted[pos].astype(np.int32)
+
+    @property
+    def padding_row(self) -> int:
+        """Global row id safe for batch padding (shard 0's reserved row)."""
+        return self.capacity - 1
+
+    def writeback(self, device_array: np.ndarray) -> None:
+        """Flush trained rows back to the host store (EndPass parity)."""
+        if self.n_keys == 0:
+            return
+        flat = np.asarray(device_array).reshape(-1, device_array.shape[-1])
+        self._table.push(self.sorted_keys, flat[self.row_of_sorted])

@@ -1,0 +1,104 @@
+"""Feature value layouts.
+
+Parity with the reference's FeaturePullValueGpu/FeaturePushValueGpu template
+grid (box_wrapper.cc:400-530 dispatches over embedx_dim × expand_dim ×
+feature_type; the struct fields are visible through the copy kernels in
+box_wrapper.cu:31-140: [show, clk, embed_w, embedx...] with
+cvm_offset selecting how many leading floats flow to the model):
+
+- PLAIN / QUANT / SHOW_CLK : cvm_offset 3  (show, clk, embed_w)
+- CONV ("q value")         : cvm_offset 4  (box_wrapper.h:526)
+- PCOC                     : cvm_offset 8  (box_wrapper.h:524)
+- SHARE_EMBEDDING          : cvm_offset expand_embed_dim + 2 (box_wrapper.h:521)
+
+Here the layout is a plain column map over one fp32 row per key, shared by
+the host store and the device pass table:
+
+    [show, clk, cvm_extra..., embed_w, embedx[D], embed_g2, embedx_g2]
+
+The *pull* slice the model sees is the first ``cvm_offset + D`` columns
+(hidden = cvm_offset + embedx_dim, matching CheckEmbedSizeIsValid,
+box_wrapper.cc:442). Optimizer state (g2 sums) trails and never leaves the
+table.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class FeatureType(enum.Enum):
+    PLAIN = "plain"
+    QUANT = "quant"
+    SHOW_CLK = "show_clk"
+    CONV = "conv"
+    PCOC = "pcoc"
+    SHARE_EMBEDDING = "share_embedding"
+
+
+_CVM_OFFSET = {
+    FeatureType.PLAIN: 3,
+    FeatureType.QUANT: 3,
+    FeatureType.SHOW_CLK: 3,
+    FeatureType.CONV: 4,
+    FeatureType.PCOC: 8,
+}
+
+# embedx dims the reference compiles kernels for (box_wrapper.cc:444-457);
+# informative only — any D works here since XLA specializes at trace time.
+REFERENCE_EMBEDX_DIMS = (0, 8, 16, 32, 64, 128, 256, 280)
+REFERENCE_EXPAND_DIMS = (0, 8, 64)
+
+
+@dataclass(frozen=True)
+class ValueLayout:
+    embedx_dim: int = 8
+    expand_embed_dim: int = 0
+    feature_type: FeatureType = FeatureType.PLAIN
+
+    @property
+    def cvm_offset(self) -> int:
+        if self.feature_type == FeatureType.SHARE_EMBEDDING:
+            return self.expand_embed_dim + 2
+        return _CVM_OFFSET[self.feature_type]
+
+    # --- column indices ---
+    SHOW = 0
+    CLK = 1
+
+    @property
+    def embed_w_col(self) -> int:
+        # embed_w is the last of the cvm block (after show/clk and any
+        # conv/pcoc extras)
+        return self.cvm_offset - 1
+
+    @property
+    def embedx_col(self) -> int:
+        return self.cvm_offset
+
+    @property
+    def embed_g2_col(self) -> int:
+        return self.cvm_offset + self.embedx_dim
+
+    @property
+    def embedx_g2_col(self) -> int:
+        return self.cvm_offset + self.embedx_dim + 1
+
+    @property
+    def width(self) -> int:
+        """Total fp32 columns per key in the table (incl. optimizer state)."""
+        return self.cvm_offset + self.embedx_dim + 2
+
+    @property
+    def pull_width(self) -> int:
+        """Columns the model sees per key (= hidden size of pull tensors)."""
+        return self.cvm_offset + self.embedx_dim
+
+    @property
+    def push_width(self) -> int:
+        """Per-key push record: [show, clk, grads for cvm-extras+embed_w+embedx].
+
+        Mirrors FeaturePushValueGpu (show, clk, embed_g, embedx_g[D]).
+        """
+        return self.cvm_offset + self.embedx_dim

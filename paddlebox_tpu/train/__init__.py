@@ -1,0 +1,3 @@
+from paddlebox_tpu.train.train_step import TrainState, make_train_step, TrainStepConfig
+
+__all__ = ["TrainState", "make_train_step", "TrainStepConfig"]

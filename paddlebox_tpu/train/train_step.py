@@ -1,0 +1,159 @@
+"""The jitted train step — the whole per-batch pipeline in one XLA program.
+
+This one function replaces the reference's per-batch op loop
+(BoxPSWorker::TrainFiles boxps_worker.cc:420-466: pull_box_sparse →
+fused_seqpool_cvm → dense ops → push_box_sparse → dense sync → AUC):
+
+    pull rows → seqpool+CVM → model fwd/bwd → sparse adagrad scatter →
+    dense optimizer (+ cross-device psum) → AUC accumulate
+
+Everything is static-shape; the host packer (data/device_pack.py) prepared
+row ids / segment ids / padding. On a mesh the same local step runs under
+shard_map with the table sharded and dense grads/metrics psum'd — the
+single-device path is the degenerate axis_name=None case.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from paddlebox_tpu.metrics.auc import AucState, auc_init, auc_update
+from paddlebox_tpu.ops.pull_push import pull_sparse_rows, push_sparse_rows
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
+from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
+from paddlebox_tpu.table.value_layout import ValueLayout
+
+
+class TrainState(NamedTuple):
+    table: jnp.ndarray  # [rows, width] pass working-set (flat across shards)
+    params: Any  # dense model params
+    opt_state: Any  # optax state
+    auc: AucState
+    step: jnp.ndarray  # int32 scalar
+
+
+@dataclass(frozen=True)
+class TrainStepConfig:
+    num_slots: int
+    batch_size: int
+    layout: ValueLayout
+    sparse_opt: SparseOptimizerConfig = SparseOptimizerConfig()
+    use_cvm: bool = True
+    clk_filter: bool = False
+    pull_scale: float = 1.0
+    auc_buckets: int = 100_000
+    axis_name: Optional[str] = None  # set on a mesh; None = single device
+    slot_lr: Optional[tuple] = None  # per-slot lr multipliers, len num_slots
+
+
+def init_train_state(
+    table: jnp.ndarray,
+    params: Any,
+    dense_opt: optax.GradientTransformation,
+    auc_buckets: int = 100_000,
+) -> TrainState:
+    return TrainState(
+        table=table,
+        params=params,
+        opt_state=dense_opt.init(params),
+        auc=auc_init(auc_buckets),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_train_step(
+    model_apply: Callable,
+    dense_opt: optax.GradientTransformation,
+    cfg: TrainStepConfig,
+) -> Callable:
+    """Build ``step(state, batch_dict) -> (state, metrics)`` (pure, jittable).
+
+    ``batch_dict`` fields: uniq_rows [U], inverse [L], segments [L],
+    labels [B], optional dense [B, Dd]. See data/device_pack.py.
+    """
+    lay, opt = cfg.layout, cfg.sparse_opt
+    S, B = cfg.num_slots, cfg.batch_size
+
+    def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
+        uniq_rows = batch["uniq_rows"]
+        inverse = batch["inverse"]
+        segments = batch["segments"]
+        labels = batch["labels"]
+        dense = batch.get("dense")
+        U = uniq_rows.shape[0]
+
+        pulled_u = pull_sparse_rows(
+            state.table, uniq_rows, lay, opt.embedx_threshold, cfg.pull_scale
+        )  # [U, PW]
+        flat = jnp.take(pulled_u, inverse, axis=0)  # [L, PW]
+
+        def loss_fn(params, flat_records):
+            slot_feats = fused_seqpool_cvm(
+                flat_records,
+                segments,
+                num_slots=S,
+                batch_size=B,
+                use_cvm=cfg.use_cvm,
+                clk_filter=cfg.clk_filter,
+            )  # [B, S, F]
+            logits = model_apply(params, slot_feats, dense)
+            loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
+            preds = jax.nn.sigmoid(logits)
+            return jnp.mean(loss_vec), preds
+
+        (loss, preds), (gparams, gflat) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(state.params, flat)
+
+        # --- sparse push: per-slot lr scaling happens at flat resolution
+        # (a key deduped across slots gets each slot's scaled contribution),
+        # then grads merge per unique row — PushMergeCopy parity.
+        if cfg.slot_lr is not None:
+            slot_of_key = jnp.minimum(segments // B, S - 1)
+            lr_tab = jnp.asarray(cfg.slot_lr, jnp.float32)
+            gflat = gflat * lr_tab[slot_of_key][:, None]
+        valid = (segments < S * B).astype(jnp.float32)  # [L] pad mask
+        gflat = gflat * valid[:, None]
+        guniq = jax.ops.segment_sum(gflat, inverse, num_segments=U)
+        ins_of_key = segments % B
+        show_counts = jax.ops.segment_sum(valid, inverse, num_segments=U)
+        clk_counts = jax.ops.segment_sum(
+            jnp.take(labels, ins_of_key) * valid, inverse, num_segments=U
+        )
+
+        new_table = push_sparse_rows(
+            state.table, uniq_rows, guniq, show_counts, clk_counts, lay, opt
+        )
+
+        # --- dense sync: psum over the DP axis (K-step/NCCL allreduce parity)
+        if cfg.axis_name is not None:
+            gparams = jax.lax.pmean(gparams, cfg.axis_name)
+            loss = jax.lax.pmean(loss, cfg.axis_name)
+        updates, new_opt_state = dense_opt.update(gparams, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        new_auc = auc_update(state.auc, preds, labels)
+        metrics = {"loss": loss, "step": state.step + 1}
+        return (
+            TrainState(
+                table=new_table,
+                params=new_params,
+                opt_state=new_opt_state,
+                auc=new_auc,
+                step=state.step + 1,
+            ),
+            metrics,
+        )
+
+    return step
+
+
+def jit_train_step(step: Callable) -> Callable:
+    """Single-device jit with table donation (in-place HBM update)."""
+    return jax.jit(step, donate_argnums=(0,))

@@ -1,0 +1,21 @@
+"""Test env: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's CI posture (closed GPU libs absent, tests run the
+open pipeline on CPU; SURVEY.md §4): sharding/collective paths are exercised
+on a virtual device mesh; the real-TPU path is covered by bench.py and the
+driver's compile checks.
+
+Note: this environment preloads a TPU PJRT plugin via sitecustomize with
+JAX_PLATFORMS baked in, and jax is already imported by then — so the switch
+to CPU must go through jax.config.update, not os.environ.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,71 @@
+"""AUC calculator tests (BasicAucCalculator parity checks)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.metrics.auc import auc_compute, auc_init, auc_update
+
+
+def reference_auc(preds, labels):
+    """O(n log n) exact AUC by rank statistic."""
+    order = np.argsort(preds, kind="stable")
+    ranks = np.empty(len(preds), dtype=np.float64)
+    # average ranks for ties
+    sp = np.asarray(preds)[order]
+    i = 0
+    r = 1
+    while i < len(sp):
+        j = i
+        while j + 1 < len(sp) and sp[j + 1] == sp[i]:
+            j += 1
+        ranks[order[i : j + 1]] = (r + r + (j - i)) / 2.0
+        r += j - i + 1
+        i = j + 1
+    labels = np.asarray(labels)
+    n_pos = labels.sum()
+    n_neg = len(labels) - n_pos
+    return (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_auc_matches_rank_statistic():
+    rng = np.random.default_rng(0)
+    preds = rng.uniform(size=2000).astype(np.float32)
+    labels = (rng.uniform(size=2000) < preds).astype(np.float32)  # informative preds
+    st = auc_init(100_000)
+    st = auc_update(st, jnp.asarray(preds), jnp.asarray(labels))
+    got = auc_compute(st)
+    want = reference_auc(preds, labels)
+    assert abs(got["auc"] - want) < 2e-3
+    assert got["ins_num"] == 2000
+    np.testing.assert_allclose(got["actual_ctr"], labels.mean(), rtol=1e-5)
+    np.testing.assert_allclose(got["predicted_ctr"], preds.mean(), rtol=1e-4)
+
+
+def test_auc_perfect_and_random():
+    preds = jnp.array([0.1, 0.2, 0.8, 0.9])
+    labels = jnp.array([0.0, 0.0, 1.0, 1.0])
+    st = auc_update(auc_init(1000), preds, labels)
+    assert auc_compute(st)["auc"] == 1.0
+    st = auc_update(auc_init(1000), 1.0 - preds, labels)
+    assert auc_compute(st)["auc"] == 0.0
+
+
+def test_auc_mask_excludes_samples():
+    preds = jnp.array([0.9, 0.1])
+    labels = jnp.array([0.0, 1.0])  # terrible predictions...
+    mask = jnp.array([0.0, 0.0])  # ...but masked out
+    st = auc_update(auc_init(1000), preds, labels, mask)
+    m = auc_compute(st)
+    assert m["ins_num"] == 0
+    assert m["auc"] == 0.5  # degenerate -> 0.5
+
+
+def test_auc_accumulates_across_batches():
+    rng = np.random.default_rng(1)
+    preds = rng.uniform(size=512).astype(np.float32)
+    labels = (rng.uniform(size=512) < 0.3).astype(np.float32)
+    st = auc_init(10_000)
+    for i in range(4):
+        st = auc_update(st, jnp.asarray(preds[i::4]), jnp.asarray(labels[i::4]))
+    whole = auc_update(auc_init(10_000), jnp.asarray(preds), jnp.asarray(labels))
+    np.testing.assert_allclose(auc_compute(st)["auc"], auc_compute(whole)["auc"], rtol=1e-9)

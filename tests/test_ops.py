@@ -1,0 +1,120 @@
+"""Op-level numeric tests (OpTest-style parity harness, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.ops import (
+    cvm_transform,
+    fused_seqpool_cvm,
+    pull_sparse_rows,
+    push_sparse_rows,
+)
+from paddlebox_tpu.table import SparseOptimizerConfig, ValueLayout
+
+
+LAY = ValueLayout(embedx_dim=4)
+
+
+def _table(rows=8, show=None, seed=0):
+    rng = np.random.default_rng(seed)
+    t = rng.normal(size=(rows, LAY.width)).astype(np.float32)
+    t[:, LAY.SHOW] = show if show is not None else 20.0
+    t[:, LAY.CLK] = 1.0
+    t[:, LAY.embed_g2_col] = 0.0
+    t[:, LAY.embedx_g2_col] = 0.0
+    return jnp.asarray(t)
+
+
+def test_pull_layout_and_gating():
+    t = _table()
+    t = t.at[1, LAY.SHOW].set(0.0)  # below threshold -> embedx masked
+    pulled = pull_sparse_rows(t, jnp.array([0, 1]), LAY, embedx_threshold=10.0, scale=2.0)
+    assert pulled.shape == (2, LAY.pull_width)
+    np.testing.assert_allclose(pulled[0, :3], t[0, :3])
+    np.testing.assert_allclose(pulled[0, 3:], t[0, 3:7] * 2.0, rtol=1e-6)
+    np.testing.assert_allclose(pulled[1, 3:], 0.0)
+
+
+def test_cvm_transform():
+    pooled = jnp.array([[3.0, 1.0, 0.7, 0.2]])
+    out = cvm_transform(pooled, use_cvm=True)
+    np.testing.assert_allclose(out[0, 0], np.log(4.0), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], np.log(2.0) - np.log(4.0), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 2:], [0.7, 0.2])
+    out2 = cvm_transform(pooled, use_cvm=False)
+    np.testing.assert_allclose(out2[0], [0.7, 0.2])
+
+
+def test_fused_seqpool_cvm_matches_numpy():
+    S, B, W = 2, 3, LAY.pull_width
+    rng = np.random.default_rng(1)
+    # ragged: lengths per (slot, ins)
+    lens = np.array([[2, 1, 3], [1, 2, 1]])
+    L = lens.sum()
+    recs = np.abs(rng.normal(size=(L, W))).astype(np.float32)
+    segs = np.repeat(np.arange(S * B), lens.reshape(-1)).astype(np.int32)
+
+    out = fused_seqpool_cvm(jnp.asarray(recs), jnp.asarray(segs), S, B, use_cvm=True)
+    assert out.shape == (B, S, W)
+
+    # numpy reference
+    pooled = np.zeros((S * B, W), dtype=np.float32)
+    np.add.at(pooled, segs, recs)
+    pooled = pooled.reshape(S, B, W)
+    expect = pooled.copy()
+    expect[..., 0] = np.log(pooled[..., 0] + 1)
+    expect[..., 1] = np.log(pooled[..., 1] + 1) - np.log(pooled[..., 0] + 1)
+    np.testing.assert_allclose(out, np.transpose(expect, (1, 0, 2)), rtol=1e-3, atol=1e-4)
+
+
+def test_fused_seqpool_padding_goes_to_trash_segment():
+    S, B, W = 1, 2, LAY.pull_width
+    recs = jnp.ones((4, W))
+    segs = jnp.array([0, 1, S * B, S * B], dtype=jnp.int32)  # 2 pads
+    out = fused_seqpool_cvm(recs, segs, S, B, use_cvm=False)
+    np.testing.assert_allclose(out[:, 0, :], 1.0)  # each ins pooled exactly 1 record
+
+
+def test_push_updates_counters_and_weights():
+    opt = SparseOptimizerConfig(embed_lr=0.1, embedx_lr=0.1, embedx_threshold=10.0)
+    t = _table()
+    rows = jnp.array([2, 5])
+    g = jnp.ones((2, LAY.pull_width), jnp.float32) * 0.5
+    show_c = jnp.array([3.0, 1.0])
+    clk_c = jnp.array([1.0, 0.0])
+    t2 = push_sparse_rows(t, rows, g, show_c, clk_c, LAY, opt)
+
+    np.testing.assert_allclose(t2[2, LAY.SHOW], t[2, LAY.SHOW] + 3.0)
+    np.testing.assert_allclose(t2[2, LAY.CLK], t[2, LAY.CLK] + 1.0)
+    # embed_w moved against the gradient
+    assert float(t2[2, LAY.embed_w_col]) < float(t[2, LAY.embed_w_col])
+    # g2 accumulated
+    assert float(t2[2, LAY.embed_g2_col]) > 0.0
+    # untouched rows unchanged
+    np.testing.assert_array_equal(t2[0], t[0])
+
+
+def test_push_embedx_gated_below_threshold():
+    opt = SparseOptimizerConfig(embedx_threshold=10.0)
+    t = _table(show=1.0)  # below threshold
+    rows = jnp.array([0])
+    g = jnp.ones((1, LAY.pull_width), jnp.float32)
+    t2 = push_sparse_rows(t, rows, g, jnp.ones(1), jnp.zeros(1), LAY, opt)
+    # embedx unchanged, embed_w still updates
+    np.testing.assert_array_equal(t2[0, LAY.embedx_col : LAY.embedx_col + 4],
+                                  t[0, LAY.embedx_col : LAY.embedx_col + 4])
+    assert float(t2[0, LAY.embed_w_col]) != float(t[0, LAY.embed_w_col])
+
+
+def test_adagrad_step_decays_with_g2():
+    opt = SparseOptimizerConfig(embed_lr=0.1, initial_g2sum=1.0)
+    t = _table()
+    rows = jnp.array([0])
+    g = jnp.zeros((1, LAY.pull_width), jnp.float32).at[0, 2].set(1.0)
+    w0 = float(t[0, LAY.embed_w_col])
+    t1 = push_sparse_rows(t, rows, g, jnp.ones(1), jnp.zeros(1), LAY, opt)
+    d1 = w0 - float(t1[0, LAY.embed_w_col])
+    t2 = push_sparse_rows(t1, rows, g, jnp.ones(1), jnp.zeros(1), LAY, opt)
+    d2 = float(t1[0, LAY.embed_w_col]) - float(t2[0, LAY.embed_w_col])
+    assert 0 < d2 < d1  # adagrad: later identical grads take smaller steps

@@ -1,0 +1,118 @@
+"""Sparse table tests: host store, pass working set, persistence."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    PassWorkingSet,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.table.sparse_table import key_to_shard
+
+
+LAYOUT = ValueLayout(embedx_dim=4)
+OPT = SparseOptimizerConfig(initial_range=0.1, show_clk_decay=0.5, shrink_threshold=1.0)
+
+
+def test_layout_columns():
+    lay = ValueLayout(embedx_dim=8)
+    assert lay.cvm_offset == 3
+    assert lay.embed_w_col == 2
+    assert lay.embedx_col == 3
+    assert lay.width == 3 + 8 + 2
+    assert lay.pull_width == 11
+
+
+def test_pull_or_create_and_persistence(tmp_path):
+    t = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=1)
+    keys = np.array([1, 2, 3, 1 << 50], dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    assert rows.shape == (4, LAYOUT.width)
+    assert len(t) == 4
+    # embed_w initialized in range
+    assert np.all(np.abs(rows[:, LAYOUT.embed_w_col]) <= 0.1)
+    # idempotent pull returns same rows
+    rows2 = t.pull_or_create(keys)
+    np.testing.assert_array_equal(rows, rows2)
+
+    rows[:, LAYOUT.SHOW] = 5.0
+    t.push(keys, rows)
+    t.save_base(str(tmp_path / "base"))
+
+    t2 = HostSparseTable(LAYOUT, OPT, n_shards=4)
+    t2.load(str(tmp_path / "base"))
+    got = t2.pull_or_create(keys)
+    np.testing.assert_array_equal(got, rows)
+
+
+def test_save_delta_only_touched(tmp_path):
+    t = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    keys = np.arange(1, 11, dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    t.save_base(str(tmp_path / "base"))  # clears touched
+    sub = keys[:3]
+    t.push(sub, rows[:3] + 1.0)
+    n = t.save_delta(str(tmp_path / "delta"))
+    assert n == 3
+    # apply delta onto a fresh load of base
+    t2 = HostSparseTable(LAYOUT, OPT, n_shards=2)
+    t2.load(str(tmp_path / "base"))
+    t2.apply_delta(str(tmp_path / "delta"))
+    got = t2.pull_or_create(sub)
+    np.testing.assert_allclose(got, rows[:3] + 1.0)
+
+
+def test_decay_and_shrink():
+    t = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    hot = np.array([100], dtype=np.uint64)
+    cold = np.array([200], dtype=np.uint64)
+    rows = t.pull_or_create(np.concatenate([hot, cold]))
+    rows[0, LAYOUT.SHOW] = 10.0  # decays to 5 -> kept
+    rows[1, LAYOUT.SHOW] = 1.0  # decays to 0.5 -> dropped
+    t.push(np.concatenate([hot, cold]), rows)
+    dropped = t.decay_and_shrink()
+    assert dropped == 1
+    assert len(t) == 1
+    got = t.pull_or_create(hot)
+    np.testing.assert_allclose(got[0, LAYOUT.SHOW], 5.0)
+
+
+@pytest.mark.parametrize("n_mesh_shards", [1, 4])
+def test_working_set_roundtrip(n_mesh_shards):
+    t = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=2)
+    ws = PassWorkingSet(n_mesh_shards=n_mesh_shards)
+    k1 = np.array([5, 9, 13], dtype=np.uint64)
+    k2 = np.array([9, 21, 1 << 40], dtype=np.uint64)
+    ws.add_keys(k1)
+    ws.add_keys(k2)
+    dev = ws.finalize(t, round_to=8)
+    assert ws.n_keys == 5
+    assert dev.shape[0] == n_mesh_shards
+    assert dev.shape[1] % 8 == 0
+
+    all_keys = np.unique(np.concatenate([k1, k2]))
+    rows = ws.lookup(all_keys)
+    # every key's row holds the host store's values
+    host_rows = t.pull_or_create(all_keys)
+    flat = dev.reshape(-1, LAYOUT.width)
+    np.testing.assert_array_equal(flat[rows], host_rows)
+    # mesh shard assignment consistent with hashing
+    shard_of_row = rows // ws.capacity
+    np.testing.assert_array_equal(shard_of_row, key_to_shard(all_keys, n_mesh_shards))
+
+    # writeback flushes mutations
+    flat[rows] += 1.0
+    ws.writeback(flat.reshape(dev.shape))
+    got = t.pull_or_create(all_keys)
+    np.testing.assert_allclose(got, host_rows + 1.0)
+
+
+def test_lookup_missing_key_raises():
+    t = HostSparseTable(LAYOUT, OPT, n_shards=2)
+    ws = PassWorkingSet()
+    ws.add_keys(np.array([1, 2], dtype=np.uint64))
+    ws.finalize(t, round_to=8)
+    with pytest.raises(KeyError):
+        ws.lookup(np.array([999], dtype=np.uint64))
