@@ -56,6 +56,151 @@ class DeviceBatch:
         return d
 
 
+def _extract_labels_dense(
+    batch: SlotBatch,
+    schema: SlotSchema,
+    label_slot: Optional[str],
+    dense_slot: Optional[str],
+    dense_dim: int,
+):
+    """Shared label/dense-float extraction for both packers."""
+    label_name = label_slot or schema.label_slot
+    if label_name is not None:
+        li = schema.float_slot_index(label_name)
+        labels = batch.dense_float_matrix(li, 1)[:, 0]
+    else:
+        labels = np.zeros(batch.batch_size, dtype=np.float32)
+    dense = None
+    if dense_slot is not None and dense_dim:
+        di = schema.float_slot_index(dense_slot)
+        dense = batch.dense_float_matrix(di, dense_dim)
+    return labels.astype(np.float32), dense
+
+
+@dataclass
+class ShardedDeviceBatch:
+    """Static-shape arrays for the mesh train step; axis 0 = device.
+
+    ``req_ranks[d, s]`` is the bucket of rank-within-shard requests device d
+    sends shard s (pads -> cap-1, the padding row); ``inverse[d]`` maps the
+    device's flat keys to bucket positions ``s*K + j``. The last slot of every
+    bucket (j = K-1) is guaranteed padding, so pad inverse entries point at
+    bucket position K-1 of shard 0.
+    """
+
+    local_batch: int
+    num_slots: int
+    req_ranks: np.ndarray  # int32 [n_dev, n_shards, K]
+    inverse: np.ndarray  # int32 [n_dev, L_pad] flat key -> bucket pos
+    segments: np.ndarray  # int32 [n_dev, L_pad]; pads -> S*local_batch
+    labels: np.ndarray  # f32 [n_dev, local_batch]
+    dense: Optional[np.ndarray]  # f32 [n_dev, local_batch, dense_dim]
+
+    def as_dict(self) -> Dict[str, np.ndarray]:
+        d = {
+            "req_ranks": self.req_ranks,
+            "inverse": self.inverse,
+            "segments": self.segments,
+            "labels": self.labels,
+        }
+        if self.dense is not None:
+            d["dense"] = self.dense
+        return d
+
+
+def pack_batch_sharded(
+    batch: SlotBatch,
+    ws: PassWorkingSet,
+    schema: SlotSchema,
+    n_devices: int,
+    dense_slot: Optional[str] = None,
+    dense_dim: int = 0,
+    label_slot: Optional[str] = None,
+    bucket: Optional[int] = None,
+) -> ShardedDeviceBatch:
+    """Split a global batch across the mesh and bucket keys by owner shard.
+
+    The analog of the reference's per-GPU batch split (one BoxPSWorker per
+    device over pre-partitioned offsets, data_set.cc:2155-2192) plus the
+    host half of the inter-node key routing that the closed PullSparseGPU
+    performs internally: every unique row is assigned to its owner shard's
+    request bucket here, so the device side is pure all_to_all + gather.
+
+    ``n_devices`` must equal the working set's mesh shard count (table shard
+    axis == dp axis), and the batch size must divide evenly.
+    """
+    bucket = bucket or config.get_flag("batch_bucket_rounding")
+    ns = ws.n_mesh_shards
+    if n_devices != ns:
+        raise ValueError(f"n_devices {n_devices} != working-set mesh shards {ns}")
+    B = batch.batch_size
+    if B % n_devices:
+        raise ValueError(f"batch {B} not divisible by {n_devices} devices")
+    b = B // n_devices
+    S = batch.num_sparse_slots
+    cap = ws.capacity
+
+    rows = ws.lookup(batch.keys)  # int32 [L] global rows (shard*cap + rank)
+    segments = batch.segment_ids()  # int32 [L] slot*B + ins
+    ins = segments % B
+    slot = segments // B
+    dev = ins // b
+
+    per_dev = []  # (uniq_rows, inverse, local_segments) per device
+    max_L = 1
+    max_bucket = 1
+    for d in range(n_devices):
+        sel = np.nonzero(dev == d)[0]
+        uniq, inv = np.unique(rows[sel], return_inverse=True)
+        local_seg = slot[sel] * b + (ins[sel] - d * b)
+        per_dev.append((uniq, inv, local_seg))
+        max_L = max(max_L, len(sel))
+        if len(uniq):
+            counts = np.bincount(uniq // cap, minlength=ns)
+            max_bucket = max(max_bucket, int(counts.max()))
+
+    # K-1 is always a pad slot; L_pad/K identical across devices so the mesh
+    # program has one shape (compute_thread_batch_nccl lockstep parity,
+    # data_set.cc:2069-2135)
+    K = _round_bucket(max_bucket + 1, bucket)
+    L_pad = _round_bucket(max_L, bucket)
+
+    req_ranks = np.full((n_devices, ns, K), cap - 1, dtype=np.int32)
+    inverse = np.full((n_devices, L_pad), K - 1, dtype=np.int32)
+    seg_out = np.full((n_devices, L_pad), S * b, dtype=np.int32)
+
+    for d, (uniq, inv, local_seg) in enumerate(per_dev):
+        shard_of = (uniq // cap).astype(np.int64)
+        rank_of = (uniq % cap).astype(np.int64)
+        order = np.argsort(shard_of, kind="stable")
+        counts = np.bincount(shard_of, minlength=ns)
+        # bucket position of each unique row: owner_shard*K + slot-in-bucket
+        pos_in_bucket = np.empty(len(uniq), dtype=np.int64)
+        start = 0
+        for s in range(ns):
+            c = int(counts[s])
+            req_ranks[d, s, :c] = rank_of[order[start : start + c]]
+            pos_in_bucket[order[start : start + c]] = s * K + np.arange(c)
+            start += c
+        inverse[d, : len(inv)] = pos_in_bucket[inv]
+        seg_out[d, : len(local_seg)] = local_seg
+
+    labels, dense = _extract_labels_dense(batch, schema, label_slot, dense_slot, dense_dim)
+    labels = labels.reshape(n_devices, b)
+    if dense is not None:
+        dense = dense.reshape(n_devices, b, dense_dim)
+
+    return ShardedDeviceBatch(
+        local_batch=b,
+        num_slots=S,
+        req_ranks=req_ranks,
+        inverse=inverse,
+        segments=seg_out,
+        labels=labels,
+        dense=dense,
+    )
+
+
 def pack_batch(
     batch: SlotBatch,
     ws: PassWorkingSet,
@@ -92,17 +237,7 @@ def pack_batch(
     seg_p = np.full(L_pad, S * B, dtype=np.int32)
     seg_p[:L] = segments
 
-    label_name = label_slot or schema.label_slot
-    if label_name is not None:
-        li = schema.float_slot_index(label_name)
-        labels = batch.dense_float_matrix(li, 1)[:, 0]
-    else:
-        labels = np.zeros(B, dtype=np.float32)
-
-    dense = None
-    if dense_slot is not None and dense_dim:
-        di = schema.float_slot_index(dense_slot)
-        dense = batch.dense_float_matrix(di, dense_dim)
+    labels, dense = _extract_labels_dense(batch, schema, label_slot, dense_slot, dense_dim)
 
     return DeviceBatch(
         batch_size=B,
@@ -110,7 +245,7 @@ def pack_batch(
         uniq_rows=uniq_p,
         inverse=inv_p,
         segments=seg_p,
-        labels=labels.astype(np.float32),
+        labels=labels,
         dense=dense,
         n_keys=L,
         n_uniq=U,

@@ -65,6 +65,9 @@ def auc_compute(state: AucState) -> Dict[str, float]:
     """Host-side f64 integration (BasicAucCalculator::compute parity)."""
     pos = np.asarray(state.pos, dtype=np.float64)
     neg = np.asarray(state.neg, dtype=np.float64)
+    if pos.ndim > 1:  # device-sharded bucket tables [n_dev, buckets]
+        pos = pos.reshape(-1, pos.shape[-1]).sum(axis=0)
+        neg = neg.reshape(-1, neg.shape[-1]).sum(axis=0)
     n_buckets = len(pos)
     center = (np.arange(n_buckets, dtype=np.float64) + 0.5) / n_buckets
 

@@ -59,6 +59,28 @@ def push_sparse_rows(
     optimizer semantics documented in table/optimizers.py.
     """
     old = jnp.take(table, rows, axis=0)  # [U, width]
+    new_rows = sparse_update_rows(
+        old, grads, show_counts, clk_counts, layout, opt, lr_scale
+    )
+    # Scatter the *delta* with add-semantics: with host dedup rows are unique
+    # and this equals a set; without dedup (enable_pullpush_dedup_keys=0) a
+    # key occurring in several slots contributes each occurrence's update
+    # deterministically (sequential-push semantics) instead of last-write-wins.
+    return table.at[rows].add(new_rows - old)
+
+
+def sparse_update_rows(
+    old: jnp.ndarray,  # [U, width] current rows
+    grads: jnp.ndarray,  # [U, pull_width] d(loss)/d(pull record)
+    show_counts: jnp.ndarray,  # f32 [U]
+    clk_counts: jnp.ndarray,  # f32 [U]
+    layout: ValueLayout,
+    opt: SparseOptimizerConfig,
+    lr_scale: jnp.ndarray | float = 1.0,
+) -> jnp.ndarray:
+    """Row-wise sparse optimizer math shared by the single-device scatter path
+    and the sharded owner-side merge path (rows with all-zero records are
+    identity: g2 += 0, step 0, counters += 0)."""
     co, D = layout.cvm_offset, layout.embedx_dim
 
     show = old[:, layout.SHOW] + show_counts
@@ -84,7 +106,7 @@ def push_sparse_rows(
     new_x = old[:, co : co + D] - (opt.embedx_lr * lr_scale * scale_x)[:, None] * x_grad
     new_x = jnp.clip(new_x, -opt.weight_bounds, opt.weight_bounds)
 
-    new_rows = jnp.concatenate(
+    return jnp.concatenate(
         [
             show[:, None],
             clk[:, None],
@@ -95,8 +117,3 @@ def push_sparse_rows(
         ],
         axis=1,
     )
-    # Scatter the *delta* with add-semantics: with host dedup rows are unique
-    # and this equals a set; without dedup (enable_pullpush_dedup_keys=0) a
-    # key occurring in several slots contributes each occurrence's update
-    # deterministically (sequential-push semantics) instead of last-write-wins.
-    return table.at[rows].add(new_rows - old)

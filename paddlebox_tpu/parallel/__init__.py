@@ -1,0 +1,28 @@
+"""Distributed tier: device mesh, sharded sparse table pull/push, collectives.
+
+TPU-native replacement for the reference's NCCL/MPI/boxps communication stack
+(SURVEY.md §2.3): a `jax.sharding.Mesh` plus XLA collectives over ICI/DCN
+stand in for NCCLCommContext + the closed `boxps::MPICluster`/`PaddleShuffler`;
+the sparse table is itself device-sharded, replacing the RPC parameter-server
+tier entirely.
+"""
+
+from paddlebox_tpu.parallel.mesh import (
+    MeshPlan,
+    make_mesh,
+    put_replicated,
+    put_sharded,
+)
+from paddlebox_tpu.parallel.sharded_pullpush import (
+    sharded_pull,
+    sharded_push,
+)
+
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "put_replicated",
+    "put_sharded",
+    "sharded_pull",
+    "sharded_push",
+]

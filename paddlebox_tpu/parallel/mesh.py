@@ -1,0 +1,85 @@
+"""Device mesh plan for CTR training.
+
+The reference's process/device topology — one BoxPSWorker per GPU
+(boxps_trainer.cc:53-73), NCCL ring per node, closed `boxps::MPICluster`
+across nodes (box_wrapper.h:531) — collapses on TPU into one
+`jax.sharding.Mesh` with a single `dp` axis:
+
+- the minibatch is data-parallel over `dp` (one worker per chip parity);
+- the pass working-set table is *sharded* over the same axis (the model-
+  parallel dimension of a CTR model is the embedding table, which dwarfs the
+  dense net — so dp and "table mp" share one axis and pull/push ride ICI
+  all_to_all);
+- dense grads are psum'd over `dp` (the NCCL allreduce / SyncDense path).
+
+TP/PP/SP over the dense net are deliberately absent, matching the reference
+(SURVEY.md §2.3: tensor/sequence parallelism ❌ absent — CTR dense towers are
+tiny). The mesh axis spans both ICI and DCN when multi-host; XLA places the
+collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A mesh + the named shardings the train step uses."""
+
+    mesh: Mesh
+    axis: str = "dp"
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def sharded(self, *axes: Optional[str]) -> NamedSharding:
+        """NamedSharding partitioning the given positional axes; e.g.
+        ``plan.sharded(plan.axis)`` shards array axis 0 over dp."""
+        return NamedSharding(self.mesh, P(*axes))
+
+    @property
+    def table_sharding(self) -> NamedSharding:
+        """[n_shards, capacity, width] pass table: axis 0 over dp."""
+        return self.sharded(self.axis)
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        """Per-device-leading batch arrays [n_dev, ...]: axis 0 over dp."""
+        return self.sharded(self.axis)
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharded()
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis: str = "dp",
+    devices: Optional[Sequence[Any]] = None,
+) -> MeshPlan:
+    """Build the 1-D CTR mesh over the first ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(f"asked for {n_devices} devices, have {len(devices)}")
+    mesh = Mesh(np.asarray(devices[:n_devices]), (axis,))
+    return MeshPlan(mesh=mesh, axis=axis)
+
+
+def put_sharded(plan: MeshPlan, x: Any) -> jax.Array:
+    """Host array [n_dev, ...] -> device array sharded on axis 0."""
+    return jax.device_put(x, plan.batch_sharding)
+
+
+def put_replicated(plan: MeshPlan, tree: Any) -> Any:
+    """Replicate a pytree (dense params, opt state) on every device."""
+    return jax.device_put(tree, plan.replicated)

@@ -1,0 +1,110 @@
+"""Sharded-table pull/push: fixed-shape all_to_all over the mesh axis.
+
+TPU-native equivalent of the reference's multi-node sparse path — closed
+`boxps::PullSparseGPU`/`PushSparseGPU` with inter-node key routing inside the
+lib (box_wrapper_impl.h:122, :229) — re-expressed as XLA collectives:
+
+pull (runs inside shard_map, per device):
+  1. the host packer bucketed this device's unique rows by owning shard into
+     ``req_ranks [n_shards, K]`` (rank-within-shard; pads -> padding row);
+  2. ``all_to_all`` routes request buckets to owners over ICI;
+  3. each owner gathers its local rows (one static-shape gather);
+  4. ``all_to_all`` routes the value buckets back;
+  -> pulled records laid out by bucket position, so the batch's flat
+     ``inverse`` indices (host-computed) address them directly.
+
+push reverses the route: per-bucket merged grads + show/clk counts travel to
+the owner shard, which scatter-merges them per owned row and applies the
+sparse optimizer exactly once per row (PushSparseGPU merge semantics) —
+deterministic regardless of how many devices touched the row.
+
+All shapes are static (K is the host-padded bucket size), so the collective
+pattern compiles to fixed ICI traffic — no ragged RPC tier.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddlebox_tpu.ops.pull_push import pull_sparse_rows, sparse_update_rows
+from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
+from paddlebox_tpu.table.value_layout import ValueLayout
+
+
+def sharded_pull(
+    table_local: jnp.ndarray,  # [cap, width] this shard's rows
+    req_ranks: jnp.ndarray,  # int32 [n_shards, K] this device's requests
+    layout: ValueLayout,
+    embedx_threshold: float,
+    scale: float = 1.0,
+    axis_name: str = "dp",
+) -> jnp.ndarray:
+    """Pull records for this device's request buckets. [n_shards*K, pull_w].
+
+    Output row s*K + j is the value for request slot j of shard s — exactly
+    the bucket positions the host packer's ``inverse`` indices refer to.
+    """
+    n, K = req_ranks.shape
+    # route requests to owners: row d of the result = bucket from device d
+    req_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
+    # owner-side gather (+ embedx gating/scaling, PullCopy parity)
+    resp = pull_sparse_rows(
+        table_local, req_recv.reshape(-1), layout, embedx_threshold, scale
+    ).reshape(n, K, -1)
+    # route value buckets back: row s = bucket answered by shard s
+    resp_back = lax.all_to_all(resp, axis_name, 0, 0, tiled=True)
+    return resp_back.reshape(n * K, -1)
+
+
+def sharded_push(
+    table_local: jnp.ndarray,  # [cap, width]
+    req_ranks: jnp.ndarray,  # int32 [n_shards, K]
+    grads_bucket: jnp.ndarray,  # [n_shards*K, pull_w] merged grads per bucket pos
+    show_bucket: jnp.ndarray,  # f32 [n_shards*K]
+    clk_bucket: jnp.ndarray,  # f32 [n_shards*K]
+    layout: ValueLayout,
+    opt: SparseOptimizerConfig,
+    axis_name: str = "dp",
+) -> jnp.ndarray:
+    """Route push records to owner shards, merge, apply optimizer once/row.
+
+    Owner-side merge is a sort-based dedup over the n_shards*K received
+    records (requests for the same row from different devices collapse into
+    one merged record), so per-step work scales with the batch's request
+    volume — never with the shard's capacity.
+    """
+    n, K = req_ranks.shape
+    pw = layout.pull_width
+
+    recs = jnp.concatenate(
+        [show_bucket[:, None], clk_bucket[:, None], grads_bucket], axis=1
+    ).reshape(n, K, pw + 2)
+    recs_recv = lax.all_to_all(recs, axis_name, 0, 0, tiled=True)  # [n, K, pw+2]
+    ranks_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
+
+    M = n * K
+    flat_ranks = ranks_recv.reshape(M)
+    flat_recs = recs_recv.reshape(M, pw + 2)
+
+    # group duplicate ranks: sort, segment by run, merge records per run
+    order = jnp.argsort(flat_ranks)
+    sr = jnp.take(flat_ranks, order)
+    srecs = jnp.take(flat_recs, order, axis=0)
+    is_head = jnp.concatenate([jnp.ones((1,), bool), sr[1:] != sr[:-1]])
+    seg = jnp.cumsum(is_head.astype(jnp.int32)) - 1  # [M] run id
+    n_uniq = seg[-1] + 1
+    merged = jax.ops.segment_sum(srecs, seg, num_segments=M)  # rows >= n_uniq zero
+    # one rank per run (duplicates in a run carry the same value; runs beyond
+    # n_uniq stay 0, a safe in-bounds row)
+    rep_rank = jnp.zeros((M,), sr.dtype).at[seg].set(sr)
+
+    old = jnp.take(table_local, rep_rank, axis=0)
+    new = sparse_update_rows(
+        old, merged[:, 2:], merged[:, 0], merged[:, 1], layout, opt
+    )
+    # runs beyond n_uniq all alias rank 0 with zero records — mask them so
+    # clipping side-effects can't scatter there repeatedly
+    valid = (jnp.arange(M) < n_uniq)[:, None]
+    return table_local.at[rep_rank].add((new - old) * valid)

@@ -1,0 +1,171 @@
+"""Mesh train step: DP batch × sharded table × replicated dense, one program.
+
+The multi-device analog of train_step.py — what the reference spreads over
+per-GPU worker threads + NCCL + the closed boxps MPI tier
+(BoxPSWorker::TrainFiles boxps_worker.cc:420-466, SyncParam :359-398,
+PullSparseGPU/PushSparseGPU box_wrapper_impl.h) compiles here into ONE
+shard_map'd XLA program per step:
+
+  per device: pull own buckets via all_to_all ──┐
+  seqpool+CVM → model fwd/bwd                   │  ICI collectives,
+  push grads via all_to_all to owner shards ────┤  XLA-scheduled
+  dense grads psum (NCCL allreduce parity) ─────┘
+  AUC accumulates into the device's own bucket slice (no host sync)
+
+State placement: table [n_dev, cap, width] sharded on dp; AUC bucket tables
+[n_dev, buckets] sharded on dp (summed at read time — collect_data_nccl
+parity); dense params + optimizer state replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from paddlebox_tpu.metrics.auc import AucState, auc_init, auc_update
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
+from paddlebox_tpu.parallel.mesh import MeshPlan
+from paddlebox_tpu.parallel.sharded_pullpush import sharded_pull, sharded_push
+from paddlebox_tpu.train.train_step import TrainState, TrainStepConfig
+
+
+def init_sharded_train_state(
+    plan: MeshPlan,
+    table: Any,  # np [n_dev, cap, width] from PassWorkingSet.finalize
+    params: Any,
+    dense_opt: optax.GradientTransformation,
+    auc_buckets: int = 100_000,
+) -> TrainState:
+    n = plan.n_devices
+    auc = AucState(
+        pos=jnp.zeros((n, auc_buckets), jnp.int32),
+        neg=jnp.zeros((n, auc_buckets), jnp.int32),
+    )
+    return TrainState(
+        table=jax.device_put(table, plan.table_sharding),
+        params=jax.device_put(params, plan.replicated),
+        opt_state=jax.device_put(dense_opt.init(params), plan.replicated),
+        auc=jax.device_put(auc, plan.batch_sharding),
+        step=jax.device_put(jnp.zeros((), jnp.int32), plan.replicated),
+    )
+
+
+def make_sharded_train_step(
+    model_apply: Callable,
+    dense_opt: optax.GradientTransformation,
+    cfg: TrainStepConfig,
+    plan: MeshPlan,
+) -> Callable:
+    """Build jitted ``step(state, batch_dict) -> (state, metrics)`` on the mesh.
+
+    ``cfg.batch_size`` is the PER-DEVICE batch; ``batch_dict`` fields come from
+    ``pack_batch_sharded`` (req_ranks/inverse/segments/labels[/dense], all with
+    a leading device axis) placed with ``plan.batch_sharding``.
+    """
+    if cfg.axis_name not in (None, plan.axis):
+        raise ValueError(
+            f"cfg.axis_name {cfg.axis_name!r} != mesh axis {plan.axis!r}; the "
+            "sharded step always runs its collectives over the plan's axis"
+        )
+    lay, opt = cfg.layout, cfg.sparse_opt
+    S, b = cfg.num_slots, cfg.batch_size
+    ax = plan.axis
+
+    def local_step(state: TrainState, batch: Dict[str, jnp.ndarray]):
+        # strip the unit device axis of dp-sharded locals
+        table = state.table[0]  # [cap, width]
+        req_ranks = batch["req_ranks"][0]  # [n_shards, K]
+        inverse = batch["inverse"][0]  # [L]
+        segments = batch["segments"][0]  # [L]
+        labels = batch["labels"][0]  # [b]
+        dense = batch.get("dense")
+        if dense is not None:
+            dense = dense[0]
+        n, K = req_ranks.shape
+
+        pulled = sharded_pull(
+            table, req_ranks, lay, opt.embedx_threshold, cfg.pull_scale, ax
+        )  # [n*K, PW]
+        flat = jnp.take(pulled, inverse, axis=0)  # [L, PW]
+
+        def loss_fn(params, flat_records):
+            slot_feats = fused_seqpool_cvm(
+                flat_records,
+                segments,
+                num_slots=S,
+                batch_size=b,
+                use_cvm=cfg.use_cvm,
+                clk_filter=cfg.clk_filter,
+            )
+            logits = model_apply(params, slot_feats, dense)
+            loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
+            preds = jax.nn.sigmoid(logits)
+            return jnp.mean(loss_vec), preds
+
+        (loss, preds), (gparams, gflat) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(state.params, flat)
+
+        # sparse grads use GLOBAL-batch-mean normalization (local mean / n_dev)
+        # so owner-side merged grads match the single-device semantics exactly
+        # and the effective sparse LR is independent of mesh size
+        gflat = gflat / plan.n_devices
+        if cfg.slot_lr is not None:
+            slot_of_key = jnp.minimum(segments // b, S - 1)
+            lr_tab = jnp.asarray(cfg.slot_lr, jnp.float32)
+            gflat = gflat * lr_tab[slot_of_key][:, None]
+        valid = (segments < S * b).astype(jnp.float32)
+        gflat = gflat * valid[:, None]
+        nseg = n * K
+        gbucket = jax.ops.segment_sum(gflat, inverse, num_segments=nseg)
+        ins_of_key = segments % b
+        show_bucket = jax.ops.segment_sum(valid, inverse, num_segments=nseg)
+        clk_bucket = jax.ops.segment_sum(
+            jnp.take(labels, ins_of_key) * valid, inverse, num_segments=nseg
+        )
+
+        new_table = sharded_push(
+            table, req_ranks, gbucket, show_bucket, clk_bucket, lay, opt, ax
+        )
+
+        gparams = jax.lax.pmean(gparams, ax)
+        loss = jax.lax.pmean(loss, ax)
+        updates, new_opt_state = dense_opt.update(gparams, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        local_auc = AucState(pos=state.auc.pos[0], neg=state.auc.neg[0])
+        new_auc = auc_update(local_auc, preds, labels)
+        new_auc = AucState(pos=new_auc.pos[None], neg=new_auc.neg[None])
+
+        metrics = {"loss": loss, "step": state.step + 1}
+        new_state = TrainState(
+            table=new_table[None],
+            params=new_params,
+            opt_state=new_opt_state,
+            auc=new_auc,
+            step=state.step + 1,
+        )
+        return new_state, metrics
+
+    dp = P(ax)
+    rep = P()
+    state_specs = TrainState(table=dp, params=rep, opt_state=rep, auc=dp, step=rep)
+
+    def batch_specs(batch):
+        return {k: dp for k in batch}
+
+    def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
+        mapped = jax.shard_map(
+            local_step,
+            mesh=plan.mesh,
+            in_specs=(state_specs, batch_specs(batch)),
+            out_specs=(state_specs, {"loss": rep, "step": rep}),
+            check_vma=False,
+        )
+        return mapped(state, batch)
+
+    return jax.jit(step, donate_argnums=(0,))
