@@ -1,0 +1,133 @@
+"""Headline benchmark: DeepFM CTR train-step throughput, samples/sec/chip.
+
+Measures the steady-state jitted train step (sparse pull -> fused
+seqpool+CVM -> DeepFM fwd/bwd -> sparse adagrad push -> dense adam -> online
+AUC) on one chip with pre-packed static-shape batches — the device half of
+the reference's BoxPSWorker::TrainFiles loop (boxps_worker.cc:420-466).
+
+Baseline (BASELINE.json): 1M samples/sec on 64 chips => 15625 samples/sec/chip.
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Criteo-DeepFM-ish flagship shape (BASELINE.md config 3)
+NUM_SLOTS = 39
+EMBEDX_DIM = 16
+BATCH = 4096
+TABLE_ROWS = 1 << 21  # ~2M pass working-set rows on chip
+HIDDEN = (512, 256, 128)
+WARMUP = 5
+STEPS = 40
+BASELINE_PER_CHIP = 1_000_000 / 64
+
+
+def make_batches(rng, n_batches, rows_limit, bucket=512):
+    """Pre-packed DeviceBatch dicts with ONE static shape across batches."""
+    L = NUM_SLOTS * BATCH  # one key per slot per sample
+    batches = []
+    u_pad = None
+    raw = []
+    for _ in range(n_batches):
+        # zipf-ish skew: mix hot head with uniform tail, like CTR traffic
+        hot = rng.integers(0, 1 << 12, L // 4)
+        cold = rng.integers(0, rows_limit - 1, L - L // 4)
+        rows = np.concatenate([hot, cold]).astype(np.int64)
+        rng.shuffle(rows)
+        uniq, inverse = np.unique(rows, return_inverse=True)
+        raw.append((uniq, inverse))
+        need = -(-(len(uniq) + 1) // bucket) * bucket
+        u_pad = max(u_pad or 0, need)
+    for uniq, inverse in raw:
+        uniq_p = np.full(u_pad, rows_limit - 1, np.int32)  # pad -> padding row
+        uniq_p[: len(uniq)] = uniq
+        inv = inverse.astype(np.int32)  # L is exact here, no key padding needed
+        seg = np.repeat(np.arange(NUM_SLOTS, dtype=np.int32), BATCH) * BATCH + np.tile(
+            np.arange(BATCH, dtype=np.int32), NUM_SLOTS
+        )
+        labels = (rng.random(BATCH) < 0.2).astype(np.float32)
+        batches.append(
+            {
+                "uniq_rows": uniq_p,
+                "inverse": inv,
+                "segments": seg,
+                "labels": labels,
+            }
+        )
+    return batches
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.table import SparseOptimizerConfig, ValueLayout
+    from paddlebox_tpu.train import TrainStepConfig, make_train_step
+    from paddlebox_tpu.train.train_step import init_train_state, jit_train_step
+
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    layout = ValueLayout(embedx_dim=EMBEDX_DIM)
+    opt_cfg = SparseOptimizerConfig(embedx_threshold=0.0)
+
+    table = np.zeros((TABLE_ROWS, layout.width), np.float32)
+    table[:, layout.embed_w_col] = rng.normal(0, 1e-2, TABLE_ROWS)
+    table[:, layout.embedx_col : layout.embedx_col + EMBEDX_DIM] = rng.normal(
+        0, 1e-2, (TABLE_ROWS, EMBEDX_DIM)
+    )
+    table[TABLE_ROWS - 1] = 0.0  # padding row
+
+    model = DeepFM(
+        num_slots=NUM_SLOTS, feat_width=layout.pull_width, embedx_dim=EMBEDX_DIM, hidden=HIDDEN
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    dense_opt = optax.adam(1e-3)
+    cfg = TrainStepConfig(
+        num_slots=NUM_SLOTS,
+        batch_size=BATCH,
+        layout=layout,
+        sparse_opt=opt_cfg,
+        auc_buckets=100_000,
+    )
+    step = jit_train_step(make_train_step(model.apply, dense_opt, cfg))
+    state = init_train_state(
+        jax.device_put(jnp.asarray(table), dev), params, dense_opt, cfg.auc_buckets
+    )
+
+    host_batches = make_batches(rng, 8, TABLE_ROWS)
+    feeds = [
+        {k: jax.device_put(jnp.asarray(v), dev) for k, v in b.items()} for b in host_batches
+    ]
+
+    for i in range(WARMUP):
+        state, m = step(state, feeds[i % len(feeds)])
+    jax.block_until_ready(state.table)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, m = step(state, feeds[i % len(feeds)])
+    jax.block_until_ready(state.table)
+    dt = time.perf_counter() - t0
+
+    sps = STEPS * BATCH / dt
+    print(
+        json.dumps(
+            {
+                "metric": "deepfm_train_samples_per_sec_per_chip",
+                "value": round(sps, 1),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(sps / BASELINE_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

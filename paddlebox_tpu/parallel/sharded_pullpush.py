@@ -95,7 +95,9 @@ def sharded_push(
     is_head = jnp.concatenate([jnp.ones((1,), bool), sr[1:] != sr[:-1]])
     seg = jnp.cumsum(is_head.astype(jnp.int32)) - 1  # [M] run id
     n_uniq = seg[-1] + 1
-    merged = jax.ops.segment_sum(srecs, seg, num_segments=M)  # rows >= n_uniq zero
+    merged = jax.ops.segment_sum(
+        srecs, seg, num_segments=M, indices_are_sorted=True
+    )  # rows >= n_uniq zero
     # one rank per run (duplicates in a run carry the same value; runs beyond
     # n_uniq stay 0, a safe in-bounds row)
     rep_rank = jnp.zeros((M,), sr.dtype).at[seg].set(sr)

@@ -26,11 +26,15 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
-from paddlebox_tpu.metrics.auc import AucState, auc_init, auc_update
-from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
-from paddlebox_tpu.parallel.mesh import MeshPlan
+from paddlebox_tpu.metrics.auc import AucState, auc_update
+from paddlebox_tpu.parallel.mesh import MeshPlan, put_replicated, put_sharded
 from paddlebox_tpu.parallel.sharded_pullpush import sharded_pull, sharded_push
-from paddlebox_tpu.train.train_step import TrainState, TrainStepConfig
+from paddlebox_tpu.train.train_step import (
+    TrainState,
+    TrainStepConfig,
+    local_forward_backward,
+    scale_and_merge_grads,
+)
 
 
 def init_sharded_train_state(
@@ -46,11 +50,11 @@ def init_sharded_train_state(
         neg=jnp.zeros((n, auc_buckets), jnp.int32),
     )
     return TrainState(
-        table=jax.device_put(table, plan.table_sharding),
-        params=jax.device_put(params, plan.replicated),
-        opt_state=jax.device_put(dense_opt.init(params), plan.replicated),
-        auc=jax.device_put(auc, plan.batch_sharding),
-        step=jax.device_put(jnp.zeros((), jnp.int32), plan.replicated),
+        table=put_sharded(plan, table),
+        params=put_replicated(plan, params),
+        opt_state=put_replicated(plan, dense_opt.init(params)),
+        auc=put_sharded(plan, auc),
+        step=put_replicated(plan, jnp.zeros((), jnp.int32)),
     )
 
 
@@ -92,40 +96,20 @@ def make_sharded_train_step(
         )  # [n*K, PW]
         flat = jnp.take(pulled, inverse, axis=0)  # [L, PW]
 
-        def loss_fn(params, flat_records):
-            slot_feats = fused_seqpool_cvm(
-                flat_records,
-                segments,
-                num_slots=S,
-                batch_size=b,
-                use_cvm=cfg.use_cvm,
-                clk_filter=cfg.clk_filter,
-            )
-            logits = model_apply(params, slot_feats, dense)
-            loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
-            preds = jax.nn.sigmoid(logits)
-            return jnp.mean(loss_vec), preds
-
-        (loss, preds), (gparams, gflat) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1), has_aux=True
-        )(state.params, flat)
-
-        # sparse grads use GLOBAL-batch-mean normalization (local mean / n_dev)
-        # so owner-side merged grads match the single-device semantics exactly
-        # and the effective sparse LR is independent of mesh size
-        gflat = gflat / plan.n_devices
-        if cfg.slot_lr is not None:
-            slot_of_key = jnp.minimum(segments // b, S - 1)
-            lr_tab = jnp.asarray(cfg.slot_lr, jnp.float32)
-            gflat = gflat * lr_tab[slot_of_key][:, None]
-        valid = (segments < S * b).astype(jnp.float32)
-        gflat = gflat * valid[:, None]
-        nseg = n * K
-        gbucket = jax.ops.segment_sum(gflat, inverse, num_segments=nseg)
-        ins_of_key = segments % b
-        show_bucket = jax.ops.segment_sum(valid, inverse, num_segments=nseg)
-        clk_bucket = jax.ops.segment_sum(
-            jnp.take(labels, ins_of_key) * valid, inverse, num_segments=nseg
+        loss, preds, gparams, gflat = local_forward_backward(
+            model_apply, cfg, state.params, flat, segments, labels, dense
+        )
+        # grad_div rescales local-mean grads to GLOBAL-batch-mean so the
+        # owner-side merge matches single-device semantics exactly and the
+        # effective sparse LR is independent of mesh size
+        gbucket, show_bucket, clk_bucket = scale_and_merge_grads(
+            cfg,
+            gflat,
+            segments,
+            inverse,
+            labels,
+            num_segments=n * K,
+            grad_div=plan.n_devices,
         )
 
         new_table = sharded_push(

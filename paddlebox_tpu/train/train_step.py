@@ -67,6 +67,72 @@ def init_train_state(
     )
 
 
+def local_forward_backward(
+    model_apply: Callable,
+    cfg: TrainStepConfig,
+    params: Any,
+    flat: jnp.ndarray,  # [L, PW] pulled records per flat key
+    segments: jnp.ndarray,  # [L]
+    labels: jnp.ndarray,  # [b]
+    dense: Optional[jnp.ndarray],
+):
+    """Shared fwd/bwd body: seqpool+CVM -> model -> BCE, grads wrt (params, flat).
+
+    Used by both the single-device and the mesh-sharded step so the numerics
+    can never diverge between them.
+    """
+
+    def loss_fn(p, flat_records):
+        slot_feats = fused_seqpool_cvm(
+            flat_records,
+            segments,
+            num_slots=cfg.num_slots,
+            batch_size=cfg.batch_size,
+            use_cvm=cfg.use_cvm,
+            clk_filter=cfg.clk_filter,
+        )
+        logits = model_apply(p, slot_feats, dense)
+        loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
+        return jnp.mean(loss_vec), jax.nn.sigmoid(logits)
+
+    (loss, preds), (gparams, gflat) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1), has_aux=True
+    )(params, flat)
+    return loss, preds, gparams, gflat
+
+
+def scale_and_merge_grads(
+    cfg: TrainStepConfig,
+    gflat: jnp.ndarray,  # [L, PW]
+    segments: jnp.ndarray,  # [L]
+    inverse: jnp.ndarray,  # [L] flat key -> merge position
+    labels: jnp.ndarray,  # [b]
+    num_segments: int,
+    grad_div: float = 1.0,
+):
+    """Shared push-side merge: slot-lr scale, pad mask, per-position sums.
+
+    Returns (merged grads, show counts, clk counts), each [num_segments, ...].
+    ``grad_div`` rescales local-mean grads to global-mean on a mesh.
+    """
+    S, b = cfg.num_slots, cfg.batch_size
+    if grad_div != 1.0:
+        gflat = gflat / grad_div
+    if cfg.slot_lr is not None:
+        slot_of_key = jnp.minimum(segments // b, S - 1)
+        lr_tab = jnp.asarray(cfg.slot_lr, jnp.float32)
+        gflat = gflat * lr_tab[slot_of_key][:, None]
+    valid = (segments < S * b).astype(jnp.float32)  # [L] pad mask
+    gflat = gflat * valid[:, None]
+    merged = jax.ops.segment_sum(gflat, inverse, num_segments=num_segments)
+    ins_of_key = segments % b
+    show = jax.ops.segment_sum(valid, inverse, num_segments=num_segments)
+    clk = jax.ops.segment_sum(
+        jnp.take(labels, ins_of_key) * valid, inverse, num_segments=num_segments
+    )
+    return merged, show, clk
+
+
 def make_train_step(
     model_apply: Callable,
     dense_opt: optax.GradientTransformation,
@@ -93,38 +159,14 @@ def make_train_step(
         )  # [U, PW]
         flat = jnp.take(pulled_u, inverse, axis=0)  # [L, PW]
 
-        def loss_fn(params, flat_records):
-            slot_feats = fused_seqpool_cvm(
-                flat_records,
-                segments,
-                num_slots=S,
-                batch_size=B,
-                use_cvm=cfg.use_cvm,
-                clk_filter=cfg.clk_filter,
-            )  # [B, S, F]
-            logits = model_apply(params, slot_feats, dense)
-            loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
-            preds = jax.nn.sigmoid(logits)
-            return jnp.mean(loss_vec), preds
-
-        (loss, preds), (gparams, gflat) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1), has_aux=True
-        )(state.params, flat)
-
+        loss, preds, gparams, gflat = local_forward_backward(
+            model_apply, cfg, state.params, flat, segments, labels, dense
+        )
         # --- sparse push: per-slot lr scaling happens at flat resolution
         # (a key deduped across slots gets each slot's scaled contribution),
         # then grads merge per unique row — PushMergeCopy parity.
-        if cfg.slot_lr is not None:
-            slot_of_key = jnp.minimum(segments // B, S - 1)
-            lr_tab = jnp.asarray(cfg.slot_lr, jnp.float32)
-            gflat = gflat * lr_tab[slot_of_key][:, None]
-        valid = (segments < S * B).astype(jnp.float32)  # [L] pad mask
-        gflat = gflat * valid[:, None]
-        guniq = jax.ops.segment_sum(gflat, inverse, num_segments=U)
-        ins_of_key = segments % B
-        show_counts = jax.ops.segment_sum(valid, inverse, num_segments=U)
-        clk_counts = jax.ops.segment_sum(
-            jnp.take(labels, ins_of_key) * valid, inverse, num_segments=U
+        guniq, show_counts, clk_counts = scale_and_merge_grads(
+            cfg, gflat, segments, inverse, labels, num_segments=U
         )
 
         new_table = push_sparse_rows(
