@@ -1,6 +1,7 @@
 from paddlebox_tpu.data.slot_schema import SlotSchema, SlotInfo
 from paddlebox_tpu.data.slot_record import SlotRecord, SlotBatch, build_batch
 from paddlebox_tpu.data.parser import parse_line, parse_logkey
+from paddlebox_tpu.data.dataset import BoxPSDataset, LocalShuffleRouter
 
 __all__ = [
     "SlotSchema",
@@ -10,4 +11,6 @@ __all__ = [
     "build_batch",
     "parse_line",
     "parse_logkey",
+    "BoxPSDataset",
+    "LocalShuffleRouter",
 ]

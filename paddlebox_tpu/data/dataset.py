@@ -1,0 +1,388 @@
+"""Pass-scoped in-memory dataset: the BoxPSDataset / PadBoxSlotDataset analog.
+
+Reference surface being rebuilt (SURVEY.md B7/B17):
+- python driver `BoxPSDataset` (python/paddle/fluid/dataset.py:1081-1221):
+  set_date / load_into_memory / preload_into_memory / wait_preload_done /
+  begin_pass / end_pass(need_save_delta) / slots_shuffle;
+- C++ `PadBoxSlotDataset` (framework/data_set.cc:1515-2192): threaded file
+  read into SlotRecords, feasign collection into the pass working set
+  (PSAgent::AddKeys, data_set.cc:1647), node-striped file lists ("dualbox",
+  data_set.cc:1452-1464), record shuffle before train (PrepareTrain,
+  data_set.cc:2155-2192), equalized minibatch counts across devices
+  (compute_thread_batch_nccl, data_set.cc:2069-2135).
+
+TPU-shaped differences: the "device working set" is one dense jax array
+sharded over the mesh (built by PassWorkingSet.finalize) instead of closed
+HBM caches, and record routing across hosts is pluggable (``router``) with
+hash semantics identical to the reference (search_id % n, XXH-style ins_id
+hash, random).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import gzip
+import subprocess
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data.parser import parse_line
+from paddlebox_tpu.data.slot_record import SlotBatch, SlotRecord, build_batch
+from paddlebox_tpu.data.slot_schema import SlotSchema
+from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
+
+config.define_flag(
+    "padbox_dataset_shuffle_thread_num", 8, "default dataset reader thread count"
+)
+
+
+def _open_lines(path: str, pipe_command: Optional[str] = None):
+    """Line iterator over a local file; .gz transparent; optional converter
+    pipe (the open analog of fs_open_read's pipe_command, framework/io/fs.cc)."""
+    if pipe_command:
+        with open(path, "rb") as src:
+            proc = subprocess.Popen(
+                pipe_command,
+                shell=True,
+                stdin=src,
+                stdout=subprocess.PIPE,
+                text=True,
+            )
+            try:
+                yield from proc.stdout
+            finally:
+                proc.stdout.close()
+                if proc.wait() != 0:
+                    raise RuntimeError(f"pipe_command failed on {path}")
+    elif path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            yield from f
+    else:
+        with open(path, "r") as f:
+            yield from f
+
+
+def shuffle_route(records: Sequence[SlotRecord], n_parts: int, mode: str, seed: int) -> List[int]:
+    """Destination part of each record (ShuffleData routing parity,
+    data_set.cc:1772-1791): 'search_id' groups a query's ads on one node,
+    'ins_id' spreads by instance hash, 'random' is uniform."""
+    if mode == "search_id":
+        return [r.search_id % n_parts for r in records]
+    if mode == "ins_id":
+        # xxhash in the reference; any good string hash preserves semantics
+        import hashlib
+
+        return [
+            int.from_bytes(hashlib.blake2b(r.ins_id.encode(), digest_size=8).digest(), "little")
+            % n_parts
+            for r in records
+        ]
+    if mode == "random":
+        rng = np.random.default_rng(seed)
+        return list(rng.integers(0, n_parts, len(records)))
+    raise ValueError(f"unknown shuffle mode {mode!r}")
+
+
+class LocalShuffleRouter:
+    """In-process stand-in for the closed ``boxps::PaddleShuffler`` RPC tier:
+    exchanges records between n logical nodes living in one process. A real
+    multi-host deployment plugs a host-RPC/all_to_all implementation with the
+    same exchange() contract."""
+
+    def __init__(self, n_nodes: int):
+        self.n_nodes = n_nodes
+        self._inboxes: List[List[SlotRecord]] = [[] for _ in range(n_nodes)]
+        self._cond = threading.Condition()
+        self._done = 0
+        self._collected = 0
+
+    def exchange(self, from_node: int, parts: List[List[SlotRecord]]) -> None:
+        """Deliver this node's outgoing parts; marks the node finished sending
+        (the zero-length completion message of the reference's protocol,
+        data_set.cc:1835-1866, collapses into this call). A node racing ahead
+        into the next pass blocks here until every node collected the current
+        one, so passes can never interleave in the inboxes."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._done < self.n_nodes)
+            for dst, recs in enumerate(parts):
+                self._inboxes[dst].extend(recs)
+            self._done += 1
+            self._cond.notify_all()
+
+    def collect(self, node: int) -> List[SlotRecord]:
+        """Blocks until every node has exchanged (ShuffleResultWaitGroup
+        parity) so no late-arriving records are dropped."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._done >= self.n_nodes)
+            out = self._inboxes[node]
+            self._inboxes[node] = []
+            self._collected += 1
+            if self._collected >= self.n_nodes:  # re-arm for the next pass
+                self._done = 0
+                self._collected = 0
+                self._cond.notify_all()  # wake exchangers blocked on the barrier
+        return out
+
+
+@dataclass
+class PassStats:
+    files: int = 0
+    lines: int = 0
+    records: int = 0
+    keys: int = 0
+
+
+class BoxPSDataset:
+    """One node's view of the pass data pipeline.
+
+    Life cycle per pass (test_paddlebox_datafeed.py:103-119 sequence):
+        set_date -> [pre]load_into_memory -> begin_pass
+        -> batches()/train -> end_pass(need_save_delta)
+    """
+
+    def __init__(
+        self,
+        schema: SlotSchema,
+        table: HostSparseTable,
+        batch_size: int,
+        n_mesh_shards: int = 1,
+        read_threads: Optional[int] = None,
+        rank: int = 0,
+        nranks: int = 1,
+        shuffle_mode: str = "none",  # none|local|search_id|ins_id|random
+        router: Optional[LocalShuffleRouter] = None,
+        pipe_command: Optional[str] = None,
+        line_parser: Optional[Callable[[str, SlotSchema], Optional[SlotRecord]]] = None,
+        drop_remainder: bool = True,
+        seed: int = 0,
+    ):
+        self.schema = schema
+        self.table = table
+        self.batch_size = batch_size
+        self.n_mesh_shards = n_mesh_shards
+        self.read_threads = (
+            read_threads
+            if read_threads is not None
+            else config.get_flag("padbox_dataset_shuffle_thread_num")
+        )
+        self.rank = rank
+        self.nranks = nranks
+        self.shuffle_mode = shuffle_mode
+        self.router = router
+        self.pipe_command = pipe_command
+        self.line_parser = line_parser or parse_line
+        self.drop_remainder = drop_remainder
+        self.seed = seed
+
+        self.date: Optional[str] = None
+        self.pass_id = 0
+        self.current_phase = 1  # 1 join, 0 update (data_set.h:291)
+        self._filelist: List[str] = []
+        self.records: List[SlotRecord] = []
+        self.ws: Optional[PassWorkingSet] = None
+        self.device_table: Optional[np.ndarray] = None
+        self.stats = PassStats()
+        self._preload_thread: Optional[threading.Thread] = None
+        self._preload_exc: Optional[BaseException] = None
+        self._in_pass = False
+        self._staged = None  # (records, ws, stats) loaded but not begun
+        self._loading_stats = self.stats
+
+    # ---- pass config -----------------------------------------------------
+
+    def set_date(self, date: str) -> None:
+        """New day/pass id (BoxHelper::SetDate parity, box_wrapper.h:810)."""
+        self.date = date
+        self.pass_id += 1
+
+    def set_filelist(self, files: Sequence[str]) -> None:
+        """Full cluster file list; this node reads its rank-strided slice
+        (dualbox striping, data_set.cc:1452-1464)."""
+        expanded: List[str] = []
+        for f in files:
+            hits = sorted(_glob.glob(f)) if any(c in f for c in "*?[") else [f]
+            expanded.extend(hits)
+        self._filelist = expanded[self.rank :: self.nranks]
+
+    def set_current_phase(self, phase: int) -> None:
+        self.current_phase = phase
+
+    # ---- load ------------------------------------------------------------
+
+    def _read_one(self, path: str) -> List[SlotRecord]:
+        out = []
+        n_lines = 0
+        for line in _open_lines(path, self.pipe_command):
+            line = line.strip("\n")
+            if not line:
+                continue
+            n_lines += 1
+            rec = self.line_parser(line, self.schema)
+            if rec is not None:
+                out.append(rec)
+        with self._stats_lock:
+            self._loading_stats.lines += n_lines
+        return out
+
+    def load_into_memory(self) -> None:
+        """Threaded read -> (optional shuffle) -> staged records + key set.
+
+        Loads into a STAGING slot, not the live pass — so it can run while
+        the previous pass is still training (double buffering; the reference
+        survives two passes in RAM the same way, via the record object pool,
+        data_feed.h:934). ``begin_pass`` consumes the staged data.
+        """
+        if self._staged is not None:
+            raise RuntimeError("staged pass not yet consumed by begin_pass")
+        self._stats_lock = threading.Lock()
+        stats = PassStats(files=len(self._filelist))
+        self._loading_stats = stats
+        ws = PassWorkingSet(n_mesh_shards=self.n_mesh_shards)
+        records: List[SlotRecord] = []
+        if self._filelist:
+            with ThreadPoolExecutor(max_workers=self.read_threads) as pool:
+                for part in pool.map(self._read_one, self._filelist):
+                    records.extend(part)
+
+        records = self._shuffle_records(records)
+
+        # MergeInsKeys parity (data_set.cc:1628-1683): every feasign of the
+        # pass feeds the working set
+        for r in records:
+            ws.add_keys(r.u64_values)
+        stats.records = len(records)
+        self._staged = (records, ws, stats)
+        if not self._in_pass:
+            # no pass training right now: publish immediately so
+            # memory_data_size()/stats match reference post-load semantics
+            self.records, self.ws, self.stats = records, ws, stats
+
+    def preload_into_memory(self) -> None:
+        """Overlap next pass's IO with current training
+        (PreLoadIntoMemory, data_set.cc:1576-1626)."""
+        if self._preload_thread is not None:
+            raise RuntimeError("preload already running")
+
+        def run():
+            try:
+                self.load_into_memory()
+            except BaseException as e:  # surfaced in wait_preload_done
+                self._preload_exc = e
+
+        self._preload_thread = threading.Thread(target=run, daemon=True)
+        self._preload_thread.start()
+
+    def wait_preload_done(self) -> None:
+        if self._preload_thread is None:
+            return
+        self._preload_thread.join()
+        self._preload_thread = None
+        if self._preload_exc is not None:
+            exc, self._preload_exc = self._preload_exc, None
+            raise exc
+
+    def _shuffle_records(self, records: List[SlotRecord]) -> List[SlotRecord]:
+        mode = self.shuffle_mode
+        if mode == "none":
+            return records
+        rng = np.random.default_rng(self.seed + self.pass_id)
+        if mode == "local":
+            order = rng.permutation(len(records))
+            return [records[i] for i in order]
+        # global modes route records between nodes, then local-shuffle
+        if self.router is None:
+            if self.nranks != 1:
+                raise RuntimeError("global shuffle across ranks needs a router")
+            order = rng.permutation(len(records))
+            return [records[i] for i in order]
+        dests = shuffle_route(records, self.router.n_nodes, mode, self.seed + self.pass_id)
+        parts: List[List[SlotRecord]] = [[] for _ in range(self.router.n_nodes)]
+        for r, d in zip(records, dests):
+            parts[d].append(r)
+        self.router.exchange(self.rank, parts)
+        mine = self.router.collect(self.rank)
+        order = rng.permutation(len(mine))
+        return [mine[i] for i in order]
+
+    # ---- pass lifecycle --------------------------------------------------
+
+    def begin_pass(self, round_to: int = 512) -> np.ndarray:
+        """Consume the staged load, finalize the working set, build the device
+        table (BeginFeedPass+EndFeedPass+BeginPass collapse: on TPU the HBM
+        staging IS the finalize, box_wrapper.cc:580-626)."""
+        if self._staged is not None:
+            if self._in_pass:
+                raise RuntimeError("end_pass the previous pass before begin_pass")
+            self.records, self.ws, self.stats = self._staged
+            self._staged = None
+        if self.ws is None:
+            raise RuntimeError("load_into_memory first")
+        if not self.ws._finalized:
+            self.device_table = self.ws.finalize(self.table, round_to=round_to)
+        self.stats.keys = self.ws.n_keys
+        self._in_pass = True
+        return self.device_table
+
+    def end_pass(
+        self,
+        trained_table: Optional[np.ndarray] = None,
+        need_save_delta: bool = False,
+        delta_dir: Optional[str] = None,
+        shrink: bool = True,
+    ) -> dict:
+        """Flush trained rows to the host store, decay/shrink, optional delta
+        save (EndPass box_wrapper.cc:627 + SaveDelta :1316)."""
+        if not self._in_pass:
+            raise RuntimeError("begin_pass first")
+        if trained_table is not None:
+            self.ws.writeback(np.asarray(trained_table))
+        dropped = self.table.decay_and_shrink() if shrink else 0
+        saved = 0
+        if need_save_delta:
+            if delta_dir is None:
+                raise ValueError("need_save_delta requires delta_dir")
+            saved = self.table.save_delta(delta_dir)
+        self.records = []
+        self.ws = None
+        self.device_table = None
+        self._in_pass = False
+        return {"dropped": dropped, "delta_keys": saved}
+
+    # ---- batch serving ---------------------------------------------------
+
+    def memory_data_size(self) -> int:
+        return len(self.records)
+
+    def num_batches(self, global_count: Optional[int] = None) -> int:
+        """Minibatch count this pass. With ``global_count`` (the allreduced
+        max across nodes — compute_thread_batch_nccl parity) the tail is
+        re-split so every node runs the same count."""
+        local = len(self.records) // self.batch_size
+        if not self.drop_remainder and len(self.records) % self.batch_size:
+            local += 1
+        return global_count if global_count is not None else local
+
+    def batches(self, n_batches: Optional[int] = None) -> Iterator[SlotBatch]:
+        """Yield equal-size SlotBatches; wraps around if asked for more than
+        the pass holds (tail re-split parity: devices stay in lockstep)."""
+        n = self.num_batches() if n_batches is None else n_batches
+        B = self.batch_size
+        if not self.records:
+            if n > 0:
+                # yielding fewer batches than asked would desync mesh
+                # collectives across ranks — fail loudly instead
+                raise RuntimeError(
+                    f"asked for {n} batches but this node holds 0 records "
+                    "(check file striping / shuffle routing)"
+                )
+            return
+        for i in range(n):
+            recs = [
+                self.records[(i * B + j) % len(self.records)] for j in range(B)
+            ]
+            yield build_batch(recs, self.schema)

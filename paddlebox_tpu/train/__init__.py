@@ -1,3 +1,12 @@
 from paddlebox_tpu.train.train_step import TrainState, make_train_step, TrainStepConfig
+from paddlebox_tpu.train.sharded_step import init_sharded_train_state, make_sharded_train_step
+from paddlebox_tpu.train.trainer import CTRTrainer
 
-__all__ = ["TrainState", "make_train_step", "TrainStepConfig"]
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "TrainStepConfig",
+    "init_sharded_train_state",
+    "make_sharded_train_step",
+    "CTRTrainer",
+]

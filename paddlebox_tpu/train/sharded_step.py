@@ -43,6 +43,7 @@ def init_sharded_train_state(
     params: Any,
     dense_opt: optax.GradientTransformation,
     auc_buckets: int = 100_000,
+    opt_state: Any = None,  # carry over between passes; None = fresh
 ) -> TrainState:
     n = plan.n_devices
     auc = AucState(
@@ -52,7 +53,9 @@ def init_sharded_train_state(
     return TrainState(
         table=put_sharded(plan, table),
         params=put_replicated(plan, params),
-        opt_state=put_replicated(plan, dense_opt.init(params)),
+        opt_state=put_replicated(
+            plan, opt_state if opt_state is not None else dense_opt.init(params)
+        ),
         auc=put_sharded(plan, auc),
         step=put_replicated(plan, jnp.zeros((), jnp.int32)),
     )

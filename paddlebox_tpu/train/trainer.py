@@ -1,0 +1,180 @@
+"""Pass-loop trainer: the BoxPSTrainer/BoxPSWorker + Executor analog.
+
+The reference drives training through Executor::RunFromDataset spawning one
+BoxPSWorker thread per GPU (boxps_trainer.cc:186-200); here one CTRTrainer
+owns the jitted step (single-device or mesh — the mesh step already contains
+every device's work) and walks a BoxPSDataset pass by pass:
+
+    trainer = CTRTrainer(model, cfg, plan=...)
+    dataset.load_into_memory(); dataset.begin_pass()
+    metrics = trainer.train_pass(dataset)
+    dataset.end_pass(trainer.trained_table(), need_save_delta=...)
+
+Dense params/optimizer state persist across passes on device; the sparse
+working-set table is rebuilt per pass (pass-scoped HBM staging parity).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddlebox_tpu.data.dataset import BoxPSDataset
+from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
+from paddlebox_tpu.metrics.auc import auc_compute, auc_init
+from paddlebox_tpu.parallel.mesh import MeshPlan
+from paddlebox_tpu.train.sharded_step import (
+    init_sharded_train_state,
+    make_sharded_train_step,
+)
+from paddlebox_tpu.train.train_step import (
+    TrainState,
+    TrainStepConfig,
+    jit_train_step,
+    make_train_step,
+)
+
+
+class CTRTrainer:
+    def __init__(
+        self,
+        model: Any,  # object with .init(rng) / .apply(params, slot_feats, dense)
+        cfg: TrainStepConfig,
+        dense_opt: Optional[optax.GradientTransformation] = None,
+        plan: Optional[MeshPlan] = None,
+        dense_slot: Optional[str] = None,
+        dense_dim: int = 0,
+        pack_bucket: Optional[int] = None,
+    ):
+        self.model = model
+        self.cfg = cfg
+        self.dense_opt = dense_opt or optax.adam(1e-3)
+        self.plan = plan
+        self.dense_slot = dense_slot
+        self.dense_dim = dense_dim
+        self.pack_bucket = pack_bucket
+        self.params: Any = None
+        self.opt_state: Any = None
+        self._state: Optional[TrainState] = None
+        if plan is None:
+            self._step = jit_train_step(make_train_step(model.apply, self.dense_opt, cfg))
+        else:
+            self._step = make_sharded_train_step(model.apply, self.dense_opt, cfg, plan)
+
+    # ---- dense param lifecycle ------------------------------------------
+
+    def init_params(self, rng=None) -> None:
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.params = self.model.init(rng)
+        self.opt_state = self.dense_opt.init(self.params)
+
+    def save_dense(self, path: str) -> None:
+        """Dense checkpoint (worker-scope param dump parity,
+        boxps_trainer.cc:123-131)."""
+        path = path if path.endswith(".npz") else path + ".npz"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        leaves, treedef = jax.tree.flatten((self.params, self.opt_state))
+        np.savez_compressed(
+            path, treedef=str(treedef), **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+        )
+
+    def load_dense(self, path: str) -> None:
+        if self.params is None:
+            raise RuntimeError("init_params first (defines the tree structure)")
+        path = path if path.endswith(".npz") else path + ".npz"
+        data = np.load(path, allow_pickle=False)
+        leaves, treedef = jax.tree.flatten((self.params, self.opt_state))
+        loaded = [jnp.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))]
+        for a, b in zip(leaves, loaded):
+            if a.shape != b.shape:
+                raise ValueError(f"dense checkpoint shape mismatch {a.shape} vs {b.shape}")
+        self.params, self.opt_state = jax.tree.unflatten(treedef, loaded)
+
+    # ---- pass loop -------------------------------------------------------
+
+    def _make_state(self, dev_table: np.ndarray) -> TrainState:
+        if self.params is None:
+            self.init_params()
+        if self.plan is None:
+            flat = jnp.asarray(dev_table.reshape(-1, dev_table.shape[-1]))
+            return TrainState(
+                table=flat,
+                params=self.params,
+                opt_state=self.opt_state,
+                auc=auc_init(self.cfg.auc_buckets),
+                step=jnp.zeros((), jnp.int32),
+            )
+        return init_sharded_train_state(
+            self.plan,
+            dev_table,
+            self.params,
+            self.dense_opt,
+            self.cfg.auc_buckets,
+            opt_state=self.opt_state,
+        )
+
+    def _pack_and_put(self, batch, ws):
+        if self.plan is None:
+            db = pack_batch(
+                batch,
+                ws,
+                self._schema,
+                dense_slot=self.dense_slot,
+                dense_dim=self.dense_dim,
+                bucket=self.pack_bucket,
+            )
+            return {k: jnp.asarray(v) for k, v in db.as_dict().items()}
+        db = pack_batch_sharded(
+            batch,
+            ws,
+            self._schema,
+            self.plan.n_devices,
+            dense_slot=self.dense_slot,
+            dense_dim=self.dense_dim,
+            bucket=self.pack_bucket,
+        )
+        return {
+            k: jax.device_put(v, self.plan.batch_sharding) for k, v in db.as_dict().items()
+        }
+
+    def train_pass(
+        self,
+        dataset: BoxPSDataset,
+        n_batches: Optional[int] = None,
+        on_batch: Optional[Callable[[int, Dict], None]] = None,
+    ) -> Dict[str, float]:
+        """Train every minibatch of the current pass; returns pass metrics.
+
+        Call between dataset.begin_pass() and dataset.end_pass(...). Dense
+        params/opt state carry over to the next pass; the trained sparse
+        table is available via trained_table() for end_pass writeback.
+        """
+        if dataset.device_table is None:
+            raise RuntimeError("dataset.begin_pass() first")
+        self._schema = dataset.schema
+        state = self._make_state(dataset.device_table)
+        losses = []
+        for i, batch in enumerate(dataset.batches(n_batches)):
+            feed = self._pack_and_put(batch, dataset.ws)
+            state, m = self._step(state, feed)
+            if on_batch is not None:
+                on_batch(i, m)
+            losses.append(m["loss"])
+        # persist dense side for the next pass; state.table stays for writeback
+        self.params = state.params
+        self.opt_state = state.opt_state
+        self._state = state
+        out = auc_compute(state.auc)
+        out["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+        out["batches"] = float(len(losses))
+        return out
+
+    def trained_table(self) -> np.ndarray:
+        if self._state is None:
+            raise RuntimeError("no trained pass")
+        return np.asarray(self._state.table)
