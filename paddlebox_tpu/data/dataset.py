@@ -20,9 +20,6 @@ hash, random).
 
 from __future__ import annotations
 
-import glob as _glob
-import gzip
-import subprocess
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -35,36 +32,12 @@ from paddlebox_tpu.data.parser import parse_line
 from paddlebox_tpu.data.slot_record import SlotBatch, SlotRecord, build_batch
 from paddlebox_tpu.data.slot_schema import SlotSchema
 from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
+from paddlebox_tpu.utils.fs import fs_glob
+from paddlebox_tpu.utils.line_reader import BufferedLineFileReader
 
 config.define_flag(
     "padbox_dataset_shuffle_thread_num", 8, "default dataset reader thread count"
 )
-
-
-def _open_lines(path: str, pipe_command: Optional[str] = None):
-    """Line iterator over a local file; .gz transparent; optional converter
-    pipe (the open analog of fs_open_read's pipe_command, framework/io/fs.cc)."""
-    if pipe_command:
-        with open(path, "rb") as src:
-            proc = subprocess.Popen(
-                pipe_command,
-                shell=True,
-                stdin=src,
-                stdout=subprocess.PIPE,
-                text=True,
-            )
-            try:
-                yield from proc.stdout
-            finally:
-                proc.stdout.close()
-                if proc.wait() != 0:
-                    raise RuntimeError(f"pipe_command failed on {path}")
-    elif path.endswith(".gz"):
-        with gzip.open(path, "rt") as f:
-            yield from f
-    else:
-        with open(path, "r") as f:
-            yield from f
 
 
 def shuffle_route(records: Sequence[SlotRecord], n_parts: int, mode: str, seed: int) -> List[int]:
@@ -205,7 +178,7 @@ class BoxPSDataset:
         (dualbox striping, data_set.cc:1452-1464)."""
         expanded: List[str] = []
         for f in files:
-            hits = sorted(_glob.glob(f)) if any(c in f for c in "*?[") else [f]
+            hits = fs_glob(f) if any(c in f for c in "*?[") else [f]
             expanded.extend(hits)
         self._filelist = expanded[self.rank :: self.nranks]
 
@@ -217,8 +190,11 @@ class BoxPSDataset:
     def _read_one(self, path: str) -> List[SlotRecord]:
         out = []
         n_lines = 0
-        for line in _open_lines(path, self.pipe_command):
-            line = line.strip("\n")
+        # per-file seed decorrelates sampling across part files (same-seeded
+        # readers would keep/drop identical line indices)
+        seed = hash((self.seed, self.pass_id, path)) & 0x7FFFFFFF
+        reader = BufferedLineFileReader(path, converter=self.pipe_command, seed=seed)
+        for line in reader:
             if not line:
                 continue
             n_lines += 1
@@ -239,6 +215,8 @@ class BoxPSDataset:
         """
         if self._staged is not None:
             raise RuntimeError("staged pass not yet consumed by begin_pass")
+        if self._preload_thread is not None and threading.current_thread() is not self._preload_thread:
+            raise RuntimeError("preload in flight; wait_preload_done first")
         self._stats_lock = threading.Lock()
         stats = PassStats(files=len(self._filelist))
         self._loading_stats = stats
@@ -252,9 +230,14 @@ class BoxPSDataset:
         records = self._shuffle_records(records)
 
         # MergeInsKeys parity (data_set.cc:1628-1683): every feasign of the
-        # pass feeds the working set
-        for r in records:
-            ws.add_keys(r.u64_values)
+        # pass feeds the working set. Runs post-shuffle (ownership is final
+        # only after routing); chunked so lock/unique cost is per-chunk, not
+        # per-record.
+        chunk = 4096
+        for i in range(0, len(records), chunk):
+            ws.add_keys(
+                np.concatenate([r.u64_values for r in records[i : i + chunk]])
+            )
         stats.records = len(records)
         self._staged = (records, ws, stats)
         if not self._in_pass:

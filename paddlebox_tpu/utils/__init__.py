@@ -1,0 +1,21 @@
+"""Host-side utilities: fs/hdfs IO, line readers, timers, stats, dumps, trace.
+
+Reference: paddle/fluid/framework/io/{fs,shell}.*, string/string_helper.h,
+platform/{timer,monitor,profiler}.* (SURVEY.md B20/B21 + §5).
+"""
+
+from paddlebox_tpu.utils.fs import (  # noqa: F401
+    FileMgr,
+    fs_exists,
+    fs_glob,
+    fs_mkdir,
+    fs_open_read,
+    fs_open_write,
+    fs_remove,
+)
+from paddlebox_tpu.utils.line_reader import (  # noqa: F401
+    BufferedLineFileReader,
+    LineFileReader,
+)
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_GET, STAT_RESET  # noqa: F401
+from paddlebox_tpu.utils.timer import ScopedTimer, Timer, TimerRegistry  # noqa: F401

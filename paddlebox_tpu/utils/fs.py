@@ -1,0 +1,209 @@
+"""Filesystem dispatch: local files vs remote stores behind shell pipes.
+
+Parity with the reference's two IO tiers (SURVEY.md B20/B21):
+
+- open tier (framework/io/fs.{h,cc}): ``fs_open_read``/``fs_open_write``
+  dispatch on path prefix — local paths get plain/gzip streams, remote
+  (``hdfs:``/``afs:``) paths get a popen'd ``hadoop fs`` pipe — with an
+  optional converter command spliced into the pipe either way.
+- closed tier (``boxps::PaddleFileMgr``, box_wrapper.h:778-802 + pybind
+  box_helper_py.cc:121-140): ls/mkdir/exists/download/upload/remove — here
+  ``FileMgr``, implemented over the same dispatch, fully open.
+
+The hadoop binary and flags are configurable (the reference passes an
+``fs.default.name``/ugi config string); everything degrades to local-path
+behavior in tests where no hadoop exists.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import gzip
+import os
+import shutil
+import subprocess
+from typing import IO, Iterator, List, Optional
+
+from paddlebox_tpu import config
+
+config.define_flag("hadoop_bin", "hadoop", "hadoop client binary for hdfs:/afs: paths")
+config.define_flag("hdfs_retry", 3, "retry count for remote fs commands")
+
+_REMOTE_PREFIXES = ("hdfs:", "afs:")
+
+
+def is_remote(path: str) -> bool:
+    return path.startswith(_REMOTE_PREFIXES)
+
+
+def _hadoop_cmd(extra_conf: Optional[str] = None) -> str:
+    cmd = config.get_flag("hadoop_bin") + " fs"
+    if extra_conf:
+        cmd += " " + extra_conf
+    return cmd
+
+
+class _PipeStream:
+    """Text stream over a shell pipeline; raises on nonzero exit at close
+    (shell-pipe error propagation, framework/io/shell.cc)."""
+
+    def __init__(self, cmd: str, mode: str = "r", stdin_file: Optional[IO] = None):
+        self.cmd = cmd
+        writing = "w" in mode
+        self.proc = subprocess.Popen(
+            cmd,
+            shell=True,
+            stdin=(subprocess.PIPE if writing else stdin_file),
+            stdout=(None if writing else subprocess.PIPE),
+            text=True,
+        )
+        self.stream = self.proc.stdin if writing else self.proc.stdout
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.stream)
+
+    def read(self, *a) -> str:
+        return self.stream.read(*a)
+
+    def write(self, s: str) -> int:
+        return self.stream.write(s)
+
+    def close(self) -> None:
+        self.stream.close()
+        if self.proc.wait() != 0:
+            raise RuntimeError(f"pipe command failed ({self.proc.returncode}): {self.cmd}")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is None:
+            self.close()
+        else:  # error path: don't mask the original exception
+            self.proc.kill()
+            self.proc.wait()
+
+
+def fs_open_read(path: str, converter: Optional[str] = None):
+    """Readable text stream for ``path`` (fs_open_read parity, io/fs.h:36-88).
+
+    Remote paths stream through ``hadoop fs -cat``; ``.gz`` decompresses
+    transparently; ``converter`` (a shell command reading stdin) is spliced
+    last, exactly where the reference puts pipe converters.
+    """
+    if is_remote(path):
+        cmd = f"{_hadoop_cmd()} -cat '{path}'"
+        if path.endswith(".gz"):
+            cmd += " | zcat"
+        if converter:
+            cmd += f" | {converter}"
+        return _PipeStream(cmd, "r")
+    if converter:
+        src = open(path, "rb")
+        cmd = (f"zcat | {converter}") if path.endswith(".gz") else converter
+        stream = _PipeStream(cmd, "r", stdin_file=src)
+        src.close()  # child holds its own fd after Popen
+        return stream
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    return open(path, "r")
+
+
+def fs_open_write(path: str, converter: Optional[str] = None):
+    """Writable text stream; remote goes through ``hadoop fs -put -``; local
+    parents are created (fs_open_write parity: reference mkdir -p's first)."""
+    if is_remote(path):
+        cmd = f"{_hadoop_cmd()} -put - '{path}'"
+        if converter:
+            cmd = f"{converter} | " + cmd
+        return _PipeStream(cmd, "w")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    if converter:
+        return _PipeStream(f"{converter} > '{path}'", "w")
+    if path.endswith(".gz"):
+        return gzip.open(path, "wt")
+    return open(path, "w")
+
+
+def _run_remote(args: str) -> str:
+    last: Optional[Exception] = None
+    for _ in range(max(1, config.get_flag("hdfs_retry"))):
+        try:
+            return subprocess.check_output(
+                f"{_hadoop_cmd()} {args}", shell=True, text=True,
+                stderr=subprocess.DEVNULL,
+            )
+        except subprocess.CalledProcessError as e:  # retry-until-ok pattern
+            last = e
+    raise RuntimeError(f"remote fs command failed: {args}") from last
+
+
+def fs_exists(path: str) -> bool:
+    if is_remote(path):
+        try:
+            _run_remote(f"-test -e '{path}' && echo yes")
+            return True
+        except RuntimeError:
+            return False
+    return os.path.exists(path)
+
+
+def fs_mkdir(path: str) -> None:
+    if is_remote(path):
+        _run_remote(f"-mkdir -p '{path}'")
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def fs_remove(path: str) -> None:
+    if is_remote(path):
+        _run_remote(f"-rm -r '{path}'")
+    elif os.path.isdir(path):
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)
+
+
+def fs_glob(pattern: str) -> List[str]:
+    """File list matching ``pattern`` (ls tier of BoxFileMgr)."""
+    if is_remote(pattern):
+        out = _run_remote(f"-ls '{pattern}'")
+        files = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8 and not parts[0].startswith("Found"):
+                files.append(parts[-1])
+        return files
+    return sorted(_glob.glob(pattern))
+
+
+class FileMgr:
+    """The open `BoxFileMgr` (box_wrapper.h:778-802): ls/mkdir/exists/
+    upload/download/remove/touch over the fs dispatch above."""
+
+    def ls(self, path: str) -> List[str]:
+        pattern = path if any(c in path for c in "*?[") else os.path.join(path, "*")
+        return fs_glob(pattern)
+
+    def exists(self, path: str) -> bool:
+        return fs_exists(path)
+
+    def mkdir(self, path: str) -> None:
+        fs_mkdir(path)
+
+    def remove(self, path: str) -> None:
+        fs_remove(path)
+
+    def touch(self, path: str) -> None:
+        with fs_open_write(path) as f:
+            f.write("")
+
+    def download(self, remote: str, local: str) -> None:
+        with fs_open_read(remote) as src, fs_open_write(local) as dst:
+            shutil.copyfileobj(src, dst)
+
+    def upload(self, local: str, remote: str) -> None:
+        with fs_open_read(local) as src, fs_open_write(remote) as dst:
+            shutil.copyfileobj(src, dst)

@@ -1,0 +1,45 @@
+"""Process-wide stat registry.
+
+Parity with ``Monitor``/``StatRegistry`` (platform/monitor.h:43-153): named
+int/float counters bumped from anywhere via STAT_ADD / read via STAT_GET /
+zeroed via STAT_RESET — e.g. the reference's
+``STAT_total_feasign_num_in_mem`` (box_wrapper.cc:1282).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+Number = Union[int, float]
+
+_lock = threading.Lock()
+_stats: Dict[str, Number] = {}
+
+
+def STAT_ADD(name: str, value: Number = 1) -> None:
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + value
+
+
+def STAT_SET(name: str, value: Number) -> None:
+    with _lock:
+        _stats[name] = value
+
+
+def STAT_GET(name: str) -> Number:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def STAT_RESET(name: str | None = None) -> None:
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def all_stats() -> Dict[str, Number]:
+    with _lock:
+        return dict(_stats)

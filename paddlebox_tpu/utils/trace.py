@@ -1,0 +1,90 @@
+"""Event profiler with chrome-trace export.
+
+Parity with the reference's event profiler (platform/profiler.{h,cc}:
+``RecordEvent`` scoped annotations, profiler.h:127) and its chrome-trace
+exporter (tools/timeline.py:115-137). On TPU the heavy lifting belongs to
+jax.profiler (XLA traces); this host-side layer times the Python/runtime
+stages around the device (pack, infeed, pass pipeline) and writes the same
+``chrome://tracing`` JSON format.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class Profiler:
+    def __init__(self):
+        self._events: List[Dict] = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    @contextmanager
+    def record_event(self, name: str, category: str = "host"):
+        """Scoped annotation (platform::RecordEvent parity)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter_ns()
+            with self._lock:
+                self._events.append(
+                    {
+                        "name": name,
+                        "cat": category,
+                        "ph": "X",
+                        "ts": t0 / 1e3,  # chrome trace wants microseconds
+                        "dur": (t1 - t0) / 1e3,
+                        "pid": 0,
+                        "tid": threading.get_ident() % 100000,
+                    }
+                )
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write chrome://tracing JSON (timeline.py parity). Returns #events."""
+        with self._lock:
+            events = list(self._events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        return len(events)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+# process-global profiler, like the reference's g_state
+PROFILER = Profiler()
+
+
+def record_event(name: str, category: str = "host"):
+    return PROFILER.record_event(name, category)
+
+
+@contextmanager
+def device_trace(log_dir: Optional[str] = None):
+    """Wrap a region with jax.profiler device tracing when available
+    (nvprof-hook analog, platform/cuda_profiler.h)."""
+    import jax
+
+    if log_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
