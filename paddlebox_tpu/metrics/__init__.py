@@ -1,3 +1,22 @@
 from paddlebox_tpu.metrics.auc import AucState, auc_init, auc_update, auc_compute
+from paddlebox_tpu.metrics.registry import (
+    CmatchRankMaskMetricMsg,
+    CmatchRankMetricMsg,
+    MaskMetricMsg,
+    MetricMsg,
+    MetricRegistry,
+    MultiTaskMetricMsg,
+)
 
-__all__ = ["AucState", "auc_init", "auc_update", "auc_compute"]
+__all__ = [
+    "AucState",
+    "auc_init",
+    "auc_update",
+    "auc_compute",
+    "MetricMsg",
+    "MetricRegistry",
+    "MaskMetricMsg",
+    "MultiTaskMetricMsg",
+    "CmatchRankMetricMsg",
+    "CmatchRankMaskMetricMsg",
+]

@@ -128,7 +128,12 @@ def make_sharded_train_step(
         new_auc = auc_update(local_auc, preds, labels)
         new_auc = AucState(pos=new_auc.pos[None], neg=new_auc.neg[None])
 
-        metrics = {"loss": loss, "step": state.step + 1}
+        metrics = {
+            "loss": loss,
+            "step": state.step + 1,
+            "preds": preds,
+            "labels": labels,
+        }
         new_state = TrainState(
             table=new_table[None],
             params=new_params,
@@ -150,7 +155,10 @@ def make_sharded_train_step(
             local_step,
             mesh=plan.mesh,
             in_specs=(state_specs, batch_specs(batch)),
-            out_specs=(state_specs, {"loss": rep, "step": rep}),
+            out_specs=(
+                state_specs,
+                {"loss": rep, "step": rep, "preds": dp, "labels": dp},
+            ),
             check_vma=False,
         )
         return mapped(state, batch)

@@ -181,7 +181,14 @@ def make_train_step(
         new_params = optax.apply_updates(state.params, updates)
 
         new_auc = auc_update(state.auc, preds, labels)
-        metrics = {"loss": loss, "step": state.step + 1}
+        # preds/labels ride along for the host-side metric registry
+        # (AddAucMonitor parity) — small [B] arrays, no sync forced
+        metrics = {
+            "loss": loss,
+            "step": state.step + 1,
+            "preds": preds,
+            "labels": labels,
+        }
         return (
             TrainState(
                 table=new_table,

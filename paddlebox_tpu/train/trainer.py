@@ -27,6 +27,7 @@ import optax
 from paddlebox_tpu.data.dataset import BoxPSDataset
 from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
 from paddlebox_tpu.metrics.auc import auc_compute, auc_init
+from paddlebox_tpu.metrics.registry import MetricRegistry
 from paddlebox_tpu.parallel.mesh import MeshPlan
 from paddlebox_tpu.train.sharded_step import (
     init_sharded_train_state,
@@ -50,6 +51,7 @@ class CTRTrainer:
         dense_slot: Optional[str] = None,
         dense_dim: int = 0,
         pack_bucket: Optional[int] = None,
+        metric_registry: Optional["MetricRegistry"] = None,
     ):
         self.model = model
         self.cfg = cfg
@@ -58,6 +60,7 @@ class CTRTrainer:
         self.dense_slot = dense_slot
         self.dense_dim = dense_dim
         self.pack_bucket = pack_bucket
+        self.metric_registry = metric_registry
         self.params: Any = None
         self.opt_state: Any = None
         self._state: Optional[TrainState] = None
@@ -162,6 +165,15 @@ class CTRTrainer:
         for i, batch in enumerate(dataset.batches(n_batches)):
             feed = self._pack_and_put(batch, dataset.ws)
             state, m = self._step(state, feed)
+            if self.metric_registry is not None:
+                # per-batch registry feed with phase + logkey-derived vars
+                # (AddAucMonitor parity, boxps_worker.cc:408-418)
+                outputs = dict(m)
+                if batch.cmatch is not None:
+                    outputs["cmatch"] = batch.cmatch
+                if batch.rank is not None:
+                    outputs["rank"] = batch.rank
+                self.metric_registry.add_all(outputs, phase=dataset.current_phase)
             if on_batch is not None:
                 on_batch(i, m)
             losses.append(m["loss"])

@@ -1,0 +1,178 @@
+"""Metric registry tests (reference: MetricMsg hierarchy box_wrapper.h:281-361,
+phase filtering boxps_worker.cc:413, init_metric/get_metric_msg
+box_helper_py.cc:87-97)."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.metrics.registry import (
+    CmatchRankMetricMsg,
+    MetricRegistry,
+    parse_cmatch_rank_group,
+)
+
+
+def _outputs(preds, labels, **extra):
+    out = {"preds": np.asarray(preds, np.float32), "labels": np.asarray(labels, np.float32)}
+    out.update({k: np.asarray(v) for k, v in extra.items()})
+    return out
+
+
+def _perfect(n=64):
+    """Separable preds: label 1 ~ high score, label 0 ~ low score."""
+    labels = np.tile([0.0, 1.0], n // 2)
+    preds = np.where(labels > 0.5, 0.9, 0.1)
+    return preds, labels
+
+
+def test_parse_cmatch_rank_group():
+    assert parse_cmatch_rank_group("401:0,401:1") == [(401, 0), (401, 1)]
+    assert parse_cmatch_rank_group("401_0") == [(401, 0)]
+    assert parse_cmatch_rank_group("401, 402") == [(401, -1), (402, -1)]
+    assert parse_cmatch_rank_group("") == []
+
+
+def test_basic_metric_and_reset():
+    reg = MetricRegistry()
+    reg.init_metric("join_auc", bucket_size=1000)
+    preds, labels = _perfect()
+    reg.add_all(_outputs(preds, labels))
+    m = reg.get_metric("join_auc")
+    assert m["auc"] > 0.99
+    assert m["ins_num"] == 64
+    # get resets (reference compute-and-reset contract)
+    m2 = reg.get_metric("join_auc")
+    assert m2["ins_num"] == 0
+
+
+def test_phase_filtering():
+    reg = MetricRegistry()
+    reg.init_metric("join_only", phase=1, bucket_size=1000)
+    reg.init_metric("update_only", phase=0, bucket_size=1000)
+    reg.init_metric("both", phase=-1, bucket_size=1000)
+    preds, labels = _perfect()
+    counted = reg.add_all(_outputs(preds, labels), phase=1)
+    assert counted == 2  # join_only + both
+    assert reg.get_metric("join_only")["ins_num"] == 64
+    assert reg.get_metric("update_only")["ins_num"] == 0
+    assert reg.get_metric("both")["ins_num"] == 64
+
+
+def test_mask_metric():
+    reg = MetricRegistry()
+    reg.init_metric("masked", mask_var="sample_mask", bucket_size=1000)
+    preds, labels = _perfect(8)
+    mask = np.array([1, 1, 0, 0, 1, 0, 1, 0])
+    reg.add_all(_outputs(preds, labels, sample_mask=mask))
+    assert reg.get_metric("masked")["ins_num"] == 4
+
+
+def test_multi_task_cmatch_filter():
+    reg = MetricRegistry()
+    reg.init_metric(
+        "mt", method="multi_task_auc", cmatch_rank_group="401,402", bucket_size=1000
+    )
+    preds, labels = _perfect(8)
+    cmatch = np.array([401, 401, 402, 999, 999, 401, 402, 0])
+    reg.add_all(_outputs(preds, labels, cmatch=cmatch))
+    assert reg.get_metric("mt")["ins_num"] == 5
+
+
+def test_cmatch_rank_pairs_and_ignore_rank():
+    preds, labels = _perfect(8)
+    cmatch = np.array([401, 401, 401, 401, 402, 402, 402, 402])
+    rank = np.array([0, 1, 2, 0, 0, 1, 0, 1])
+    m = CmatchRankMetricMsg("cr", "401:0,402:1", bucket_size=1000)
+    m.add_data(_outputs(preds, labels, cmatch=cmatch, rank=rank))
+    assert m.get_metric()["ins_num"] == 4  # 401/0 x2, 402/1 x2
+    m2 = CmatchRankMetricMsg("cr2", "401:0", ignore_rank=True, bucket_size=1000)
+    m2.add_data(_outputs(preds, labels, cmatch=cmatch, rank=rank))
+    assert m2.get_metric()["ins_num"] == 4  # all cmatch==401
+
+
+def test_cmatch_rank_mask_combined():
+    reg = MetricRegistry()
+    reg.init_metric(
+        "crm", cmatch_rank_group="401:0", mask_var="ok", bucket_size=1000
+    )
+    preds, labels = _perfect(4)
+    reg.add_all(
+        _outputs(
+            preds,
+            labels,
+            cmatch=np.array([401, 401, 401, 999]),
+            rank=np.array([0, 0, 1, 0]),
+            ok=np.array([1, 0, 1, 1]),
+        )
+    )
+    assert reg.get_metric("crm")["ins_num"] == 1  # only ins 0 passes both
+
+
+def test_metric_msg_string_format():
+    reg = MetricRegistry()
+    reg.init_metric("fmt", bucket_size=1000)
+    preds, labels = _perfect()
+    reg.add_all(_outputs(preds, labels))
+    msg = reg.get_metric_msg("fmt")
+    for field in ("AUC=", "BUCKET_ERROR=", "MAE=", "RMSE=", "Actual CTR=", "COPC=", "INS_NUM="):
+        assert field in msg, msg
+
+
+def test_unknown_method_rejected():
+    reg = MetricRegistry()
+    with pytest.raises(ValueError):
+        reg.init_metric("bad", method="wuauc")
+
+
+def test_trainer_integration_exposes_preds():
+    """Train-step metrics must carry preds/labels for the registry feed."""
+    import jax.numpy as jnp
+    import optax
+
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        PassWorkingSet,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train.train_step import (
+        TrainStepConfig,
+        init_train_state,
+        jit_train_step,
+        make_train_step,
+    )
+
+    lay = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(lay, SparseOptimizerConfig(embedx_threshold=0.0))
+    ws = PassWorkingSet()
+    keys = np.arange(1, 50, dtype=np.uint64)
+    ws.add_keys(keys)
+    dev = ws.finalize(table, round_to=64)
+
+    B, S = 8, 3
+    model = DeepFM(num_slots=S, feat_width=lay.pull_width, embedx_dim=4, hidden=(8,))
+    cfg = TrainStepConfig(num_slots=S, batch_size=B, layout=lay, auc_buckets=100)
+    opt = optax.sgd(0.1)
+    step = jit_train_step(make_train_step(model.apply, opt, cfg))
+    state = init_train_state(
+        jnp.asarray(dev.reshape(-1, dev.shape[-1])),
+        model.init(__import__("jax").random.PRNGKey(0)),
+        opt,
+        100,
+    )
+    rows = ws.lookup(np.arange(1, 1 + B * S, dtype=np.uint64) % 49 + 1)
+    feed = {
+        "uniq_rows": np.pad(np.unique(rows), (0, 64 - len(np.unique(rows))), constant_values=ws.padding_row).astype(np.int32),
+        "inverse": np.pad(np.searchsorted(np.unique(rows), rows), (0, 64 - len(rows)), constant_values=63).astype(np.int32),
+        "segments": np.pad(np.arange(B * S) % (S * B), (0, 64 - B * S), constant_values=S * B).astype(np.int32),
+        "labels": np.tile([0.0, 1.0], B // 2).astype(np.float32),
+    }
+    state, m = step(state, feed)
+    assert m["preds"].shape == (B,)
+    assert m["labels"].shape == (B,)
+
+    reg = MetricRegistry()
+    reg.init_metric("e2e", bucket_size=100)
+    reg.add_all(m)
+    assert reg.get_metric("e2e")["ins_num"] == B
