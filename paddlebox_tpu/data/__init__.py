@@ -2,6 +2,13 @@ from paddlebox_tpu.data.slot_schema import SlotSchema, SlotInfo
 from paddlebox_tpu.data.slot_record import SlotRecord, SlotBatch, build_batch
 from paddlebox_tpu.data.parser import parse_line, parse_logkey
 from paddlebox_tpu.data.dataset import BoxPSDataset, LocalShuffleRouter
+from paddlebox_tpu.data.pv_instance import (
+    PvInstance,
+    build_rank_offset,
+    flatten_pv_instances,
+    merge_pv_instances,
+    pack_pv_batches,
+)
 
 __all__ = [
     "SlotSchema",
@@ -13,4 +20,9 @@ __all__ = [
     "parse_logkey",
     "BoxPSDataset",
     "LocalShuffleRouter",
+    "PvInstance",
+    "build_rank_offset",
+    "flatten_pv_instances",
+    "merge_pv_instances",
+    "pack_pv_batches",
 ]

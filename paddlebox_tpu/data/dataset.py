@@ -20,6 +20,7 @@ hash, random).
 
 from __future__ import annotations
 
+import itertools
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -29,6 +30,12 @@ import numpy as np
 
 from paddlebox_tpu import config
 from paddlebox_tpu.data.parser import parse_line
+from paddlebox_tpu.data.pv_instance import (
+    PvInstance,
+    flatten_pv_instances,
+    merge_pv_instances,
+    pack_pv_batches,
+)
 from paddlebox_tpu.data.slot_record import SlotBatch, SlotRecord, build_batch
 from paddlebox_tpu.data.slot_schema import SlotSchema
 from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
@@ -184,6 +191,50 @@ class BoxPSDataset:
 
     def set_current_phase(self, phase: int) -> None:
         self.current_phase = phase
+
+    # ---- pv merge (join phase) ------------------------------------------
+
+    def preprocess_instance(
+        self, max_rank: int = 3, valid_cmatch=(222, 223)
+    ) -> int:
+        """Group this pass's records into pv instances for join-phase
+        training (PreprocessInstance parity, data_set.cc:1968-2009).
+        Returns the pv count. Requires logkey parsing (search_id)."""
+        self.pvs: List[PvInstance] = merge_pv_instances(self.records)
+        self._pv_max_rank = max_rank
+        self._pv_valid_cmatch = tuple(valid_cmatch)
+        self._pv_merged = True
+        return len(self.pvs)
+
+    def postprocess_instance(self) -> None:
+        """Restore the flat record view for the update phase
+        (PostprocessInstance parity)."""
+        if getattr(self, "_pv_merged", False):
+            self.records = flatten_pv_instances(self.pvs)
+            self.pvs = []
+            self._pv_merged = False
+
+    def pv_batches(self, n_batches: Optional[int] = None):
+        """Join-phase batches: (SlotBatch with rank_offset, ins_weight).
+
+        Whole pvs pack into ``batch_size`` instance slots, ghost-padded
+        (see data/pv_instance.py). SlotBatch.rank_offset is set; ins_weight
+        masks ghosts out of loss/metrics/show-clk.
+        """
+        if not getattr(self, "_pv_merged", False):
+            raise RuntimeError("preprocess_instance first")
+        packed = pack_pv_batches(
+            self.pvs,
+            self.batch_size,
+            max_rank=self._pv_max_rank,
+            valid_cmatch=self._pv_valid_cmatch,
+        )
+        if n_batches is not None:
+            packed = itertools.islice(packed, n_batches)
+        for records, rank_offset, weight in packed:
+            sb = build_batch(records, self.schema)
+            sb.rank_offset = rank_offset
+            yield sb, weight
 
     # ---- load ------------------------------------------------------------
 

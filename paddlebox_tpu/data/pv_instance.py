@@ -1,0 +1,144 @@
+"""PV (page-view) instance merging and rank_offset construction.
+
+Parity with the reference's join-phase machinery:
+- ``PreprocessInstance`` sorts records by search_id and groups each query's
+  ads into one ``SlotPvInstance`` (data_set.cc:1968-2009);
+- ``PostprocessInstance`` restores the flat record list for the update phase;
+- ``GetRankOffset`` builds the [ins, 2*max_rank+1] matrix rank_attention
+  consumes (data_feed.cc:2531-2580): col 0 is the ad's own 1-based rank (-1
+  if invalid), col 2m+1/2m+2 are the rank and batch row of the pv's ad with
+  rank m+1. An ad is rank-valid iff its cmatch is in ``valid_cmatch`` and
+  1 <= rank <= max_rank (the reference hard-codes cmatch 222/223).
+
+TPU-shaped difference: the reference serves join batches of N whole pvs with
+a data-dependent total ad count; XLA wants static shapes, so ``pack_pv_batches``
+packs whole pvs into fixed-size instance batches and pads the tail with
+weight-0 ghost copies of the last real ad — ghosts contribute nothing to the
+loss, metrics, or per-key show/clk counts (ins_weight plumbs through the
+train step).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.data.slot_record import SlotRecord
+
+DEFAULT_VALID_CMATCH = (222, 223)
+
+
+@dataclass
+class PvInstance:
+    """One page view: the ads served for one search_id (SlotPvInstance)."""
+
+    search_id: int
+    ads: List[SlotRecord] = field(default_factory=list)
+
+    def merge_instance(self, rec: SlotRecord) -> None:
+        self.ads.append(rec)
+
+
+def merge_pv_instances(
+    records: Sequence[SlotRecord], sort: bool = True
+) -> List[PvInstance]:
+    """Group records into pv instances by search_id (PreprocessInstance).
+
+    ``sort=True`` mirrors the reference's stable sort by search_id so a
+    query's ads land together even after a global shuffle.
+    """
+    if sort:
+        records = sorted(records, key=lambda r: r.search_id)
+    pvs: List[PvInstance] = []
+    for rec in records:
+        if pvs and pvs[-1].search_id == rec.search_id:
+            pvs[-1].merge_instance(rec)
+        else:
+            pvs.append(PvInstance(search_id=rec.search_id, ads=[rec]))
+    return pvs
+
+
+def flatten_pv_instances(pvs: Sequence[PvInstance]) -> List[SlotRecord]:
+    """Back to the flat record list (PostprocessInstance parity)."""
+    out: List[SlotRecord] = []
+    for pv in pvs:
+        out.extend(pv.ads)
+    return out
+
+
+def _ad_rank(rec: SlotRecord, max_rank: int, valid_cmatch) -> int:
+    if rec.cmatch in valid_cmatch and 1 <= rec.rank <= max_rank:
+        return rec.rank
+    return -1
+
+
+def build_rank_offset(
+    pvs: Sequence[PvInstance],
+    ins_number: int,
+    max_rank: int = 3,
+    valid_cmatch: Sequence[int] = DEFAULT_VALID_CMATCH,
+) -> np.ndarray:
+    """[ins_number, 2*max_rank+1] int32 matrix (GetRankOffset parity).
+
+    Ads are assumed laid out pv-contiguously in the batch, pvs in order;
+    rows past the pvs' total ad count stay all -1 (ghost padding).
+    """
+    col = 2 * max_rank + 1
+    mat = np.full((ins_number, col), -1, dtype=np.int32)
+    index = 0
+    for pv in pvs:
+        start = index
+        ranks = [_ad_rank(ad, max_rank, valid_cmatch) for ad in pv.ads]
+        for j, rank in enumerate(ranks):
+            mat[index, 0] = rank
+            if rank > 0:
+                for k, fast_rank in enumerate(ranks):
+                    if fast_rank > 0:
+                        m = fast_rank - 1
+                        mat[index, 2 * m + 1] = fast_rank
+                        mat[index, 2 * m + 2] = start + k
+            index += 1
+    return mat
+
+
+def pack_pv_batches(
+    pvs: Sequence[PvInstance],
+    batch_size: int,
+    max_rank: int = 3,
+    valid_cmatch: Sequence[int] = DEFAULT_VALID_CMATCH,
+    drop_remainder: bool = False,
+) -> Iterator[Tuple[List[SlotRecord], np.ndarray, np.ndarray]]:
+    """Yield (records, rank_offset, ins_weight) join-phase batches.
+
+    Whole pvs pack greedily into ``batch_size`` instance slots; the tail pads
+    with weight-0 ghost copies of the last real ad so every batch has the
+    same static shape. A pv with more ads than ``batch_size`` is rejected.
+    """
+    cur: List[PvInstance] = []
+    cur_ins = 0
+
+    def emit(group: List[PvInstance]):
+        records = flatten_pv_instances(group)
+        n_real = len(records)
+        weight = np.zeros(batch_size, dtype=np.float32)
+        weight[:n_real] = 1.0
+        while len(records) < batch_size:  # ghost-pad
+            records.append(records[-1])
+        ro = build_rank_offset(group, batch_size, max_rank, valid_cmatch)
+        return records, ro, weight
+
+    for pv in pvs:
+        n = len(pv.ads)
+        if n > batch_size:
+            raise ValueError(
+                f"pv with {n} ads exceeds join batch size {batch_size}"
+            )
+        if cur_ins + n > batch_size:
+            yield emit(cur)
+            cur, cur_ins = [], 0
+        cur.append(pv)
+        cur_ins += n
+    if cur and not drop_remainder:
+        yield emit(cur)

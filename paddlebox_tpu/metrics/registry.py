@@ -119,6 +119,9 @@ class MetricMsg:
         mask = self.sample_mask(outputs)
         if mask is None:
             mask = jnp.ones(preds.shape, jnp.int32)
+        if "ins_weight" in outputs:
+            # ghost-padded instances (pv join batches) never count
+            mask = mask * (_var(outputs, "ins_weight", self.name) > 0).astype(jnp.int32)
         with self._state_lock:
             self.state = _masked_update(self.state, preds, labels, mask)
         return True

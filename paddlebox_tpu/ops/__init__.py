@@ -1,9 +1,26 @@
+from paddlebox_tpu.ops.ctr_ops import batch_fc, fused_concat, rank_attention
 from paddlebox_tpu.ops.pull_push import pull_sparse_rows, push_sparse_rows
-from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm, cvm_transform
+from paddlebox_tpu.ops.seqpool_cvm import (
+    cvm_transform,
+    cvm_with_conv_transform,
+    cvm_with_pcoc_transform,
+    fused_seqpool_cvm,
+    fused_seqpool_cvm_with_conv,
+    fused_seqpool_cvm_with_diff_thres,
+    fused_seqpool_cvm_with_pcoc,
+)
 
 __all__ = [
     "pull_sparse_rows",
     "push_sparse_rows",
     "fused_seqpool_cvm",
+    "fused_seqpool_cvm_with_conv",
+    "fused_seqpool_cvm_with_diff_thres",
+    "fused_seqpool_cvm_with_pcoc",
     "cvm_transform",
+    "cvm_with_conv_transform",
+    "cvm_with_pcoc_transform",
+    "rank_attention",
+    "batch_fc",
+    "fused_concat",
 ]

@@ -1,0 +1,85 @@
+"""CTR-specific dense ops: rank_attention, batch_fc, fused_concat.
+
+These are the remaining B13 ops (SURVEY.md): position-aware attention over
+pv-merged ad lists and per-"channel" batched FC. The reference hand-writes
+CUDA forward+backward for each (operators/rank_attention_op.cu + .cu.h,
+batch_fc_op.cu, fused/fused_concat_op.cu); here each forward is a
+gather + einsum that XLA fuses and batches onto the MXU, and autodiff
+produces the (gather/scatter-transposed) backward.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rank_attention(
+    x: jnp.ndarray,  # [B, F] per-ad input features
+    rank_offset: jnp.ndarray,  # int32 [B, 2*max_rank+1]
+    rank_param: jnp.ndarray,  # [max_rank*max_rank*F, C] position-pair blocks
+    max_rank: int = 3,
+) -> jnp.ndarray:
+    """Position-pair attention over pv-grouped ads -> [B, C].
+
+    Semantics (rank_attention.cu.h:27-112 expand kernels; python wrapper
+    contrib/layers/nn.py:1337):
+
+    - ``rank_offset[i, 0]``        = 1-based rank of ad i in its pv (0 = no pv)
+    - ``rank_offset[i, 2k+1]``     = 1-based rank of the k-th peer ad (0 = absent)
+    - ``rank_offset[i, 2k+2]``     = row of that peer in ``x``
+    - ``rank_param`` reshaped [max_rank(own), max_rank(peer), F, C]: a weight
+      block per (own-rank, peer-rank) pair.
+
+        out[i] = Σ_k  x[peer_k(i)] @ rank_param[own(i), peer_rank_k(i)]
+
+    Absent peers and rankless instances contribute zero, exactly like the
+    reference's zero-filled input_help/param_help expansion.
+    """
+    B, F = x.shape
+    C = rank_param.shape[-1]
+    param = rank_param.reshape(max_rank, max_rank, F, C)
+
+    own = rank_offset[:, 0] - 1  # [B] -1 = invalid
+    peer_rank = rank_offset[:, 1::2] - 1  # [B, R]
+    peer_idx = rank_offset[:, 2::2]  # [B, R]
+    valid = (own[:, None] >= 0) & (peer_rank >= 0)  # [B, R]
+
+    x_exp = x[jnp.clip(peer_idx, 0, B - 1)]  # [B, R, F]
+    x_exp = jnp.where(valid[..., None], x_exp, 0.0)
+    blocks = param[jnp.clip(own, 0, max_rank - 1)[:, None],
+                   jnp.clip(peer_rank, 0, max_rank - 1)]  # [B, R, F, C]
+    blocks = jnp.where(valid[..., None, None], blocks, 0.0)
+    return jnp.einsum("brf,brfc->bc", x_exp, blocks)
+
+
+def batch_fc(
+    x: jnp.ndarray,  # [B, batchcount * in_feat]
+    w: jnp.ndarray,  # [in_feat, batchcount * out_feat]
+    bias: jnp.ndarray,  # [batchcount * out_feat]
+    batchcount: int,
+) -> jnp.ndarray:
+    """Per-channel FC -> [B, batchcount * out_feat].
+
+    Channel k maps x[:, k*in : (k+1)*in] through w[:, k*out : (k+1)*out]
+    plus bias — the reference's strided BatchedGEMM + row-add
+    (batch_fc_op.cu:121-188). One einsum keeps all channels in a single
+    MXU-batched matmul.
+    """
+    B = x.shape[0]
+    in_feat = x.shape[1] // batchcount
+    out_feat = w.shape[1] // batchcount
+    xb = x.reshape(B, batchcount, in_feat)
+    wb = w.reshape(in_feat, batchcount, out_feat)
+    out = jnp.einsum("bki,iko->bko", xb, wb)
+    return (out + bias.reshape(1, batchcount, out_feat)).reshape(B, -1)
+
+
+def fused_concat(
+    xs,  # sequence of [B, D] tensors (equal D)
+    offset: int,
+    length: int,
+) -> jnp.ndarray:
+    """Concat columns [offset, offset+length) of every input -> [B, n*length]
+    (fused_concat_op.cu:207-260). The typical use slices the embedx block out
+    of several pulled slot tensors in one op."""
+    return jnp.concatenate([x[:, offset : offset + length] for x in xs], axis=1)

@@ -92,6 +92,12 @@ def make_sharded_train_step(
         dense = batch.get("dense")
         if dense is not None:
             dense = dense[0]
+        ins_weight = batch.get("ins_weight")
+        if ins_weight is not None:
+            ins_weight = ins_weight[0]
+        rank_offset = batch.get("rank_offset")
+        if rank_offset is not None:
+            rank_offset = rank_offset[0]
         n, K = req_ranks.shape
 
         pulled = sharded_pull(
@@ -99,8 +105,22 @@ def make_sharded_train_step(
         )  # [n*K, PW]
         flat = jnp.take(pulled, inverse, axis=0)  # [L, PW]
 
+        # weighted (pv/ghost) batches normalize by the GLOBAL weight sum, so
+        # a device with more ghosts doesn't over-weight its real samples;
+        # its local grads are then already global-mean scale (grad_div=1)
+        # and the dense reduction is a psum of partial sums, not a pmean.
+        if ins_weight is not None:
+            loss_denom = jnp.maximum(
+                jax.lax.psum(jnp.sum(ins_weight), ax), 1.0
+            )
+            grad_div = 1.0
+        else:
+            loss_denom = None
+            grad_div = float(plan.n_devices)
         loss, preds, gparams, gflat = local_forward_backward(
-            model_apply, cfg, state.params, flat, segments, labels, dense
+            model_apply, cfg, state.params, flat, segments, labels, dense,
+            ins_weight=ins_weight, rank_offset=rank_offset,
+            loss_denom=loss_denom,
         )
         # grad_div rescales local-mean grads to GLOBAL-batch-mean so the
         # owner-side merge matches single-device semantics exactly and the
@@ -112,20 +132,26 @@ def make_sharded_train_step(
             inverse,
             labels,
             num_segments=n * K,
-            grad_div=plan.n_devices,
+            grad_div=grad_div,
+            ins_weight=ins_weight,
         )
 
         new_table = sharded_push(
             table, req_ranks, gbucket, show_bucket, clk_bucket, lay, opt, ax
         )
 
-        gparams = jax.lax.pmean(gparams, ax)
-        loss = jax.lax.pmean(loss, ax)
+        if ins_weight is not None:
+            gparams = jax.lax.psum(gparams, ax)
+            loss = jax.lax.psum(loss, ax)
+        else:
+            gparams = jax.lax.pmean(gparams, ax)
+            loss = jax.lax.pmean(loss, ax)
         updates, new_opt_state = dense_opt.update(gparams, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
         local_auc = AucState(pos=state.auc.pos[0], neg=state.auc.neg[0])
-        new_auc = auc_update(local_auc, preds, labels)
+        auc_mask = None if ins_weight is None else (ins_weight > 0)
+        new_auc = auc_update(local_auc, preds, labels, auc_mask)
         new_auc = AucState(pos=new_auc.pos[None], neg=new_auc.neg[None])
 
         metrics = {

@@ -50,6 +50,9 @@ class TrainStepConfig:
     auc_buckets: int = 100_000
     axis_name: Optional[str] = None  # set on a mesh; None = single device
     slot_lr: Optional[tuple] = None  # per-slot lr multipliers, len num_slots
+    # join-phase models taking the pv rank matrix get it as a 4th arg:
+    # model_apply(params, slot_feats, dense, rank_offset)
+    model_takes_rank_offset: bool = False
 
 
 def init_train_state(
@@ -75,11 +78,18 @@ def local_forward_backward(
     segments: jnp.ndarray,  # [L]
     labels: jnp.ndarray,  # [b]
     dense: Optional[jnp.ndarray],
+    ins_weight: Optional[jnp.ndarray] = None,  # [b] 0 masks ghost-padded ins
+    rank_offset: Optional[jnp.ndarray] = None,  # [b, 2R+1] join-phase pv matrix
+    loss_denom: Optional[jnp.ndarray] = None,  # weighted-loss denominator
 ):
     """Shared fwd/bwd body: seqpool+CVM -> model -> BCE, grads wrt (params, flat).
 
     Used by both the single-device and the mesh-sharded step so the numerics
-    can never diverge between them.
+    can never diverge between them. With ``ins_weight`` the loss is the
+    weighted mean, so weight-0 ghosts (pv batch padding) produce exactly zero
+    gradient everywhere. ``loss_denom`` overrides the weight-sum denominator —
+    the mesh step passes the GLOBAL (psum'd) weight sum so per-device ghost
+    imbalance cannot skew sample weighting.
     """
 
     def loss_fn(p, flat_records):
@@ -91,9 +101,21 @@ def local_forward_backward(
             use_cvm=cfg.use_cvm,
             clk_filter=cfg.clk_filter,
         )
-        logits = model_apply(p, slot_feats, dense)
+        if cfg.model_takes_rank_offset:
+            logits = model_apply(p, slot_feats, dense, rank_offset)
+        else:
+            logits = model_apply(p, slot_feats, dense)
         loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
-        return jnp.mean(loss_vec), jax.nn.sigmoid(logits)
+        if ins_weight is not None:
+            denom = (
+                loss_denom
+                if loss_denom is not None
+                else jnp.maximum(jnp.sum(ins_weight), 1.0)
+            )
+            loss = jnp.sum(loss_vec * ins_weight) / denom
+        else:
+            loss = jnp.mean(loss_vec)
+        return loss, jax.nn.sigmoid(logits)
 
     (loss, preds), (gparams, gflat) = jax.value_and_grad(
         loss_fn, argnums=(0, 1), has_aux=True
@@ -109,6 +131,7 @@ def scale_and_merge_grads(
     labels: jnp.ndarray,  # [b]
     num_segments: int,
     grad_div: float = 1.0,
+    ins_weight: Optional[jnp.ndarray] = None,  # [b] ghosts -> 0 show/clk
 ):
     """Shared push-side merge: slot-lr scale, pad mask, per-position sums.
 
@@ -122,10 +145,14 @@ def scale_and_merge_grads(
         slot_of_key = jnp.minimum(segments // b, S - 1)
         lr_tab = jnp.asarray(cfg.slot_lr, jnp.float32)
         gflat = gflat * lr_tab[slot_of_key][:, None]
-    valid = (segments < S * b).astype(jnp.float32)  # [L] pad mask
-    gflat = gflat * valid[:, None]
-    merged = jax.ops.segment_sum(gflat, inverse, num_segments=num_segments)
+    pad_mask = (segments < S * b).astype(jnp.float32)  # [L] 0 on pad keys
     ins_of_key = segments % b
+    # valid = pad mask x instance weight: ghosts add no show/clk
+    valid = (
+        pad_mask if ins_weight is None else pad_mask * jnp.take(ins_weight, ins_of_key)
+    )
+    gflat = gflat * pad_mask[:, None]
+    merged = jax.ops.segment_sum(gflat, inverse, num_segments=num_segments)
     show = jax.ops.segment_sum(valid, inverse, num_segments=num_segments)
     clk = jax.ops.segment_sum(
         jnp.take(labels, ins_of_key) * valid, inverse, num_segments=num_segments
@@ -152,6 +179,8 @@ def make_train_step(
         segments = batch["segments"]
         labels = batch["labels"]
         dense = batch.get("dense")
+        ins_weight = batch.get("ins_weight")
+        rank_offset = batch.get("rank_offset")
         U = uniq_rows.shape[0]
 
         pulled_u = pull_sparse_rows(
@@ -160,13 +189,15 @@ def make_train_step(
         flat = jnp.take(pulled_u, inverse, axis=0)  # [L, PW]
 
         loss, preds, gparams, gflat = local_forward_backward(
-            model_apply, cfg, state.params, flat, segments, labels, dense
+            model_apply, cfg, state.params, flat, segments, labels, dense,
+            ins_weight=ins_weight, rank_offset=rank_offset,
         )
         # --- sparse push: per-slot lr scaling happens at flat resolution
         # (a key deduped across slots gets each slot's scaled contribution),
         # then grads merge per unique row — PushMergeCopy parity.
         guniq, show_counts, clk_counts = scale_and_merge_grads(
-            cfg, gflat, segments, inverse, labels, num_segments=U
+            cfg, gflat, segments, inverse, labels, num_segments=U,
+            ins_weight=ins_weight,
         )
 
         new_table = push_sparse_rows(
@@ -180,7 +211,8 @@ def make_train_step(
         updates, new_opt_state = dense_opt.update(gparams, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
-        new_auc = auc_update(state.auc, preds, labels)
+        auc_mask = None if ins_weight is None else (ins_weight > 0)
+        new_auc = auc_update(state.auc, preds, labels, auc_mask)
         # preds/labels ride along for the host-side metric registry
         # (AddAucMonitor parity) — small [B] arrays, no sync forced
         metrics = {

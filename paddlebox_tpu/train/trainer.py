@@ -162,8 +162,25 @@ class CTRTrainer:
         self._schema = dataset.schema
         state = self._make_state(dataset.device_table)
         losses = []
-        for i, batch in enumerate(dataset.batches(n_batches)):
+        # join phase serves pv-merged batches with rank_offset + ghost
+        # weights; update phase serves flat batches (EnablePvMerge branch,
+        # data_feed.cc:2165-2198)
+        use_pv = getattr(dataset, "_pv_merged", False) and dataset.current_phase == 1
+        if use_pv:
+            if self.plan is not None:
+                raise NotImplementedError(
+                    "join-phase pv batches are single-device for now; shard "
+                    "the update phase or run join on one chip"
+                )
+            iterator = dataset.pv_batches(n_batches)
+        else:
+            iterator = ((b, None) for b in dataset.batches(n_batches))
+        for i, (batch, ins_weight) in enumerate(iterator):
             feed = self._pack_and_put(batch, dataset.ws)
+            if ins_weight is not None:
+                feed["ins_weight"] = jnp.asarray(ins_weight)
+            if batch.rank_offset is not None:
+                feed["rank_offset"] = jnp.asarray(batch.rank_offset)
             state, m = self._step(state, feed)
             if self.metric_registry is not None:
                 # per-batch registry feed with phase + logkey-derived vars
@@ -173,6 +190,8 @@ class CTRTrainer:
                     outputs["cmatch"] = batch.cmatch
                 if batch.rank is not None:
                     outputs["rank"] = batch.rank
+                if ins_weight is not None:
+                    outputs["ins_weight"] = ins_weight
                 self.metric_registry.add_all(outputs, phase=dataset.current_phase)
             if on_batch is not None:
                 on_batch(i, m)

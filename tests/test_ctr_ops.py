@@ -1,0 +1,191 @@
+"""CTR op family tests against loop-reference implementations
+(reference: rank_attention.cu.h expand kernels, batch_fc_op.cu strided GEMM,
+fused_concat_op.cu, fused_seqpool_cvm_* variant kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.ops import (
+    batch_fc,
+    cvm_with_conv_transform,
+    cvm_with_pcoc_transform,
+    fused_concat,
+    fused_seqpool_cvm_with_conv,
+    fused_seqpool_cvm_with_diff_thres,
+    fused_seqpool_cvm_with_pcoc,
+    rank_attention,
+)
+
+
+def _rank_attention_ref(x, rank_offset, rank_param, max_rank):
+    """Direct loop transcription of expand_input/expand_param + matmul."""
+    B, F = x.shape
+    C = rank_param.shape[-1]
+    param = rank_param.reshape(max_rank, max_rank, F, C)
+    out = np.zeros((B, C), np.float32)
+    for i in range(B):
+        own = rank_offset[i, 0] - 1
+        if own < 0:
+            continue
+        for k in range(max_rank):
+            pr = rank_offset[i, 2 * k + 1] - 1
+            idx = rank_offset[i, 2 * k + 2]
+            if pr < 0:
+                continue
+            out[i] += x[idx] @ param[own, pr]
+    return out
+
+
+def test_rank_attention_matches_reference_loop():
+    rng = np.random.default_rng(0)
+    B, F, C, R = 6, 4, 5, 3
+    x = rng.normal(size=(B, F)).astype(np.float32)
+    # pv structure: ins 0-2 in one pv (ranks 1,2,3), ins 3-4 in one pv, ins 5 rankless
+    rank_offset = np.zeros((B, 2 * R + 1), np.int32)
+    pv1, pv2 = [0, 1, 2], [3, 4]
+    for pv in (pv1, pv2):
+        for a, i in enumerate(pv):
+            rank_offset[i, 0] = a + 1
+            for k, j in enumerate(pv):
+                rank_offset[i, 2 * k + 1] = k + 1
+                rank_offset[i, 2 * k + 2] = j
+    param = rng.normal(size=(R * R * F, C)).astype(np.float32)
+
+    got = np.asarray(rank_attention(jnp.asarray(x), jnp.asarray(rank_offset), jnp.asarray(param), R))
+    want = _rank_attention_ref(x, rank_offset, param, R)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert np.all(got[5] == 0)  # rankless instance -> zeros
+
+
+def test_rank_attention_grad_flows_only_to_used_blocks():
+    B, F, C, R = 2, 3, 2, 2
+    x = jnp.ones((B, F))
+    rank_offset = np.zeros((B, 2 * R + 1), np.int32)
+    rank_offset[0] = [1, 1, 0, 2, 1]  # own rank 1; peers rank1->ins0, rank2->ins1
+    rank_offset[1] = [2, 1, 0, 2, 1]
+    param = jnp.zeros((R * R * F, C))
+
+    def loss(p):
+        return jnp.sum(rank_attention(x, jnp.asarray(rank_offset), p, R))
+
+    g = np.asarray(jax.grad(loss)(param)).reshape(R, R, F, C)
+    # own=0 row used by ins0 (peers 0 and 1), own=1 row used by ins1
+    assert np.abs(g[0]).sum() > 0 and np.abs(g[1]).sum() > 0
+
+
+def test_batch_fc_matches_per_channel_loop():
+    rng = np.random.default_rng(1)
+    B, cnt, fin, fout = 5, 3, 4, 2
+    x = rng.normal(size=(B, cnt * fin)).astype(np.float32)
+    w = rng.normal(size=(fin, cnt * fout)).astype(np.float32)
+    b = rng.normal(size=(cnt * fout,)).astype(np.float32)
+    got = np.asarray(batch_fc(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), cnt))
+    for k in range(cnt):
+        want = x[:, k * fin : (k + 1) * fin] @ w[:, k * fout : (k + 1) * fout] + b[
+            k * fout : (k + 1) * fout
+        ]
+        np.testing.assert_allclose(got[:, k * fout : (k + 1) * fout], want, rtol=1e-5)
+
+
+def test_fused_concat():
+    xs = [jnp.arange(12.0).reshape(3, 4), 100 + jnp.arange(12.0).reshape(3, 4)]
+    out = np.asarray(fused_concat(xs, offset=1, length=2))
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out[0], [1, 2, 101, 102])
+
+
+def _pool_ref(vals, segments, num_slots, B):
+    width = vals.shape[1]
+    pooled = np.zeros((num_slots * B, width), np.float32)
+    for v, s in zip(vals, segments):
+        if s < num_slots * B:
+            pooled[s] += v
+    return pooled.reshape(num_slots, B, width)
+
+
+def test_seqpool_with_conv_formula():
+    rng = np.random.default_rng(2)
+    S, B, D = 2, 3, 2
+    width = 3 + D  # show, clk, conv, embedx
+    L = 10
+    vals = np.abs(rng.normal(size=(L, width))).astype(np.float32)
+    segments = rng.integers(0, S * B, L).astype(np.int32)
+    got = np.asarray(
+        fused_seqpool_cvm_with_conv(jnp.asarray(vals), jnp.asarray(segments), S, B)
+    )
+    pooled = _pool_ref(vals, segments, S, B)
+    want0 = np.log(pooled[..., 0] + 1)
+    want1 = np.log(pooled[..., 1] + 1)
+    want2 = np.log(pooled[..., 2] + 1) - np.log(pooled[..., 1] + 1)
+    got_sb = np.transpose(got, (1, 0, 2))
+    np.testing.assert_allclose(got_sb[..., 0], want0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_sb[..., 1], want1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_sb[..., 2], want2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_sb[..., 3:], pooled[..., 3:], rtol=1e-5, atol=1e-6)
+    # show_filter drops the show column
+    got_f = np.asarray(
+        fused_seqpool_cvm_with_conv(
+            jnp.asarray(vals), jnp.asarray(segments), S, B, show_filter=True
+        )
+    )
+    assert got_f.shape[-1] == width - 1
+    np.testing.assert_allclose(np.transpose(got_f, (1, 0, 2))[..., 0], want1, rtol=1e-5, atol=1e-6)
+    # no-cvm strips the 3-col cvm block
+    got_nc = np.asarray(
+        fused_seqpool_cvm_with_conv(
+            jnp.asarray(vals), jnp.asarray(segments), S, B, use_cvm=False
+        )
+    )
+    assert got_nc.shape[-1] == D
+
+
+def test_seqpool_with_pcoc_formula():
+    rng = np.random.default_rng(3)
+    S, B, D, P = 1, 2, 2, 3
+    width = 4 + P + D
+    L = 6
+    vals = np.abs(rng.normal(size=(L, width))).astype(np.float32)
+    segments = rng.integers(0, S * B, L).astype(np.int32)
+    got = np.asarray(
+        fused_seqpool_cvm_with_pcoc(jnp.asarray(vals), jnp.asarray(segments), S, B, pclk_num=P)
+    )
+    pooled = _pool_ref(vals, segments, S, B)
+    ls = np.log(pooled[..., 0] + 1)
+    lc = np.log(pooled[..., 1] + 1)
+    ljs = np.log(pooled[..., 2] + 1)
+    ljc = np.log(pooled[..., 3] + 1)
+    lp = np.log(pooled[..., 4 : 4 + P] + 1)
+    got_sb = np.transpose(got, (1, 0, 2))
+    assert got_sb.shape[-1] == 2 + 2 * P + D
+    np.testing.assert_allclose(got_sb[..., 0], ls, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_sb[..., 1], lc - ls, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_sb[..., 2 : 2 + P], lp - ljs[..., None], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        got_sb[..., 2 + P : 2 + 2 * P], lp - ljc[..., None], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_seqpool_diff_thres_per_slot_filter():
+    S, B = 2, 1
+    width = 3
+    # slot 0 key passes its threshold, slot 1 key fails its higher one
+    vals = np.array([[1.0, 1.0, 5.0], [1.0, 1.0, 7.0]], np.float32)
+    segments = np.array([0, 1], np.int32)  # slot0/ins0, slot1/ins0
+    thr = np.array([0.5, 99.0], np.float32)
+    got = np.asarray(
+        fused_seqpool_cvm_with_diff_thres(
+            jnp.asarray(vals), jnp.asarray(segments), S, B,
+            threshold_vec=thr, show_coeff=0.2, clk_coeff=1.0,
+        )
+    )  # [B, S, width]
+    assert got[0, 0, 2] == 5.0  # kept
+    assert got[0, 1, 2] == 0.0  # filtered by slot-1 threshold
+
+
+def test_conv_pcoc_transforms_shapes():
+    x = jnp.abs(jnp.ones((2, 2, 7)))
+    assert cvm_with_conv_transform(x).shape == (2, 2, 7)
+    assert cvm_with_conv_transform(x, show_filter=True).shape == (2, 2, 6)
+    y = jnp.ones((2, 2, 4 + 3 + 2))
+    assert cvm_with_pcoc_transform(y, pclk_num=3).shape == (2, 2, 2 + 6 + 2)

@@ -1,0 +1,221 @@
+"""pv-merge + rank_offset + two-phase join/update tests.
+
+Mirrors the reference sequence (test_paddlebox_datafeed.py:103-119):
+set_current_phase(1) -> preprocess_instance -> train -> set_current_phase(0)
+-> postprocess_instance -> train -> end_pass; rank_offset semantics from
+GetRankOffset (data_feed.cc:2531-2580)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.data import (
+    BoxPSDataset,
+    SlotInfo,
+    SlotSchema,
+    build_rank_offset,
+    merge_pv_instances,
+    pack_pv_batches,
+)
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.ops import rank_attention
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train.train_step import TrainStepConfig
+from paddlebox_tpu.train.trainer import CTRTrainer
+
+
+def _rec(search_id, cmatch, rank, keys, label):
+    keys = np.asarray(keys, np.uint64)
+    return SlotRecord(
+        u64_values=keys,
+        u64_offsets=np.arange(len(keys) + 1, dtype=np.uint32),
+        f_values=np.array([label], np.float32),
+        f_offsets=np.array([0, 1], np.uint32),
+        ins_id=f"ins_{search_id}_{rank}",
+        search_id=search_id,
+        cmatch=cmatch,
+        rank=rank,
+    )
+
+
+def test_merge_and_flatten_roundtrip():
+    recs = [
+        _rec(7, 222, 1, [1, 2], 1.0),
+        _rec(3, 222, 1, [3, 4], 0.0),
+        _rec(7, 222, 2, [5, 6], 0.0),
+        _rec(3, 223, 2, [7, 8], 1.0),
+    ]
+    pvs = merge_pv_instances(recs)
+    assert [pv.search_id for pv in pvs] == [3, 7]
+    assert [len(pv.ads) for pv in pvs] == [2, 2]
+
+
+def test_rank_offset_matrix_reference_semantics():
+    # pv of 3 ads ranks 1,2,3 + one invalid-cmatch ad
+    recs = [
+        _rec(1, 222, 1, [1], 0),
+        _rec(1, 223, 2, [2], 0),
+        _rec(1, 222, 3, [3], 0),
+        _rec(1, 999, 1, [4], 0),  # cmatch not in {222,223} -> rank -1
+    ]
+    pvs = merge_pv_instances(recs, sort=False)
+    ro = build_rank_offset(pvs, ins_number=5, max_rank=3)
+    assert ro.shape == (5, 7)
+    assert ro[0, 0] == 1 and ro[1, 0] == 2 and ro[2, 0] == 3
+    assert ro[3, 0] == -1  # invalid cmatch
+    assert ro[4, 0] == -1  # ghost row
+    # peer columns bucket by peer rank: col 2m+1 = rank m+1, col 2m+2 = row
+    for i in range(3):
+        assert list(ro[i, 1::2]) == [1, 2, 3]
+        assert list(ro[i, 2::2]) == [0, 1, 2]
+    # invalid ad doesn't fill peer columns
+    assert list(ro[3, 1:]) == [-1] * 6
+
+
+def test_pack_pv_batches_whole_pv_and_ghosts():
+    recs = [
+        _rec(1, 222, 1, [1], 1),
+        _rec(1, 222, 2, [2], 0),
+        _rec(2, 222, 1, [3], 0),
+        _rec(3, 222, 1, [4], 1),
+        _rec(3, 222, 2, [5], 0),
+    ]
+    pvs = merge_pv_instances(recs)
+    batches = list(pack_pv_batches(pvs, batch_size=4))
+    assert len(batches) == 2
+    recs0, ro0, w0 = batches[0]
+    assert len(recs0) == 4
+    # first batch holds pv1 (2 ads) + pv2 (1 ad) + 1 ghost
+    assert list(w0) == [1, 1, 1, 0]
+    assert ro0[3, 0] == -1  # ghost row rankless
+    recs1, ro1, w1 = batches[1]
+    assert list(w1) == [1, 1, 0, 0]
+    # oversize pv rejected
+    big = merge_pv_instances([_rec(9, 222, r + 1, [r + 10], 0) for r in range(5)])
+    with pytest.raises(ValueError):
+        list(pack_pv_batches(big, batch_size=4))
+
+
+class RankDeepFM:
+    """DeepFM + rank_attention tower over the pv rank matrix."""
+
+    def __init__(self, num_slots, feat_width, embedx_dim, max_rank=3, hidden=(16,)):
+        self.base = DeepFM(num_slots, feat_width, embedx_dim, hidden=hidden)
+        self.max_rank = max_rank
+        self.in_dim = num_slots * feat_width
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "base": self.base.init(k1),
+            "rank_param": 0.01
+            * jax.random.normal(k2, (self.max_rank * self.max_rank * self.in_dim, 1)),
+        }
+
+    def apply(self, params, slot_feats, dense=None, rank_offset=None):
+        logit = self.base.apply(params["base"], slot_feats, dense)
+        if rank_offset is not None:
+            x = slot_feats.reshape(slot_feats.shape[0], -1)
+            att = rank_attention(x, rank_offset, params["rank_param"], self.max_rank)
+            logit = logit + att[:, 0]
+        return logit
+
+
+def _logkey(search_id, cmatch, rank):
+    return "0" * 11 + format(cmatch, "03x") + format(rank, "02x") + format(search_id, "016x")
+
+
+def _write_pv_file(path, rng, n_queries=60, n_slots=3):
+    lines = []
+    for q in range(1, n_queries + 1):
+        n_ads = int(rng.integers(1, 4))
+        for r in range(1, n_ads + 1):
+            keys = rng.integers(1, 200, n_slots)
+            label = 1.0 if (keys % 5 == 0).any() else 0.0
+            parts = [f"1 {_logkey(q, 222, r)}", f"1 {label}"] + [f"1 {k}" for k in keys]
+            lines.append(" ".join(parts))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def test_two_phase_join_update_end_to_end(tmp_path):
+    """The full reference sequence on a tiny pv dataset."""
+    rng = np.random.default_rng(0)
+    n_slots = 3
+    path = str(tmp_path / "pv.txt")
+    _write_pv_file(path, rng, n_queries=60, n_slots=n_slots)
+
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(n_slots)],
+        label_slot="label",
+        parse_logkey=True,
+    )
+    layout = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(layout, SparseOptimizerConfig(embedx_threshold=0.0))
+    ds = BoxPSDataset(schema, table, batch_size=16)
+    ds.set_date("20260729")
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64)
+
+    model = RankDeepFM(n_slots, layout.pull_width, layout.embedx_dim)
+    cfg_join = TrainStepConfig(
+        num_slots=n_slots, batch_size=16, layout=layout,
+        sparse_opt=SparseOptimizerConfig(embedx_threshold=0.0),
+        auc_buckets=1000, model_takes_rank_offset=True,
+    )
+    trainer = CTRTrainer(model, cfg_join, dense_opt=optax.adam(1e-2))
+
+    # ---- join phase: pv-merged batches with rank_offset
+    ds.set_current_phase(1)
+    n_pvs = ds.preprocess_instance()
+    assert n_pvs == 60
+    m_join = trainer.train_pass(ds)
+    assert np.isfinite(m_join["loss"])
+    assert m_join["batches"] > 0
+    # ghosts masked: counted instances == real records
+    assert m_join["ins_num"] == ds.memory_data_size()
+
+    # ---- update phase: flat batches, same trained table carries on
+    ds.set_current_phase(0)
+    ds.postprocess_instance()
+    cfg_upd = TrainStepConfig(
+        num_slots=n_slots, batch_size=16, layout=layout,
+        sparse_opt=SparseOptimizerConfig(embedx_threshold=0.0), auc_buckets=1000,
+    )
+    trainer2 = CTRTrainer(model, cfg_upd, dense_opt=optax.adam(1e-2))
+    trainer2.params = trainer.params  # dense params carry across phases
+    trainer2.opt_state = None
+    trainer2.init_params = lambda rng=None: None  # keep carried params
+    trainer2.opt_state = optax.adam(1e-2).init(trainer.params)
+    m_upd = trainer2.train_pass(ds)
+    assert np.isfinite(m_upd["loss"])
+
+    out = ds.end_pass(trainer2.trained_table())
+    assert out["dropped"] >= 0
+
+
+def test_rank_attention_changes_join_logits(tmp_path):
+    """rank_offset actually reaches the model in the join step."""
+    n_slots = 2
+    layout = ValueLayout(embedx_dim=4)
+    model = RankDeepFM(n_slots, layout.pull_width, layout.embedx_dim)
+    params = model.init(jax.random.PRNGKey(0))
+    params["rank_param"] = params["rank_param"] + 1.0  # make attention visible
+    B = 4
+    feats = jnp.ones((B, n_slots, layout.pull_width))
+    ro = np.full((B, 7), -1, np.int32)
+    ro[0] = [1, 1, 0, 2, 1, -1, -1]
+    ro[1] = [2, 1, 0, 2, 1, -1, -1]
+    with_ro = model.apply(params, feats, None, jnp.asarray(ro))
+    without = model.apply(params, feats, None, None)
+    assert abs(float(with_ro[0] - without[0])) > 1e-3
+    assert abs(float(with_ro[3] - without[3])) < 1e-6  # rankless row unchanged
