@@ -86,3 +86,4 @@ define_flag("enable_dense_nccl_barrier", False, "barrier before dense sync (refe
 
 # --- metrics ---
 define_flag("auc_num_buckets", 1_000_000, "AUC wuauc bucket table size (reference box_wrapper.h:61)")
+define_flag("auc_runner_pool_size", 10_000, "AucRunner candidate reservoir capacity per pool")

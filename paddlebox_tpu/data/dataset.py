@@ -200,11 +200,22 @@ class BoxPSDataset:
         """Group this pass's records into pv instances for join-phase
         training (PreprocessInstance parity, data_set.cc:1968-2009).
         Returns the pv count. Requires logkey parsing (search_id)."""
+        if not self.schema.parse_logkey:
+            raise RuntimeError(
+                "preprocess_instance needs search_ids: build the SlotSchema "
+                "with parse_logkey=True (else every record has search_id=0 "
+                "and the whole pass merges into one pv)"
+            )
         self.pvs: List[PvInstance] = merge_pv_instances(self.records)
         self._pv_max_rank = max_rank
         self._pv_valid_cmatch = tuple(valid_cmatch)
         self._pv_merged = True
         return len(self.pvs)
+
+    @property
+    def pv_merged(self) -> bool:
+        """True between preprocess_instance and postprocess_instance."""
+        return getattr(self, "_pv_merged", False)
 
     def postprocess_instance(self) -> None:
         """Restore the flat record view for the update phase
@@ -343,6 +354,41 @@ class BoxPSDataset:
         order = rng.permutation(len(mine))
         return [mine[i] for i in order]
 
+    # ---- AucRunner slot-shuffle eval ------------------------------------
+
+    def slots_shuffle(self, slots) -> dict:
+        """Replace ``slots``' feasigns in the in-memory records with pooled
+        candidates for feature-importance eval (BoxPSDataset.slots_shuffle
+        parity, python dataset.py:1191-1210 -> BoxHelper::SlotsShuffle).
+
+        The AucRunner is created lazily over all sparse slots on first use;
+        pass ``slots=[]``/set() to restore the previous shuffle. Shuffled
+        keys must still resolve in the pass working set — candidates come
+        from this pass's own records, so they always do.
+        """
+        from paddlebox_tpu.metrics.auc_runner import AucRunner
+
+        if not self.records:
+            raise RuntimeError("slots_shuffle needs in-memory records")
+        runner = getattr(self, "_auc_runner", None)
+        if runner is None or getattr(self, "_auc_runner_pass", None) != self.pass_id:
+            cap = config.get_flag("auc_runner_pool_size")
+            runner = AucRunner(
+                self.schema,
+                replaced_slots=[s.name for s in self.schema.used_sparse],
+                capacity=cap,
+                seed=self.seed + self.pass_id,
+            )
+            runner.observe(self.records)
+            self._auc_runner = runner
+            self._auc_runner_pass = self.pass_id
+        return runner.slots_shuffle(self.records, set(slots))
+
+    @property
+    def auc_runner_phase(self) -> int:
+        runner = getattr(self, "_auc_runner", None)
+        return runner.phase if runner is not None else 1
+
     # ---- pass lifecycle --------------------------------------------------
 
     def begin_pass(self, round_to: int = 512) -> np.ndarray:
@@ -385,6 +431,7 @@ class BoxPSDataset:
         self.ws = None
         self.device_table = None
         self._in_pass = False
+        self._auc_runner = None  # pools reference this pass's records only
         return {"dropped": dropped, "delta_keys": saved}
 
     # ---- batch serving ---------------------------------------------------

@@ -1,4 +1,5 @@
 from paddlebox_tpu.metrics.auc import AucState, auc_init, auc_update, auc_compute
+from paddlebox_tpu.metrics.auc_runner import AucRunner, CandidatePool
 from paddlebox_tpu.metrics.registry import (
     CmatchRankMaskMetricMsg,
     CmatchRankMetricMsg,
@@ -13,6 +14,8 @@ __all__ = [
     "auc_init",
     "auc_update",
     "auc_compute",
+    "AucRunner",
+    "CandidatePool",
     "MetricMsg",
     "MetricRegistry",
     "MaskMetricMsg",

@@ -54,11 +54,11 @@ def cvm_with_conv_transform(
       out = [log(show+1), log(clk+1), log(conv+1) - log(clk+1), rest]
       show_filter drops the show column (join-with-show-only mode).
     """
+    if not use_cvm:
+        return pooled[..., 3:]
     log_show = jnp.log(pooled[..., 0:1] + 1.0)
     log_clk = jnp.log(pooled[..., 1:2] + 1.0)
     log_conv = jnp.log(pooled[..., 2:3] + 1.0)
-    if not use_cvm:
-        return pooled[..., 3:]
     cols = [log_show, log_clk, log_conv - log_clk, pooled[..., 3:]]
     if show_filter:
         cols = cols[1:]

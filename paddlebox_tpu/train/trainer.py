@@ -165,7 +165,7 @@ class CTRTrainer:
         # join phase serves pv-merged batches with rank_offset + ghost
         # weights; update phase serves flat batches (EnablePvMerge branch,
         # data_feed.cc:2165-2198)
-        use_pv = getattr(dataset, "_pv_merged", False) and dataset.current_phase == 1
+        use_pv = dataset.pv_merged and dataset.current_phase == 1
         if use_pv:
             if self.plan is not None:
                 raise NotImplementedError(

@@ -1,0 +1,176 @@
+"""AucRunner slot-shuffle tests (B15).
+
+Model: the reference exercises AucRunner through BoxHelper::SlotsShuffle on
+in-memory records (box_wrapper.h:961-985); here we check reservoir behavior,
+exact replace/replace-back round-trip, phase flipping, and the end-to-end
+dataset hook.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import SlotInfo, SlotSchema
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.metrics import AucRunner, CandidatePool
+
+NUM_SLOTS = 4
+
+
+def make_schema():
+    return SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NUM_SLOTS)],
+        label_slot="label",
+    )
+
+
+def make_records(rng, n, max_len=3):
+    recs = []
+    for _ in range(n):
+        lens = rng.integers(1, max_len + 1, NUM_SLOTS)
+        off = np.zeros(NUM_SLOTS + 1, dtype=np.uint32)
+        np.cumsum(lens, out=off[1:])
+        recs.append(
+            SlotRecord(
+                u64_values=rng.integers(1, 1000, int(off[-1])).astype(np.uint64),
+                u64_offsets=off,
+                f_values=np.array([1.0], np.float32),
+                f_offsets=np.array([0, 1], np.uint32),
+            )
+        )
+    return recs
+
+
+def snapshot(recs):
+    return [(r.u64_values.copy(), r.u64_offsets.copy()) for r in recs]
+
+
+def test_reservoir_pool_bounds():
+    rng = np.random.default_rng(0)
+    pool = CandidatePool(capacity=10, rng=rng)
+    ids = [pool.add_and_get({0: np.array([i], np.uint64)}) for i in range(500)]
+    assert len(pool) == 10
+    assert all(0 <= i < 10 for i in ids)
+    # reservoir keeps a (statistically) late-biased-free sample: at least one
+    # candidate from the back half of the stream should survive
+    vals = [int(c[0][0]) for c in pool.candidates]
+    assert max(vals) >= 250
+
+
+def test_replace_and_replace_back_roundtrip():
+    rng = np.random.default_rng(1)
+    schema = make_schema()
+    recs = make_records(rng, 40)
+    before = snapshot(recs)
+    runner = AucRunner(schema, replaced_slots=["s1", "s3"], capacity=8, seed=0)
+    runner.observe(recs)
+
+    stats = runner.slots_shuffle(recs, {"s1"})
+    assert stats["deleted"] > 0 and stats["added"] > 0
+    assert runner.phase == 0
+    # untouched slots identical; shuffled slot lengths match the candidates
+    changed = 0
+    for r, (v, o) in zip(recs, before):
+        for s in (0, 2, 3):
+            lo, hi = r.u64_offsets[s], r.u64_offsets[s + 1]
+            np.testing.assert_array_equal(r.u64_values[lo:hi], v[o[s] : o[s + 1]])
+        lo, hi = r.u64_offsets[1], r.u64_offsets[2]
+        if not np.array_equal(r.u64_values[lo:hi], v[o[1] : o[2]]):
+            changed += 1
+    assert changed > 0
+
+    # shuffling s3 must restore s1 first (last_slots protocol)
+    runner.slots_shuffle(recs, {"s3"})
+    for r, (v, o) in zip(recs, before):
+        lo, hi = r.u64_offsets[1], r.u64_offsets[2]
+        np.testing.assert_array_equal(r.u64_values[lo:hi], v[o[1] : o[2]])
+
+    # empty set = full restore
+    runner.slots_shuffle(recs, set())
+    after = snapshot(recs)
+    for (v0, o0), (v1, o1) in zip(before, after):
+        np.testing.assert_array_equal(v0, v1)
+        np.testing.assert_array_equal(o0, o1)
+    assert runner.phase == 0  # flipped 3 times from 1
+
+
+def test_undeclared_slot_rejected():
+    rng = np.random.default_rng(2)
+    schema = make_schema()
+    recs = make_records(rng, 5)
+    runner = AucRunner(schema, replaced_slots=["s1"], capacity=4)
+    runner.observe(recs)
+    with pytest.raises(ValueError):
+        runner.slots_shuffle(recs, {"s0"})
+    with pytest.raises(RuntimeError):
+        AucRunner(schema, replaced_slots=["s1"], capacity=4).slots_shuffle(recs, {"s1"})
+
+
+def test_repeat_shuffle_same_slot_stats_balanced():
+    """Re-shuffling the same slot must not double-count feasign stats and
+    must still restore exactly."""
+    rng = np.random.default_rng(7)
+    schema = make_schema()
+    recs = make_records(rng, 20)
+    before = snapshot(recs)
+    runner = AucRunner(schema, replaced_slots=["s1"], capacity=20, seed=0)
+    runner.observe(recs)
+
+    def total():
+        return sum(len(r.u64_values) for r in recs)
+
+    # invariant: per-call total-length delta == added - deleted
+    for slots in ({"s1"}, {"s1"}, set()):
+        n0 = total()
+        st = runner.slots_shuffle(recs, slots)
+        assert total() - n0 == st["added"] - st["deleted"]
+    after = snapshot(recs)
+    for (v0, _), (v1, _) in zip(before, after):
+        np.testing.assert_array_equal(v0, v1)
+
+
+def test_candidates_self_consistent():
+    """Replaced values must come from the pool the record was assigned to."""
+    rng = np.random.default_rng(3)
+    schema = make_schema()
+    recs = make_records(rng, 30)
+    runner = AucRunner(schema, replaced_slots=["s2"], capacity=30, seed=1)
+    runner.observe(recs)
+    pool_vals = {tuple(c[2].tolist()) for c in runner.pools[0].candidates}
+    runner.slots_shuffle(recs, {"s2"})
+    for r in recs:
+        assert tuple(r.slot_keys(2).tolist()) in pool_vals
+
+
+def test_dataset_slots_shuffle_hook(tmp_path):
+    from paddlebox_tpu.data import BoxPSDataset
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+
+    rng = np.random.default_rng(4)
+    schema = make_schema()
+    lines = []
+    for _ in range(32):
+        ks = rng.integers(1, 50, NUM_SLOTS)
+        lines.append("1 1.0 " + " ".join(f"1 {k}" for k in ks))
+    p = tmp_path / "part-000.txt"
+    p.write_text("\n".join(lines) + "\n")
+
+    table = HostSparseTable(ValueLayout(embedx_dim=4), SparseOptimizerConfig(), n_shards=4)
+    ds = BoxPSDataset(schema, table, batch_size=8, read_threads=1)
+    ds.set_date("20260101")
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+    assert ds.auc_runner_phase == 1
+    ds.slots_shuffle(["s0"])
+    assert ds.auc_runner_phase == 0
+    # every batch key must still resolve in the pass working set (candidates
+    # come from the pass itself)
+    for b in ds.batches():
+        ds.ws.lookup(b.keys)
+    ds.slots_shuffle([])
+    assert ds.auc_runner_phase == 1
