@@ -255,6 +255,9 @@ class BoxPSDataset:
         # per-file seed decorrelates sampling across part files (same-seeded
         # readers would keep/drop identical line indices)
         seed = hash((self.seed, self.pass_id, path)) & 0x7FFFFFFF
+        begin_file = getattr(self.line_parser, "begin_file", None)
+        if begin_file is not None:  # per-file parser state (e.g. cache lines)
+            begin_file(path)
         reader = BufferedLineFileReader(path, converter=self.pipe_command, seed=seed)
         for line in reader:
             if not line:

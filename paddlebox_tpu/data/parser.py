@@ -23,6 +23,7 @@ Custom parsers: the reference loads user ``.so`` plugins via dlopen
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -119,3 +120,59 @@ def _parse_line(line: str, schema: SlotSchema) -> Optional[SlotRecord]:
         cmatch=cmatch,
         rank=rank,
     )
+
+
+class ReplicaCacheLineParser:
+    """Line parser for replica-cache datasets (B16 feed integration).
+
+    Parity with SlotPaddleBoxDataFeedWithGpuReplicaCache
+    (data_feed.cc:3198-3326): a line starting with ``#`` carries ``dim``
+    floats appended to the cache (no record produced); every following
+    normal line stores the latest cache row id as the single feasign of
+    ``cache_slot`` (the reference hard-codes slot index 3; here it is named).
+    The id slot's tokens in the text line are still consumed positionally.
+
+    State is thread-local and reset per file (``begin_file``, invoked by the
+    dataset reader): a cache line governs the records after it *within its
+    file*; a record before any cache line in its file is an error.
+    """
+
+    def __init__(self, cache, cache_slot: str):
+        self.cache = cache
+        self.cache_slot = cache_slot
+        self._tls = threading.local()
+
+    def begin_file(self, path: str) -> None:
+        self._tls.offset = None
+
+    def __call__(self, line: str, schema: SlotSchema) -> Optional[SlotRecord]:
+        if line.startswith("#"):
+            # full token list: a dim mismatch in either direction must raise
+            # (add_items validates), not silently truncate
+            vals = np.array(line[1:].split(), dtype=np.float32)
+            self._tls.offset = self.cache.add_items(vals)
+            return None
+        rec = parse_line(line, schema)
+        if rec is None:
+            return None
+        offset = getattr(self._tls, "offset", None)
+        if offset is None:
+            raise ValueError(
+                "record line before any '#' cache line in this file"
+            )
+        s = schema.sparse_slot_index(self.cache_slot)
+        new_vals = {s: np.array([offset], dtype=np.uint64)}
+        parts = []
+        n_slots = len(rec.u64_offsets) - 1
+        lens = np.empty(n_slots, dtype=np.int64)
+        for i in range(n_slots):
+            v = new_vals.get(i)
+            if v is None:
+                v = rec.slot_keys(i)
+            parts.append(v)
+            lens[i] = len(v)
+        rec.u64_values = np.concatenate(parts).astype(np.uint64, copy=False)
+        off = np.zeros(n_slots + 1, dtype=np.uint32)
+        np.cumsum(lens, out=off[1:])
+        rec.u64_offsets = off
+        return rec

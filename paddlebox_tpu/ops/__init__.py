@@ -1,5 +1,9 @@
 from paddlebox_tpu.ops.ctr_ops import batch_fc, fused_concat, rank_attention
-from paddlebox_tpu.ops.pull_push import pull_sparse_rows, push_sparse_rows
+from paddlebox_tpu.ops.pull_push import (
+    pull_sparse_rows,
+    pull_sparse_rows_extended,
+    push_sparse_rows,
+)
 from paddlebox_tpu.ops.seqpool_cvm import (
     cvm_transform,
     cvm_with_conv_transform,
@@ -12,6 +16,7 @@ from paddlebox_tpu.ops.seqpool_cvm import (
 
 __all__ = [
     "pull_sparse_rows",
+    "pull_sparse_rows_extended",
     "push_sparse_rows",
     "fused_seqpool_cvm",
     "fused_seqpool_cvm_with_conv",

@@ -42,6 +42,31 @@ def pull_sparse_rows(
     return jnp.concatenate([cvm_block, embedx], axis=1)
 
 
+def pull_sparse_rows_extended(
+    table: jnp.ndarray,  # [rows, width]
+    rows: jnp.ndarray,  # int32 [U]
+    layout: ValueLayout,
+    embedx_threshold: float,
+    scale: float = 1.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(pull records [U, pull_width], expand embeddings [U, expand_dim]).
+
+    The pull_box_extended_sparse analog (pull_box_extended_sparse_op.h:26-95):
+    each key yields its normal record plus a second, independently trained
+    expand embedding (same activation gating).
+    """
+    if layout.expand_dim == 0:
+        raise ValueError("layout has no expand block (expand_embed_dim == 0)")
+    picked = jnp.take(table, rows, axis=0)
+    cvm_block = picked[:, : layout.cvm_offset]
+    active = (picked[:, layout.SHOW] >= embedx_threshold)[:, None]
+    embedx = picked[:, layout.embedx_col : layout.embedx_col + layout.embedx_dim]
+    embedx = jnp.where(active, embedx * scale, 0.0)
+    expand = picked[:, layout.expand_col : layout.expand_col + layout.expand_dim]
+    expand = jnp.where(active, expand * scale, 0.0)
+    return jnp.concatenate([cvm_block, embedx], axis=1), expand
+
+
 def push_sparse_rows(
     table: jnp.ndarray,  # [rows, width]
     rows: jnp.ndarray,  # int32 [U] deduped rows (padding row allowed)
@@ -80,8 +105,15 @@ def sparse_update_rows(
 ) -> jnp.ndarray:
     """Row-wise sparse optimizer math shared by the single-device scatter path
     and the sharded owner-side merge path (rows with all-zero records are
-    identity: g2 += 0, step 0, counters += 0)."""
+    identity: g2 += 0, step 0, counters += 0).
+
+    ``grads`` may be [U, pull_width] or [U, pull_width + expand_dim] — the
+    extended form (pull_sparse_rows_extended) appends expand-embedding grads,
+    updated with their own adagrad g2 scalar (static shapes: the branch
+    resolves at trace time).
+    """
     co, D = layout.cvm_offset, layout.embedx_dim
+    with_expand = grads.shape[1] == layout.extended_push_width and layout.expand_dim > 0
 
     show = old[:, layout.SHOW] + show_counts
     clk = old[:, layout.CLK] + clk_counts
@@ -106,14 +138,20 @@ def sparse_update_rows(
     new_x = old[:, co : co + D] - (opt.embedx_lr * lr_scale * scale_x)[:, None] * x_grad
     new_x = jnp.clip(new_x, -opt.weight_bounds, opt.weight_bounds)
 
-    return jnp.concatenate(
-        [
-            show[:, None],
-            clk[:, None],
-            new_w,
-            new_x,
-            g2_e[:, None],
-            g2_x[:, None],
-        ],
-        axis=1,
-    )
+    cols = [show[:, None], clk[:, None], new_w, new_x]
+    if layout.expand_dim:
+        E = layout.expand_dim
+        ec = layout.expand_col
+        if with_expand:
+            e_grad = grads[:, co + D : co + D + E]
+            e_grad = jnp.where(active, e_grad, 0.0)
+        else:  # plain push on an expand-capable layout: expand untouched
+            e_grad = jnp.zeros((old.shape[0], E), old.dtype)
+        g2_p = old[:, layout.expand_g2_col] + jnp.mean(e_grad * e_grad, axis=1)
+        scale_p = jnp.sqrt(opt.initial_g2sum / (opt.initial_g2sum + g2_p))
+        new_p = old[:, ec : ec + E] - (opt.embedx_lr * lr_scale * scale_p)[:, None] * e_grad
+        cols.append(jnp.clip(new_p, -opt.weight_bounds, opt.weight_bounds))
+        cols += [g2_e[:, None], g2_x[:, None], g2_p[:, None]]
+    else:
+        cols += [g2_e[:, None], g2_x[:, None]]
+    return jnp.concatenate(cols, axis=1)

@@ -28,7 +28,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from paddlebox_tpu.ops.pull_push import pull_sparse_rows, sparse_update_rows
+from paddlebox_tpu.ops.pull_push import (
+    pull_sparse_rows,
+    pull_sparse_rows_extended,
+    sparse_update_rows,
+)
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
 
@@ -40,19 +44,28 @@ def sharded_pull(
     embedx_threshold: float,
     scale: float = 1.0,
     axis_name: str = "dp",
+    extended: bool = False,
 ) -> jnp.ndarray:
     """Pull records for this device's request buckets. [n_shards*K, pull_w].
 
     Output row s*K + j is the value for request slot j of shard s — exactly
     the bucket positions the host packer's ``inverse`` indices refer to.
+    With ``extended`` each record carries the expand-embedding block as
+    trailing columns (pull_box_extended_sparse parity over the mesh).
     """
     n, K = req_ranks.shape
     # route requests to owners: row d of the result = bucket from device d
     req_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
     # owner-side gather (+ embedx gating/scaling, PullCopy parity)
-    resp = pull_sparse_rows(
-        table_local, req_recv.reshape(-1), layout, embedx_threshold, scale
-    ).reshape(n, K, -1)
+    if extended:
+        rec, exp = pull_sparse_rows_extended(
+            table_local, req_recv.reshape(-1), layout, embedx_threshold, scale
+        )
+        resp = jnp.concatenate([rec, exp], axis=1).reshape(n, K, -1)
+    else:
+        resp = pull_sparse_rows(
+            table_local, req_recv.reshape(-1), layout, embedx_threshold, scale
+        ).reshape(n, K, -1)
     # route value buckets back: row s = bucket answered by shard s
     resp_back = lax.all_to_all(resp, axis_name, 0, 0, tiled=True)
     return resp_back.reshape(n * K, -1)
@@ -76,17 +89,17 @@ def sharded_push(
     volume — never with the shard's capacity.
     """
     n, K = req_ranks.shape
-    pw = layout.pull_width
+    gw = grads_bucket.shape[1]  # pull_width, or pull_width+expand (extended)
 
     recs = jnp.concatenate(
         [show_bucket[:, None], clk_bucket[:, None], grads_bucket], axis=1
-    ).reshape(n, K, pw + 2)
-    recs_recv = lax.all_to_all(recs, axis_name, 0, 0, tiled=True)  # [n, K, pw+2]
+    ).reshape(n, K, gw + 2)
+    recs_recv = lax.all_to_all(recs, axis_name, 0, 0, tiled=True)  # [n, K, gw+2]
     ranks_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
 
     M = n * K
     flat_ranks = ranks_recv.reshape(M)
-    flat_recs = recs_recv.reshape(M, pw + 2)
+    flat_recs = recs_recv.reshape(M, gw + 2)
 
     # group duplicate ranks: sort, segment by run, merge records per run
     order = jnp.argsort(flat_ranks)

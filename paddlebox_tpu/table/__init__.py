@@ -1,6 +1,11 @@
 from paddlebox_tpu.table.value_layout import ValueLayout, FeatureType
 from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
+from paddlebox_tpu.table.replica_cache import (
+    InputTable,
+    ReplicaCache,
+    pull_cache_value,
+)
 
 __all__ = [
     "ValueLayout",
@@ -8,4 +13,7 @@ __all__ = [
     "HostSparseTable",
     "PassWorkingSet",
     "SparseOptimizerConfig",
+    "ReplicaCache",
+    "InputTable",
+    "pull_cache_value",
 ]

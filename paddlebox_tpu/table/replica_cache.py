@@ -1,0 +1,152 @@
+"""Full-replica device caches: ReplicaCache + string-keyed InputTable (B16).
+
+Parity targets (box_wrapper.h:140-248):
+
+- ``GpuReplicaCache``: host threads accumulate fixed-dim float rows during
+  data load (``AddItems`` returns the row id, which replaces the feasign in
+  the parsed record); ``ToHBM`` replicates the whole table to every device;
+  the ``pull_cache_value`` op then gathers rows by id inside the step. The
+  cache is pass-scoped — BoxWrapper creates one per pass
+  (box_wrapper.cc:585-607) — and suits small/dense-ish side embeddings where
+  full replication beats sharded pull.
+
+- ``InputTable``: string key -> row of floats, CPU-resident, with a reserved
+  default row 0 (key "-") returned on miss (miss counter kept). The
+  reference's LookupInput is itself a host gather (D2H keys -> memcpy rows
+  -> H2D, box_wrapper.h:217-232), so a host-side ``lookup_input`` plus an
+  optional device replica is strictly faster than parity.
+
+TPU shape: ``to_device`` returns one jnp array; under a mesh pass a MeshPlan
+and it is placed replicated (every chip holds the full table — the XLA
+analog of the per-GPU cudaMemcpy loop in ToHBM). Row ids travel through the
+normal uint64 slot pipeline, so batches need no new plumbing.
+
+Note: the reference's InputTable stores *element* offsets into one flat
+float vector (key_offset_[key] = table_.size()); row ids are the same
+information divided by dim, kept as rows here for direct gather use.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+try:  # jax only needed for to_device / device gathers
+    import jax
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jax = jnp = None
+
+
+class ReplicaCache:
+    """GpuReplicaCache analog: append-only host rows -> replicated device array."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._rows: List[np.ndarray] = []
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def add_items(self, emb) -> int:
+        """Append one row; returns its id (AddItems parity, thread-safe)."""
+        row = np.asarray(emb, dtype=np.float32).reshape(-1)
+        if row.shape[0] != self.dim:
+            raise ValueError(f"row dim {row.shape[0]} != cache dim {self.dim}")
+        with self._lock:
+            self._rows.append(row)
+            return len(self._rows) - 1
+
+    def host_array(self) -> np.ndarray:
+        with self._lock:
+            if not self._rows:
+                return np.zeros((0, self.dim), dtype=np.float32)
+            return np.stack(self._rows)
+
+    def to_device(self, plan=None) -> "jnp.ndarray":
+        """Replicate to device(s) (ToHBM parity). With a MeshPlan the array
+        is placed replicated across the mesh."""
+        host = self.host_array()
+        if plan is not None:
+            from paddlebox_tpu.parallel.mesh import put_replicated
+
+            return put_replicated(plan, host)
+        return jnp.asarray(host)
+
+    def mem_used_mb(self) -> float:
+        return len(self._rows) * self.dim * 4 / 1024.0 / 1024.0
+
+
+def pull_cache_value(cache: "jnp.ndarray", ids: "jnp.ndarray") -> "jnp.ndarray":
+    """Gather cache rows by id — the pull_cache_value op
+    (pull_box_sparse_op.h:55-73 -> GpuReplicaCache::PullCacheValue)."""
+    return jnp.take(cache, ids.astype(jnp.int32), axis=0)
+
+
+class InputTable:
+    """String-keyed side-input table with default row 0 on miss."""
+
+    DEFAULT_KEY = "-"
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._key_row = {}
+        self._rows: List[np.ndarray] = []
+        self._lock = threading.Lock()
+        self._miss = 0
+        self.add_index_data(self.DEFAULT_KEY, np.zeros(dim, np.float32))
+
+    def __len__(self) -> int:
+        return len(self._key_row)
+
+    @property
+    def miss(self) -> int:
+        return self._miss
+
+    def add_index_data(self, key: str, vec) -> int:
+        row = np.asarray(vec, dtype=np.float32).reshape(-1)
+        if row.shape[0] != self.dim:
+            raise ValueError(f"row dim {row.shape[0]} != table dim {self.dim}")
+        with self._lock:
+            if key in self._key_row:  # last write wins, stable row id
+                rid = self._key_row[key]
+                self._rows[rid] = row
+                return rid
+            rid = len(self._rows)
+            self._key_row[key] = rid
+            self._rows.append(row)
+            return rid
+
+    def get_index_offset(self, key: str) -> int:
+        """Row id for ``key``; 0 (default row) and miss++ when absent
+        (GetIndexOffset parity). Called at parse/pack time so only int ids
+        reach the device pipeline."""
+        with self._lock:
+            rid = self._key_row.get(key)
+            if rid is None:
+                self._miss += 1
+                return 0
+            return rid
+
+    def lookup_input(self, ids: np.ndarray) -> np.ndarray:
+        """Host gather of rows by id (LookupInput parity — the reference's
+        version is a host gather with device copies around it)."""
+        with self._lock:
+            table = np.stack(self._rows) if self._rows else np.zeros((0, self.dim), np.float32)
+        return table[np.asarray(ids, dtype=np.int64)]
+
+    def to_device(self, plan=None) -> "jnp.ndarray":
+        """Device replica for in-step gathers via pull_cache_value."""
+        with self._lock:
+            host = np.stack(self._rows)
+        if plan is not None:
+            from paddlebox_tpu.parallel.mesh import put_replicated
+
+            return put_replicated(plan, host)
+        return jnp.asarray(host)
+
+    def mem_used_mb(self) -> float:
+        return len(self._rows) * self.dim * 4 / 1024.0 / 1024.0

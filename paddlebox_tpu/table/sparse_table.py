@@ -101,8 +101,9 @@ class HostSparseTable:
         rows = np.zeros((n, lay.width), dtype=np.float32)
         r = self.opt.initial_range
         rows[:, lay.embed_w_col] = self._rng.uniform(-r, r, size=n)
-        rows[:, lay.embedx_col : lay.embedx_col + lay.embedx_dim] = self._rng.uniform(
-            -r, r, size=(n, lay.embedx_dim)
+        n_emb = lay.embedx_dim + lay.expand_dim  # expand block trails embedx
+        rows[:, lay.embedx_col : lay.embedx_col + n_emb] = self._rng.uniform(
+            -r, r, size=(n, n_emb)
         )
         return rows
 

@@ -78,17 +78,43 @@ class ValueLayout:
         return self.cvm_offset
 
     @property
-    def embed_g2_col(self) -> int:
+    def expand_col(self) -> int:
+        """First column of the expand-embedding block (B12 extended pull:
+        pull_box_extended_sparse returns (emb, expand_emb) per slot). Empty
+        unless expand_embed_dim > 0 with a non-SHARE_EMBEDDING type —
+        SHARE_EMBEDDING folds its expand dims into the cvm block instead."""
         return self.cvm_offset + self.embedx_dim
 
     @property
+    def expand_dim(self) -> int:
+        if self.feature_type == FeatureType.SHARE_EMBEDDING:
+            return 0
+        return self.expand_embed_dim
+
+    @property
+    def embed_g2_col(self) -> int:
+        return self.cvm_offset + self.embedx_dim + self.expand_dim
+
+    @property
     def embedx_g2_col(self) -> int:
-        return self.cvm_offset + self.embedx_dim + 1
+        return self.embed_g2_col + 1
+
+    @property
+    def expand_g2_col(self) -> int:
+        if self.expand_dim == 0:
+            raise ValueError("layout has no expand block")
+        return self.embed_g2_col + 2
 
     @property
     def width(self) -> int:
         """Total fp32 columns per key in the table (incl. optimizer state)."""
-        return self.cvm_offset + self.embedx_dim + 2
+        return (
+            self.cvm_offset
+            + self.embedx_dim
+            + self.expand_dim
+            + 2
+            + (1 if self.expand_dim else 0)
+        )
 
     @property
     def pull_width(self) -> int:
@@ -102,3 +128,9 @@ class ValueLayout:
         Mirrors FeaturePushValueGpu (show, clk, embed_g, embedx_g[D]).
         """
         return self.cvm_offset + self.embedx_dim
+
+    @property
+    def extended_push_width(self) -> int:
+        """Extended push record: push_width + expand grads appended
+        (FeaturePushValueGpu expand variants, box_wrapper.cc:466-530)."""
+        return self.push_width + self.expand_dim

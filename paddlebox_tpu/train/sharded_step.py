@@ -101,9 +101,10 @@ def make_sharded_train_step(
         n, K = req_ranks.shape
 
         pulled = sharded_pull(
-            table, req_ranks, lay, opt.embedx_threshold, cfg.pull_scale, ax
-        )  # [n*K, PW]
-        flat = jnp.take(pulled, inverse, axis=0)  # [L, PW]
+            table, req_ranks, lay, opt.embedx_threshold, cfg.pull_scale, ax,
+            extended=cfg.use_expand,
+        )  # [n*K, PW(+E)]
+        flat = jnp.take(pulled, inverse, axis=0)  # [L, PW(+E)]
 
         # weighted (pv/ghost) batches normalize by the GLOBAL weight sum, so
         # a device with more ghosts doesn't over-weight its real samples;

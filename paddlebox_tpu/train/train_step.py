@@ -24,7 +24,11 @@ import jax.numpy as jnp
 import optax
 
 from paddlebox_tpu.metrics.auc import AucState, auc_init, auc_update
-from paddlebox_tpu.ops.pull_push import pull_sparse_rows, push_sparse_rows
+from paddlebox_tpu.ops.pull_push import (
+    pull_sparse_rows,
+    pull_sparse_rows_extended,
+    push_sparse_rows,
+)
 from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
@@ -53,6 +57,11 @@ class TrainStepConfig:
     # join-phase models taking the pv rank matrix get it as a 4th arg:
     # model_apply(params, slot_feats, dense, rank_offset)
     model_takes_rank_offset: bool = False
+    # extended pull (pull_box_extended_sparse parity): layout must have
+    # expand_embed_dim > 0; the model receives sum-pooled expand embeddings
+    # [B, S, E] as its last positional arg and their grads flow back into
+    # the table's expand block
+    use_expand: bool = False
 
 
 def init_train_state(
@@ -93,6 +102,10 @@ def local_forward_backward(
     """
 
     def loss_fn(p, flat_records):
+        if cfg.use_expand:  # trailing expand columns pool separately
+            E = cfg.layout.expand_dim
+            expand_flat = flat_records[:, -E:]
+            flat_records = flat_records[:, :-E]
         slot_feats = fused_seqpool_cvm(
             flat_records,
             segments,
@@ -101,10 +114,18 @@ def local_forward_backward(
             use_cvm=cfg.use_cvm,
             clk_filter=cfg.clk_filter,
         )
+        extra = []
         if cfg.model_takes_rank_offset:
-            logits = model_apply(p, slot_feats, dense, rank_offset)
-        else:
-            logits = model_apply(p, slot_feats, dense)
+            extra.append(rank_offset)
+        if cfg.use_expand:
+            # sum-pool expand per (slot, ins): [B, S, E] (pad segments drop)
+            pooled = jax.ops.segment_sum(
+                expand_flat,
+                segments,
+                num_segments=cfg.num_slots * cfg.batch_size,
+            ).reshape(cfg.num_slots, cfg.batch_size, E)
+            extra.append(jnp.transpose(pooled, (1, 0, 2)))
+        logits = model_apply(p, slot_feats, dense, *extra)
         loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
         if ins_weight is not None:
             denom = (
@@ -183,10 +204,16 @@ def make_train_step(
         rank_offset = batch.get("rank_offset")
         U = uniq_rows.shape[0]
 
-        pulled_u = pull_sparse_rows(
-            state.table, uniq_rows, lay, opt.embedx_threshold, cfg.pull_scale
-        )  # [U, PW]
-        flat = jnp.take(pulled_u, inverse, axis=0)  # [L, PW]
+        if cfg.use_expand:
+            rec_u, exp_u = pull_sparse_rows_extended(
+                state.table, uniq_rows, lay, opt.embedx_threshold, cfg.pull_scale
+            )
+            pulled_u = jnp.concatenate([rec_u, exp_u], axis=1)  # [U, PW+E]
+        else:
+            pulled_u = pull_sparse_rows(
+                state.table, uniq_rows, lay, opt.embedx_threshold, cfg.pull_scale
+            )  # [U, PW]
+        flat = jnp.take(pulled_u, inverse, axis=0)  # [L, PW(+E)]
 
         loss, preds, gparams, gflat = local_forward_backward(
             model_apply, cfg, state.params, flat, segments, labels, dense,

@@ -1,0 +1,311 @@
+"""ReplicaCache + InputTable (B16) and extended/expand pull (B12) tests."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.ops import pull_sparse_rows_extended, push_sparse_rows
+from paddlebox_tpu.ops.pull_push import sparse_update_rows
+from paddlebox_tpu.table import (
+    FeatureType,
+    HostSparseTable,
+    InputTable,
+    ReplicaCache,
+    SparseOptimizerConfig,
+    ValueLayout,
+    pull_cache_value,
+)
+
+
+# ---- value layout with expand block ------------------------------------
+
+def test_expand_layout_columns():
+    lay = ValueLayout(embedx_dim=8, expand_embed_dim=4)
+    assert lay.expand_dim == 4
+    assert lay.expand_col == lay.cvm_offset + 8
+    assert lay.embed_g2_col == lay.cvm_offset + 12
+    assert lay.expand_g2_col == lay.embed_g2_col + 2
+    assert lay.width == lay.cvm_offset + 8 + 4 + 3
+    assert lay.pull_width == lay.cvm_offset + 8
+    assert lay.extended_push_width == lay.pull_width + 4
+    # no expand: unchanged classic layout
+    base = ValueLayout(embedx_dim=8)
+    assert base.expand_dim == 0 and base.width == base.cvm_offset + 8 + 2
+    with pytest.raises(ValueError):
+        _ = base.expand_g2_col
+    # SHARE_EMBEDDING folds expand into cvm block: no trailing expand block
+    share = ValueLayout(embedx_dim=8, expand_embed_dim=4,
+                        feature_type=FeatureType.SHARE_EMBEDDING)
+    assert share.expand_dim == 0 and share.cvm_offset == 6
+
+
+def test_extended_pull_and_push():
+    lay = ValueLayout(embedx_dim=4, expand_embed_dim=3)
+    opt = SparseOptimizerConfig(embedx_threshold=2.0, embed_lr=0.1, embedx_lr=0.1)
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(16, lay.width)).astype(np.float32))
+    # row shows: rows 0..7 active (show >= 2), rows 8+ inactive
+    table = table.at[:, lay.SHOW].set(jnp.where(jnp.arange(16) < 8, 5.0, 0.0))
+    rows = jnp.array([1, 3, 9], jnp.int32)
+
+    rec, expand = pull_sparse_rows_extended(table, rows, lay, opt.embedx_threshold)
+    assert rec.shape == (3, lay.pull_width)
+    assert expand.shape == (3, 3)
+    np.testing.assert_allclose(
+        expand[0], table[1, lay.expand_col : lay.expand_col + 3], rtol=1e-6
+    )
+    np.testing.assert_array_equal(expand[2], np.zeros(3))  # gated
+
+    # push with expand grads: expand weights move for active rows only
+    grads = jnp.ones((3, lay.extended_push_width), jnp.float32)
+    new_table = push_sparse_rows(
+        table, rows, grads, jnp.ones(3), jnp.zeros(3), lay, opt
+    )
+    before = np.asarray(table)[:, lay.expand_col : lay.expand_col + 3]
+    after = np.asarray(new_table)[:, lay.expand_col : lay.expand_col + 3]
+    assert not np.allclose(before[1], after[1])
+    np.testing.assert_allclose(before[9], after[9])  # inactive: untouched
+    # expand g2 accumulated for active rows
+    assert np.asarray(new_table)[1, lay.expand_g2_col] > np.asarray(table)[1, lay.expand_g2_col]
+
+    # plain (non-extended) push on an expand layout leaves expand block alone
+    new2 = push_sparse_rows(
+        table, rows, grads[:, : lay.push_width], jnp.ones(3), jnp.zeros(3), lay, opt
+    )
+    np.testing.assert_allclose(
+        np.asarray(new2)[:, lay.expand_col : lay.expand_col + 3], before, rtol=1e-6
+    )
+
+
+def test_host_table_inits_expand_block():
+    lay = ValueLayout(embedx_dim=4, expand_embed_dim=3)
+    opt = SparseOptimizerConfig(initial_range=0.1)
+    t = HostSparseTable(lay, opt, n_shards=2, seed=0)
+    rows = t.pull_or_create(np.arange(1, 50, dtype=np.uint64))
+    ex = rows[:, lay.expand_col : lay.expand_col + 3]
+    assert np.abs(ex).max() > 0 and np.abs(ex).max() <= 0.1
+    assert (rows[:, lay.expand_g2_col] == 0).all()
+
+
+# ---- replica cache -----------------------------------------------------
+
+def test_replica_cache_threaded_add_and_gather():
+    cache = ReplicaCache(dim=4)
+    ids = {}
+
+    def add(tid):
+        for i in range(50):
+            ids[(tid, i)] = cache.add_items(np.full(4, tid * 100 + i, np.float32))
+
+    ts = [threading.Thread(target=add, args=(t,)) for t in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(cache) == 200
+    dev = cache.to_device()
+    # every returned id maps to the row that was added under it
+    for (tid, i), rid in ids.items():
+        np.testing.assert_array_equal(
+            np.asarray(dev[rid]), np.full(4, tid * 100 + i, np.float32)
+        )
+    got = pull_cache_value(dev, jnp.array([ids[(2, 7)], ids[(0, 0)]]))
+    np.testing.assert_array_equal(np.asarray(got[0]), np.full(4, 207.0))
+
+    with pytest.raises(ValueError):
+        cache.add_items(np.zeros(5, np.float32))
+
+
+def test_input_table_default_miss_and_upsert():
+    t = InputTable(dim=3)
+    assert len(t) == 1  # default row
+    a = t.add_index_data("ad-1", [1, 2, 3])
+    b = t.add_index_data("ad-2", [4, 5, 6])
+    assert (a, b) == (1, 2)
+    assert t.get_index_offset("ad-2") == 2
+    assert t.get_index_offset("nope") == 0 and t.miss == 1
+    # upsert keeps row id
+    assert t.add_index_data("ad-1", [9, 9, 9]) == 1
+    got = t.lookup_input(np.array([0, 1, 2]))
+    np.testing.assert_array_equal(got[0], np.zeros(3))
+    np.testing.assert_array_equal(got[1], [9, 9, 9])
+    dev = t.to_device()
+    np.testing.assert_array_equal(np.asarray(pull_cache_value(dev, jnp.array([2]))[0]), [4, 5, 6])
+
+
+# ---- feed integration ---------------------------------------------------
+
+def test_replica_cache_line_parser_end_to_end(tmp_path):
+    from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+    from paddlebox_tpu.data.parser import ReplicaCacheLineParser
+
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1),
+         SlotInfo("cache_idx"), SlotInfo("s0"), SlotInfo("s1")],
+        label_slot="label",
+    )
+    cache = ReplicaCache(dim=2)
+    # two cache groups; records after each '#' line use its row
+    lines = [
+        "# 1.5 2.5",
+        "1 1.0 1 7 1 11 1 21",
+        "1 0.0 1 7 1 12 1 22",
+        "# 3.5 4.5",
+        "1 1.0 1 7 1 13 1 23",
+    ]
+    p = tmp_path / "part-000.txt"
+    p.write_text("\n".join(lines) + "\n")
+
+    lay = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(lay, SparseOptimizerConfig(), n_shards=2)
+    ds = BoxPSDataset(
+        schema, table, batch_size=3, read_threads=1,
+        line_parser=ReplicaCacheLineParser(cache, "cache_idx"),
+        drop_remainder=False,
+    )
+    ds.set_date("20260101")
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    assert len(cache) == 2
+    assert ds.memory_data_size() == 3
+    # cache_idx slot (sparse slot 0) carries row ids 0,0,1
+    got = sorted(int(r.slot_keys(0)[0]) for r in ds.records)
+    assert got == [0, 0, 1]
+    dev = cache.to_device()
+    np.testing.assert_array_equal(np.asarray(pull_cache_value(dev, jnp.array([1]))[0]), [3.5, 4.5])
+
+
+def test_replica_cache_parser_file_boundary_and_dim_mismatch(tmp_path):
+    """A file without a leading '#' line must raise (no state leaking from
+    the previous file on the same thread); oversize cache lines must raise."""
+    from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+    from paddlebox_tpu.data.parser import ReplicaCacheLineParser
+
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1),
+         SlotInfo("cache_idx"), SlotInfo("s0")],
+        label_slot="label",
+    )
+    (tmp_path / "a.txt").write_text("# 1 2\n1 1.0 1 7 1 11\n")
+    (tmp_path / "b.txt").write_text("1 1.0 1 7 1 12\n")  # no '#' line
+    cache = ReplicaCache(dim=2)
+    lay = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(lay, SparseOptimizerConfig(), n_shards=2)
+    ds = BoxPSDataset(
+        schema, table, batch_size=2, read_threads=1,
+        line_parser=ReplicaCacheLineParser(cache, "cache_idx"),
+    )
+    ds.set_date("20260101")
+    ds.set_filelist([str(tmp_path / "a.txt"), str(tmp_path / "b.txt")])
+    with pytest.raises(ValueError, match="cache line"):
+        ds.load_into_memory()
+
+    parser = ReplicaCacheLineParser(ReplicaCache(dim=2), "cache_idx")
+    parser.begin_file("x")
+    with pytest.raises(ValueError):  # 3 floats into a dim-2 cache
+        parser("# 1 2 3", schema)
+
+
+# ---- extended pull through the train step (single device vs mesh) -------
+
+class ExpandModel:
+    """Tiny model consuming (slot_feats, dense, expand[B,S,E])."""
+
+    def __init__(self, num_slots, feat_width, expand_dim):
+        self.num_slots, self.feat_width, self.expand_dim = (
+            num_slots, feat_width, expand_dim,
+        )
+
+    def init(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w": jax.random.normal(k1, (self.num_slots * self.feat_width,)) * 0.05,
+            "we": jax.random.normal(k2, (self.num_slots * self.expand_dim,)) * 0.05,
+        }
+
+    def apply(self, p, slot_feats, dense=None, expand=None):
+        B = slot_feats.shape[0]
+        return (
+            slot_feats.reshape(B, -1) @ p["w"]
+            + expand.reshape(B, -1) @ p["we"]
+        )
+
+
+def test_extended_train_step_single_vs_mesh():
+    import jax
+    import optax
+
+    from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
+    from paddlebox_tpu.data.slot_record import build_batch
+    from paddlebox_tpu.data.slot_schema import SlotInfo, SlotSchema
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.table import PassWorkingSet
+    from paddlebox_tpu.train import TrainStepConfig, make_train_step
+    from paddlebox_tpu.train.sharded_step import (
+        init_sharded_train_state,
+        make_sharded_train_step,
+    )
+    from paddlebox_tpu.train.train_step import init_train_state, jit_train_step
+    from test_train_step import synth_records
+
+    S, B, NDEV = 4, 32, 8
+    lay = ValueLayout(embedx_dim=4, expand_embed_dim=3)
+    opt = SparseOptimizerConfig(
+        embed_lr=0.2, embedx_lr=0.2, embedx_threshold=0.0, initial_range=0.05,
+        show_clk_decay=1.0, shrink_threshold=0.0,
+    )
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(S)],
+        label_slot="label",
+    )
+    rng = np.random.default_rng(3)
+    table = HostSparseTable(lay, opt, n_shards=4, seed=0)
+    recs = synth_records(rng, B * 4, schema)
+    ws = PassWorkingSet(n_mesh_shards=NDEV)
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev_table = ws.finalize(table, round_to=32)
+
+    model = ExpandModel(S, lay.pull_width, lay.expand_dim)
+    params = model.init(jax.random.PRNGKey(1))
+    paramsN = model.init(jax.random.PRNGKey(1))
+    dense_opt = optax.adam(1e-2)
+
+    cfg1 = TrainStepConfig(num_slots=S, batch_size=B, layout=lay,
+                           sparse_opt=opt, auc_buckets=1000, use_expand=True)
+    step1 = jit_train_step(make_train_step(model.apply, dense_opt, cfg1))
+    st1 = init_train_state(
+        jnp.asarray(dev_table.reshape(-1, lay.width)), params, dense_opt, 1000
+    )
+    t0 = np.asarray(st1.table).copy()
+
+    plan = make_mesh(NDEV)
+    cfgN = TrainStepConfig(num_slots=S, batch_size=B // NDEV, layout=lay,
+                           sparse_opt=opt, auc_buckets=1000,
+                           axis_name=plan.axis, use_expand=True)
+    stepN = make_sharded_train_step(model.apply, dense_opt, cfgN, plan)
+    stN = init_sharded_train_state(plan, dev_table, paramsN, dense_opt, 1000)
+
+    for i in range(4):
+        batch_recs = [recs[(i * B + j) % len(recs)] for j in range(B)]
+        batch = build_batch(batch_recs, schema)
+        db1 = pack_batch(batch, ws, schema, bucket=64)
+        st1, m1 = step1(st1, {k: jnp.asarray(v) for k, v in db1.as_dict().items()})
+        dbN = pack_batch_sharded(batch, ws, schema, NDEV, bucket=32)
+        feed = {k: jax.device_put(v, plan.batch_sharding) for k, v in dbN.as_dict().items()}
+        stN, mN = stepN(stN, feed)
+        np.testing.assert_allclose(float(m1["loss"]), float(mN["loss"]), rtol=3e-4)
+
+    t1 = np.asarray(st1.table)
+    # expand block trained (changed) for touched rows
+    exp0 = t0[:, lay.expand_col : lay.expand_col + lay.expand_dim]
+    exp1 = t1[:, lay.expand_col : lay.expand_col + lay.expand_dim]
+    assert np.abs(exp1 - exp0).max() > 1e-5
+    # expand g2 accumulated
+    assert t1[:, lay.expand_g2_col].max() > 0
+    # sharded table matches single-device row-for-row
+    tN = np.asarray(stN.table).reshape(-1, lay.width)
+    np.testing.assert_allclose(t1, tN, rtol=1e-3, atol=5e-4)
