@@ -1,5 +1,10 @@
 from paddlebox_tpu.train.train_step import TrainState, make_train_step, TrainStepConfig
-from paddlebox_tpu.train.sharded_step import init_sharded_train_state, make_sharded_train_step
+from paddlebox_tpu.train.sharded_step import (
+    init_sharded_train_state,
+    kstep_sync_params,
+    make_sharded_train_step,
+)
+from paddlebox_tpu.train.async_dense import AsyncDenseTable
 from paddlebox_tpu.train.trainer import CTRTrainer
 
 __all__ = [
@@ -7,6 +12,8 @@ __all__ = [
     "make_train_step",
     "TrainStepConfig",
     "init_sharded_train_state",
+    "kstep_sync_params",
     "make_sharded_train_step",
+    "AsyncDenseTable",
     "CTRTrainer",
 ]

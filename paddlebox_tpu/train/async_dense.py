@@ -1,0 +1,167 @@
+"""Async dense table: host-side background dense optimizer (B6).
+
+Parity with BoxPSAsynDenseTable (boxps_worker.cc:35-237, device_worker.h:
+586-617): device workers *pull* the current dense params before each batch
+and *push* raw gradients after it; a background host thread drains the grad
+queue, merges up to ``merge_limit`` packages (mean), and applies the
+reference's fixed Adam-like rule
+
+    mom1 = 0.99 * mom1 + 0.01 * g
+    mom2 = 0.9999 * mom2 + 0.0001 * g*g
+    p   -= lr * mom1 / (sqrt(mom2) + 1e-8)
+
+(the "magic beta and epsilon" constants, boxps_worker.cc:166-175) with a
+per-parameter lr override map (GetLRMap parity, box_wrapper.cc:1234-1241).
+
+TPU shape: params live as a numpy pytree guarded by a rw-lock; ``pull_dense``
+returns the current tree (to be fed into a step whose config sets
+``dense_sync_mode="async"`` so the device never updates params itself), and
+``push_dense`` enqueues the step's gparams. Training proceeds without
+waiting on the optimizer — the asynchrony/staleness semantics match the
+reference (workers may train on params a few updates old).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class AsyncDenseTable:
+    """Background-thread dense optimizer with pull/push worker API."""
+
+    def __init__(
+        self,
+        params: Any,  # pytree of arrays (initial values)
+        base_lr: float,
+        lr_map: Optional[Dict[str, float]] = None,  # leaf-path -> lr override
+        merge_limit: int = 4,
+        queue_cap: int = 24,  # PSBufferQueue(8 * 3) parity
+    ):
+        leaves, self._treedef = jax.tree.flatten(params)
+        self._params = [np.array(x, dtype=np.float32) for x in leaves]
+        self._mom1 = [np.zeros_like(x) for x in self._params]
+        self._mom2 = [np.zeros_like(x) for x in self._params]
+        self.base_lr = float(base_lr)
+        self.merge_limit = merge_limit
+        # leaf lr: lr_map keys match normalized "/"-joined key paths, exact
+        # or path-suffix ("mlp/w0" matches key "w0" and key "mlp/w0", never
+        # the substring-style accident of "w" matching "w0")
+        def norm(kp) -> str:
+            parts = []
+            for e in kp:
+                for attr in ("key", "idx", "name"):
+                    if hasattr(e, attr):
+                        parts.append(str(getattr(e, attr)))
+                        break
+                else:
+                    parts.append(str(e))
+            return "/".join(parts)
+
+        paths = [
+            norm(kp) for kp, _ in jax.tree_util.tree_flatten_with_path(params)[0]
+        ]
+
+        def leaf_lr(path: str) -> float:
+            for k, v in (lr_map or {}).items():
+                if path == k or path.endswith("/" + k):
+                    return v
+            return self.base_lr
+
+        self._leaf_lr = np.array([leaf_lr(p) for p in paths], dtype=np.float32)
+        self._lock = threading.Lock()  # guards _params/_mom*
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_cap)
+        self._n_updates = 0
+        self._closed = False
+        self._thread = threading.Thread(target=self._update_loop, daemon=True)
+        self._thread.start()
+
+    # ---- worker API ------------------------------------------------------
+
+    def pull_dense(self) -> Any:
+        """Current param tree (PullDense parity). Cheap copy under lock."""
+        with self._lock:
+            leaves = [x.copy() for x in self._params]
+        return jax.tree.unflatten(self._treedef, leaves)
+
+    def push_dense(self, gparams: Any) -> None:
+        """Enqueue one step's dense grads (PushDense parity). Blocks only
+        when the queue is full (producer backpressure, like the reference's
+        bounded channel)."""
+        if self._closed:
+            raise RuntimeError("table finalized")
+        leaves = jax.tree.leaves(gparams)
+        self._queue.put([np.asarray(x, dtype=np.float32) for x in leaves])
+
+    @property
+    def n_updates(self) -> int:
+        return self._n_updates
+
+    # ---- background optimizer -------------------------------------------
+
+    def _update_loop(self) -> None:
+        while True:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if first is None:  # close sentinel
+                return
+            batch = [first]
+            # merge up to merge_limit-1 more waiting packages (AsyncUpdate
+            # merge_num = min(queue size + 1, 4))
+            while len(batch) < self.merge_limit:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._apply(batch)
+                    return
+                batch.append(nxt)
+            self._apply(batch)
+
+    def _apply(self, batch) -> None:
+        inv = 1.0 / len(batch)
+        with self._lock:
+            for i in range(len(self._params)):
+                g = batch[0][i]
+                for other in batch[1:]:
+                    g = g + other[i]
+                if len(batch) > 1:
+                    g = g * inv
+                m1, m2 = self._mom1[i], self._mom2[i]
+                m1 *= 0.99
+                m1 += 0.01 * g
+                m2 *= 0.9999
+                m2 += 0.0001 * g * g
+                self._params[i] -= self._leaf_lr[i] * m1 / (np.sqrt(m2) + 1e-8)
+            self._n_updates += 1
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def finalize(self) -> Any:
+        """Drain the queue, stop the thread, return the final params
+        (Finalize copies ps_ back to the root scope)."""
+        if not self._closed:
+            self._closed = True
+            self._queue.put(None)
+            self._thread.join()
+            # drain anything left after the sentinel raced in
+            leftovers = []
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    leftovers.append(item)
+            for item in leftovers:
+                self._apply([item])
+        return self.pull_dense()

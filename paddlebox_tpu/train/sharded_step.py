@@ -44,18 +44,33 @@ def init_sharded_train_state(
     dense_opt: optax.GradientTransformation,
     auc_buckets: int = 100_000,
     opt_state: Any = None,  # carry over between passes; None = fresh
+    local_dense: bool = False,  # kstep/LocalSGD: per-device dense replicas
 ) -> TrainState:
     n = plan.n_devices
     auc = AucState(
         pos=jnp.zeros((n, auc_buckets), jnp.int32),
         neg=jnp.zeros((n, auc_buckets), jnp.int32),
     )
+    opt_state = opt_state if opt_state is not None else dense_opt.init(params)
+    if local_dense:
+        # K-step mode: every device carries its OWN dense params between
+        # syncs, so they get a leading device axis sharded over the mesh
+        # (the replicated layout would silently assume device-invariance)
+        stack = lambda tree: jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                jnp.asarray(x)[None], (n,) + jnp.shape(x)
+            ),
+            tree,
+        )
+        params_p = jax.device_put(stack(params), plan.batch_sharding)
+        opt_p = jax.device_put(stack(opt_state), plan.batch_sharding)
+    else:
+        params_p = put_replicated(plan, params)
+        opt_p = put_replicated(plan, opt_state)
     return TrainState(
         table=put_sharded(plan, table),
-        params=put_replicated(plan, params),
-        opt_state=put_replicated(
-            plan, opt_state if opt_state is not None else dense_opt.init(params)
-        ),
+        params=params_p,
+        opt_state=opt_p,
         auc=put_sharded(plan, auc),
         step=put_replicated(plan, jnp.zeros((), jnp.int32)),
     )
@@ -77,6 +92,11 @@ def make_sharded_train_step(
         raise ValueError(
             f"cfg.axis_name {cfg.axis_name!r} != mesh axis {plan.axis!r}; the "
             "sharded step always runs its collectives over the plan's axis"
+        )
+    if cfg.dense_sync_mode == "async":
+        raise NotImplementedError(
+            "dense_sync_mode='async' (host AsyncDenseTable) is a "
+            "single-device worker mode; on a mesh use 'step' or 'kstep'"
         )
     lay, opt = cfg.layout, cfg.sparse_opt
     S, b = cfg.num_slots, cfg.batch_size
@@ -106,10 +126,14 @@ def make_sharded_train_step(
         )  # [n*K, PW(+E)]
         flat = jnp.take(pulled, inverse, axis=0)  # [L, PW(+E)]
 
+        kstep = cfg.dense_sync_mode == "kstep"
         # weighted (pv/ghost) batches normalize by the GLOBAL weight sum, so
         # a device with more ghosts doesn't over-weight its real samples;
         # its local grads are then already global-mean scale (grad_div=1)
         # and the dense reduction is a psum of partial sums, not a pmean.
+        # (This holds in kstep mode too — the sparse table is SHARED, so its
+        # grads always need the global denominator; only the dense update
+        # goes local, via a rescale below.)
         if ins_weight is not None:
             loss_denom = jnp.maximum(
                 jax.lax.psum(jnp.sum(ins_weight), ax), 1.0
@@ -118,8 +142,15 @@ def make_sharded_train_step(
         else:
             loss_denom = None
             grad_div = float(plan.n_devices)
+        # kstep keeps per-device dense replicas: strip their device axis
+        params = (
+            jax.tree.map(lambda x: x[0], state.params) if kstep else state.params
+        )
+        opt_state = (
+            jax.tree.map(lambda x: x[0], state.opt_state) if kstep else state.opt_state
+        )
         loss, preds, gparams, gflat = local_forward_backward(
-            model_apply, cfg, state.params, flat, segments, labels, dense,
+            model_apply, cfg, params, flat, segments, labels, dense,
             ins_weight=ins_weight, rank_offset=rank_offset,
             loss_denom=loss_denom,
         )
@@ -141,14 +172,37 @@ def make_sharded_train_step(
             table, req_ranks, gbucket, show_bucket, clk_bucket, lay, opt, ax
         )
 
-        if ins_weight is not None:
+        if kstep:
+            # LocalSGD: dense update uses LOCAL grads. Weighted grads came
+            # out against the global denominator (sparse correctness), so
+            # rescale them to this device's local weighted mean.
+            if ins_weight is not None:
+                local_w = jnp.maximum(jnp.sum(ins_weight), 1.0)
+                gparams = jax.tree.map(lambda g: g * (loss_denom / local_w), gparams)
+                loss = jax.lax.psum(loss, ax)
+            else:
+                loss = jax.lax.pmean(loss, ax)
+        elif ins_weight is not None:
             gparams = jax.lax.psum(gparams, ax)
             loss = jax.lax.psum(loss, ax)
         else:
             gparams = jax.lax.pmean(gparams, ax)
             loss = jax.lax.pmean(loss, ax)
-        updates, new_opt_state = dense_opt.update(gparams, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        updates, new_opt_state = dense_opt.update(gparams, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        if kstep:
+            # average params across the mesh every K steps (SyncParam scale
+            # 1/(dev*node) parity) — the step counter is replicated, so the
+            # cond is uniform and the collective inside it is deadlock-free
+            new_params = jax.lax.cond(
+                (state.step + 1) % cfg.param_sync_step == 0,
+                lambda p: jax.tree.map(lambda x: jax.lax.pmean(x, ax), p),
+                lambda p: p,
+                new_params,
+            )
+            # restore the device axis for the sharded state layout
+            new_params = jax.tree.map(lambda x: x[None], new_params)
+            new_opt_state = jax.tree.map(lambda x: x[None], new_opt_state)
 
         local_auc = AucState(pos=state.auc.pos[0], neg=state.auc.neg[0])
         auc_mask = None if ins_weight is None else (ins_weight > 0)
@@ -172,7 +226,10 @@ def make_sharded_train_step(
 
     dp = P(ax)
     rep = P()
-    state_specs = TrainState(table=dp, params=rep, opt_state=rep, auc=dp, step=rep)
+    dense_spec = dp if cfg.dense_sync_mode == "kstep" else rep
+    state_specs = TrainState(
+        table=dp, params=dense_spec, opt_state=dense_spec, auc=dp, step=rep
+    )
 
     def batch_specs(batch):
         return {k: dp for k in batch}
@@ -191,3 +248,12 @@ def make_sharded_train_step(
         return mapped(state, batch)
 
     return jax.jit(step, donate_argnums=(0,))
+
+
+def kstep_sync_params(state: TrainState) -> TrainState:
+    """Average the per-device dense replicas of a kstep state (the final
+    SyncParam at pass end, boxps_worker.cc:459-461). The mean over the
+    sharded device axis compiles to one all-reduce."""
+    avg = jax.tree.map(lambda x: jnp.mean(x, axis=0, keepdims=True), state.params)
+    bcast = jax.tree.map(lambda x, a: jnp.broadcast_to(a, x.shape), state.params, avg)
+    return state._replace(params=bcast)

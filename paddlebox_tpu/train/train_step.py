@@ -62,6 +62,25 @@ class TrainStepConfig:
     # [B, S, E] as its last positional arg and their grads flow back into
     # the table's expand block
     use_expand: bool = False
+    # dense sync mode (BoxPSWorker sync_mode_, boxps_worker.cc:239-240):
+    #  "step"  - allreduce dense grads every step (default; DP-sync parity)
+    #  "kstep" - LocalSGD: local updates, params averaged across the mesh
+    #            every param_sync_step steps + at pass end (DenseKStepNode/
+    #            ALL parity — the NCCL reduce-scatter + closed SyncDense +
+    #            allgather hierarchy collapses into one XLA all-reduce)
+    #  "async" - device never updates dense params; gparams are returned in
+    #            metrics for a host AsyncDenseTable (B6) pull/push loop
+    dense_sync_mode: str = "step"
+    param_sync_step: int = 16  # K for "kstep"
+
+    def __post_init__(self):
+        if self.dense_sync_mode not in ("step", "kstep", "async"):
+            raise ValueError(
+                f"dense_sync_mode {self.dense_sync_mode!r} not in "
+                "('step', 'kstep', 'async')"
+            )
+        if self.dense_sync_mode == "kstep" and self.param_sync_step < 1:
+            raise ValueError("param_sync_step must be >= 1 for kstep")
 
 
 def init_train_state(
@@ -235,8 +254,14 @@ def make_train_step(
         if cfg.axis_name is not None:
             gparams = jax.lax.pmean(gparams, cfg.axis_name)
             loss = jax.lax.pmean(loss, cfg.axis_name)
-        updates, new_opt_state = dense_opt.update(gparams, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if cfg.dense_sync_mode == "async":
+            # host AsyncDenseTable owns the dense optimizer: hand grads back
+            new_params, new_opt_state = state.params, state.opt_state
+        else:
+            updates, new_opt_state = dense_opt.update(
+                gparams, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
 
         auc_mask = None if ins_weight is None else (ins_weight > 0)
         new_auc = auc_update(state.auc, preds, labels, auc_mask)
@@ -248,6 +273,8 @@ def make_train_step(
             "preds": preds,
             "labels": labels,
         }
+        if cfg.dense_sync_mode == "async":
+            metrics["gparams"] = gparams
         return (
             TrainState(
                 table=new_table,

@@ -31,6 +31,7 @@ from paddlebox_tpu.metrics.registry import MetricRegistry
 from paddlebox_tpu.parallel.mesh import MeshPlan
 from paddlebox_tpu.train.sharded_step import (
     init_sharded_train_state,
+    kstep_sync_params,
     make_sharded_train_step,
 )
 from paddlebox_tpu.train.train_step import (
@@ -52,11 +53,24 @@ class CTRTrainer:
         dense_dim: int = 0,
         pack_bucket: Optional[int] = None,
         metric_registry: Optional["MetricRegistry"] = None,
+        async_dense: Optional["AsyncDenseTable"] = None,
     ):
         self.model = model
         self.cfg = cfg
         self.dense_opt = dense_opt or optax.adam(1e-3)
         self.plan = plan
+        self.async_dense = async_dense
+        if cfg.dense_sync_mode == "async":
+            if async_dense is None:
+                raise ValueError(
+                    "dense_sync_mode='async' needs an AsyncDenseTable (else "
+                    "dense params would silently never update)"
+                )
+            if plan is not None:
+                raise NotImplementedError(
+                    "async dense mode is single-device; use 'step'/'kstep' "
+                    "on a mesh"
+                )
         self.dense_slot = dense_slot
         self.dense_dim = dense_dim
         self.pack_bucket = pack_bucket
@@ -119,6 +133,7 @@ class CTRTrainer:
             self.dense_opt,
             self.cfg.auc_buckets,
             opt_state=self.opt_state,
+            local_dense=self.cfg.dense_sync_mode == "kstep",
         )
 
     def _pack_and_put(self, batch, ws):
@@ -175,13 +190,20 @@ class CTRTrainer:
             iterator = dataset.pv_batches(n_batches)
         else:
             iterator = ((b, None) for b in dataset.batches(n_batches))
+        is_async = self.cfg.dense_sync_mode == "async"
         for i, (batch, ins_weight) in enumerate(iterator):
             feed = self._pack_and_put(batch, dataset.ws)
             if ins_weight is not None:
                 feed["ins_weight"] = jnp.asarray(ins_weight)
             if batch.rank_offset is not None:
                 feed["rank_offset"] = jnp.asarray(batch.rank_offset)
+            if is_async:  # PullDense / PushDense worker loop (B6)
+                state = state._replace(
+                    params=jax.device_put(self.async_dense.pull_dense())
+                )
             state, m = self._step(state, feed)
+            if is_async:
+                self.async_dense.push_dense(jax.tree.map(np.asarray, m["gparams"]))
             if self.metric_registry is not None:
                 # per-batch registry feed with phase + logkey-derived vars
                 # (AddAucMonitor parity, boxps_worker.cc:408-418)
@@ -197,8 +219,20 @@ class CTRTrainer:
                 on_batch(i, m)
             losses.append(m["loss"])
         # persist dense side for the next pass; state.table stays for writeback
-        self.params = state.params
-        self.opt_state = state.opt_state
+        if is_async:
+            # the host table owns the dense params; snapshot its latest view
+            self.params = jax.device_put(self.async_dense.pull_dense())
+            self.opt_state = state.opt_state  # untouched in async mode
+        elif self.plan is not None and self.cfg.dense_sync_mode == "kstep":
+            # pass-end SyncParam (boxps_worker.cc:459-461), then store the
+            # synced params un-stacked; momentum stays device-0's (the
+            # reference likewise syncs only the fused param buffer)
+            state = kstep_sync_params(state)
+            self.params = jax.tree.map(lambda x: x[0], state.params)
+            self.opt_state = jax.tree.map(lambda x: x[0], state.opt_state)
+        else:
+            self.params = state.params
+            self.opt_state = state.opt_state
         self._state = state
         out = auc_compute(state.auc)
         out["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")

@@ -1,0 +1,253 @@
+"""Dense sync modes: K-step/LocalSGD on the mesh + async dense table (B5/B6).
+
+Model: the reference's sync_mode_ switch (DenseKStepNode/DenseKStepALL,
+boxps_worker.cc:239-240, SyncParam :359-398) and BoxPSAsynDenseTable
+(:35-237).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
+from paddlebox_tpu.data.slot_record import build_batch
+from paddlebox_tpu.data.slot_schema import SlotInfo, SlotSchema
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    PassWorkingSet,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train import (
+    AsyncDenseTable,
+    TrainStepConfig,
+    init_sharded_train_state,
+    kstep_sync_params,
+    make_sharded_train_step,
+    make_train_step,
+)
+from paddlebox_tpu.train.train_step import init_train_state, jit_train_step
+
+from test_train_step import synth_records
+
+NUM_SLOTS = 4
+BATCH = 64
+N_DEV = 8
+LAYOUT = ValueLayout(embedx_dim=8)
+OPT = SparseOptimizerConfig(
+    embed_lr=0.2, embedx_lr=0.2, embedx_threshold=0.0, initial_range=0.01,
+    show_clk_decay=1.0, shrink_threshold=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def schema():
+    return SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NUM_SLOTS)],
+        label_slot="label",
+    )
+
+
+@pytest.fixture(scope="module")
+def setup(schema):
+    rng = np.random.default_rng(11)
+    table = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    recs = synth_records(rng, BATCH * 4, schema)
+    ws = PassWorkingSet(n_mesh_shards=N_DEV)
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev_table = ws.finalize(table, round_to=32)
+    return table, recs, ws, dev_table
+
+
+def param_spread(state):
+    """Max across leaves of max-abs spread between device replicas."""
+    s = 0.0
+    for x in jax.tree.leaves(state.params):
+        x = np.asarray(x).astype(np.float64)
+        s = max(s, np.abs(x - x[:1]).max())
+    return s
+
+
+def test_kstep_localsgd_mesh(schema, setup):
+    table, recs, ws, dev_table = setup
+    plan = make_mesh(N_DEV)
+    K = 4
+    model = DeepFM(num_slots=NUM_SLOTS, feat_width=LAYOUT.pull_width,
+                   embedx_dim=8, hidden=(16,))
+    dense_opt = optax.adam(1e-2)
+    cfg = TrainStepConfig(
+        num_slots=NUM_SLOTS, batch_size=BATCH // N_DEV, layout=LAYOUT,
+        sparse_opt=OPT, auc_buckets=1000, axis_name=plan.axis,
+        dense_sync_mode="kstep", param_sync_step=K,
+    )
+    step = make_sharded_train_step(model.apply, dense_opt, cfg, plan)
+    st = init_sharded_train_state(
+        plan, dev_table, model.init(jax.random.PRNGKey(0)), dense_opt, 1000,
+        local_dense=True,
+    )
+    assert param_spread(st) == 0.0
+
+    losses = []
+    spreads = []
+    for i in range(2 * K):
+        batch_recs = [recs[(i * BATCH + j) % len(recs)] for j in range(BATCH)]
+        db = pack_batch_sharded(build_batch(batch_recs, schema), ws, schema, N_DEV, bucket=32)
+        feed = {k: jax.device_put(v, plan.batch_sharding) for k, v in db.as_dict().items()}
+        st, m = step(st, feed)
+        losses.append(float(m["loss"]))
+        spreads.append(param_spread(st))
+
+    # replicas diverge between syncs and re-converge exactly on sync steps
+    # (steps are 1-based in the cond: sync when step % K == 0)
+    for i, s in enumerate(spreads):
+        if (i + 1) % K == 0:
+            assert s < 1e-6, (i, s)
+        else:
+            assert s > 0, (i, s)
+    assert losses[-1] < losses[0]
+
+    # desync once more, then the pass-end sync equalizes replicas
+    batch_recs = [recs[j % len(recs)] for j in range(BATCH)]
+    db = pack_batch_sharded(build_batch(batch_recs, schema), ws, schema, N_DEV, bucket=32)
+    feed = {k: jax.device_put(v, plan.batch_sharding) for k, v in db.as_dict().items()}
+    st, _ = step(st, feed)
+    assert param_spread(st) > 0
+    st = kstep_sync_params(st)
+    assert param_spread(st) < 1e-6
+
+
+def test_async_dense_update_rule():
+    """One pushed grad package must apply the exact reference rule
+    (mom 0.99/0.9999, eps 1e-8, boxps_worker.cc:166-175)."""
+    p0 = {"w": np.full(4, 1.0, np.float32), "b": np.zeros(2, np.float32)}
+    t = AsyncDenseTable(p0, base_lr=0.1, lr_map={"b": 0.5})
+    g = {"w": np.full(4, 2.0, np.float32), "b": np.ones(2, np.float32)}
+    t.push_dense(g)
+    deadline = time.time() + 5
+    while t.n_updates < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    got = t.finalize()
+    m1w, m2w = 0.01 * 2.0, 0.0001 * 4.0
+    want_w = 1.0 - 0.1 * (m1w / (np.sqrt(m2w) + 1e-8))
+    np.testing.assert_allclose(got["w"], np.full(4, want_w), rtol=1e-6)
+    m1b, m2b = 0.01 * 1.0, 0.0001 * 1.0
+    want_b = 0.0 - 0.5 * (m1b / (np.sqrt(m2b) + 1e-8))  # lr_map override
+    np.testing.assert_allclose(got["b"], np.full(2, want_b), rtol=1e-6)
+    with pytest.raises(RuntimeError):
+        t.push_dense(g)
+
+
+def test_async_dense_training(schema, setup):
+    """End-to-end async mode: device pushes grads, host table optimizes."""
+    table, recs, ws, dev_table = setup
+    model = DeepFM(num_slots=NUM_SLOTS, feat_width=LAYOUT.pull_width,
+                   embedx_dim=8, hidden=(16,))
+    dense_opt = optax.adam(1e-2)  # unused by the step in async mode
+    cfg = TrainStepConfig(
+        num_slots=NUM_SLOTS, batch_size=BATCH, layout=LAYOUT, sparse_opt=OPT,
+        auc_buckets=1000, dense_sync_mode="async",
+    )
+    step = jit_train_step(make_train_step(model.apply, dense_opt, cfg))
+    params0 = model.init(jax.random.PRNGKey(0))
+    adt = AsyncDenseTable(params0, base_lr=0.05)
+    st = init_train_state(
+        jnp.asarray(dev_table.reshape(-1, LAYOUT.width)), params0, dense_opt, 1000
+    )
+    losses = []
+    for i in range(24):
+        st = st._replace(params=jax.device_put(adt.pull_dense()))
+        batch_recs = [recs[(i * BATCH + j) % len(recs)] for j in range(BATCH)]
+        db = pack_batch(build_batch(batch_recs, schema), ws, schema, bucket=64)
+        st, m = step(st, {k: jnp.asarray(v) for k, v in db.as_dict().items()})
+        adt.push_dense(jax.tree.map(np.asarray, m["gparams"]))
+        losses.append(float(m["loss"]))
+    final = adt.finalize()
+    assert adt.n_updates > 0
+    # params moved and training improved
+    moved = max(
+        np.abs(np.asarray(a) - np.asarray(b)).max()
+        for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(params0))
+    )
+    assert moved > 1e-4
+    assert np.mean(losses[-6:]) < np.mean(losses[:6])
+
+
+def test_mode_validation():
+    with pytest.raises(ValueError, match="dense_sync_mode"):
+        TrainStepConfig(num_slots=2, batch_size=4, layout=LAYOUT,
+                        dense_sync_mode="k-step")
+    from paddlebox_tpu.models import DeepFM as _D
+    plan = make_mesh(N_DEV)
+    cfg = TrainStepConfig(num_slots=2, batch_size=4, layout=LAYOUT,
+                          dense_sync_mode="async")
+    m = _D(num_slots=2, feat_width=LAYOUT.pull_width, embedx_dim=8, hidden=(4,))
+    with pytest.raises(NotImplementedError):
+        make_sharded_train_step(m.apply, optax.adam(1e-3), cfg, plan)
+    from paddlebox_tpu.train import CTRTrainer
+    with pytest.raises(ValueError, match="AsyncDenseTable"):
+        CTRTrainer(m, cfg)
+
+
+def test_async_lr_map_suffix_matching():
+    p = {"mlp": {"w0": np.zeros(2, np.float32), "w1": np.zeros(2, np.float32)},
+         "w": np.zeros(2, np.float32)}
+    t = AsyncDenseTable(p, base_lr=0.1, lr_map={"w0": 0.5, "mlp/w1": 0.25})
+    try:
+        lrs = dict(zip(["mlp/w0", "mlp/w1", "w"], t._leaf_lr))
+        assert lrs["mlp/w0"] == np.float32(0.5)
+        assert lrs["mlp/w1"] == np.float32(0.25)
+        assert lrs["w"] == np.float32(0.1)  # "w" must NOT match "w0"/"w1"
+    finally:
+        t.finalize()
+
+
+def test_trainer_async_dense_integration(tmp_path, schema):
+    """CTRTrainer drives the pull/push loop itself in async mode."""
+    from paddlebox_tpu.data import BoxPSDataset
+    from paddlebox_tpu.train import CTRTrainer
+
+    rng = np.random.default_rng(5)
+    key_w = rng.normal(size=70) * 1.5
+    lines = []
+    for _ in range(256):
+        ks = rng.integers(1, 65, NUM_SLOTS)
+        lab = 1.0 if key_w[ks].sum() + rng.normal() * 0.3 > 0 else 0.0
+        lines.append(f"1 {lab:.1f} " + " ".join(f"1 {k}" for k in ks))
+    p = tmp_path / "f.txt"
+    p.write_text("\n".join(lines) + "\n")
+
+    table = HostSparseTable(LAYOUT, OPT, n_shards=4)
+    ds = BoxPSDataset(schema, table, batch_size=32, read_threads=1)
+    ds.set_date("20260101")
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+
+    model = DeepFM(num_slots=NUM_SLOTS, feat_width=LAYOUT.pull_width,
+                   embedx_dim=8, hidden=(16,))
+    params0 = model.init(jax.random.PRNGKey(0))
+    adt = AsyncDenseTable(params0, base_lr=0.05)
+    cfg = TrainStepConfig(num_slots=NUM_SLOTS, batch_size=32, layout=LAYOUT,
+                          sparse_opt=OPT, auc_buckets=1000,
+                          dense_sync_mode="async")
+    tr = CTRTrainer(model, cfg, async_dense=adt)
+    tr.params = params0
+    tr.opt_state = tr.dense_opt.init(params0)
+    m = tr.train_pass(ds)
+    assert m["batches"] == 8
+    assert adt.n_updates > 0
+    moved = max(
+        np.abs(np.asarray(a) - np.asarray(b)).max()
+        for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(params0))
+    )
+    assert moved > 1e-5
+    adt.finalize()
+    ds.end_pass(tr.trained_table())
