@@ -17,6 +17,16 @@ from paddlebox_tpu.parallel.sharded_pullpush import (
     sharded_pull,
     sharded_push,
 )
+from paddlebox_tpu.parallel.pipeline import (
+    PipelineSpec,
+    init_pipeline_state,
+    make_pipeline_train_step,
+    pipeline_forward,
+)
+from paddlebox_tpu.parallel.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "MeshPlan",
@@ -25,4 +35,10 @@ __all__ = [
     "put_sharded",
     "sharded_pull",
     "sharded_push",
+    "PipelineSpec",
+    "pipeline_forward",
+    "make_pipeline_train_step",
+    "init_pipeline_state",
+    "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,213 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a 'pp' mesh axis.
+
+TPU-native re-expression of the reference's pipeline stack — PipelineTrainer
++ SectionWorker (trainer.h:281-310, pipeline_trainer.cc:127) run each program
+*section* on its own device, exchange activations with send_v2/recv_v2
+(operators/collective/send_v2_op.cc), and schedule all microbatch forwards,
+then all backwards, then one optimize pass (section_worker.cc:44-119).
+
+Here the same structure compiles into ONE shard_map'd XLA program:
+
+- each mesh position along ``axis_name`` holds ONE stage's params
+  (stacked [n_stages, ...] pytree sharded on the pp axis);
+- activations hop stages via ``lax.ppermute`` (the send_v2/recv_v2 analog,
+  riding ICI) inside a ``lax.scan`` over n_micro + n_stages - 1 ticks —
+  the classic fill/steady/drain rotation;
+- the backward schedule needs no hand-writing: differentiating through the
+  scan + ppermute replays the reverse permutes, which *is* the F-then-B
+  microbatch schedule (with activation rematerialization per microbatch via
+  jax.checkpoint on the stage, matching the reference's per-microbatch
+  scopes rather than storing every stage activation);
+- the optimize pass applies once per (global) batch on each stage's own
+  params — grads never leave their stage, only activations move.
+
+Uniform-stage contract: every stage maps [mb, H] -> [mb, H]. Encoders /
+heads live inside the first/last stage's params (build_mlp_stages pads
+layer widths to H) — the same discipline the reference imposes by cutting
+one program into equal sections.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddlebox_tpu.parallel.mesh import MeshPlan
+
+
+@dataclass(frozen=True)
+class PipelineSpec:
+    n_micro: int  # microbatches per global batch (num_microbatches_ parity)
+    axis_name: str = "pp"
+    remat: bool = True  # re-run stage forward in backward (microbatch scopes)
+
+
+def pipeline_forward(
+    stage_apply: Callable,  # (stage_params, x[mb, H]) -> y[mb, H]
+    spec: PipelineSpec,
+    broadcast: bool = True,
+) -> Callable:
+    """Build ``fn(stage_params, x_micro) -> y_micro`` for use INSIDE a
+    shard_map over the pp axis.
+
+    ``x_micro`` [n_micro, mb, H] is consumed by stage 0. With ``broadcast``
+    the returned ``y_micro`` [n_micro, mb, H] holds the last stage's outputs
+    on EVERY device (masked psum) for uniform loss/metric reads — inference
+    use. For TRAINING use ``broadcast=False`` (outputs stay zero off the
+    last stage) and reduce the loss with a last-stage mask + scalar psum:
+    broadcasting y first would route every stage's loss cotangent back
+    through the psum and scale grads by n_stages.
+    """
+    apply = jax.checkpoint(stage_apply) if spec.remat else stage_apply
+
+    def fn(stage_params: Any, x_micro: jnp.ndarray) -> jnp.ndarray:
+        n = lax.axis_size(spec.axis_name)
+        idx = lax.axis_index(spec.axis_name)
+        M = spec.n_micro
+        T = M + n - 1
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        zero = jnp.zeros_like(x_micro[0])
+
+        def tick(buf, t):
+            # stage 0 consumes microbatch t during the fill+steady window;
+            # later stages consume the rotated buffer
+            feed = lax.dynamic_index_in_dim(
+                x_micro, jnp.minimum(t, M - 1), keepdims=False
+            )
+            x_in = jnp.where((idx == 0) & (t < M), feed, buf)
+            y = apply(stage_params, x_in)
+            # last stage emits microbatch t-(n-1) at tick t
+            out = jnp.where((idx == n - 1) & (t >= n - 1), y, 0.0)
+            return lax.ppermute(y, spec.axis_name, perm), out
+
+        _, outs = lax.scan(tick, zero, jnp.arange(T))
+        y_micro = outs[n - 1 :]  # [M, mb, H], nonzero only on last stage
+        if not broadcast:
+            return y_micro
+        # broadcast last stage's outputs to every stage (masked psum): each
+        # device contributed zeros except the last
+        return lax.psum(y_micro, spec.axis_name)
+
+    return fn
+
+
+def make_pipeline_train_step(
+    stage_apply: Callable,  # (stage_params, x[mb, H]) -> y[mb, H]
+    loss_fn: Callable,  # (y[mb, H], target[mb, ...]) -> scalar mean loss
+    dense_opt: optax.GradientTransformation,
+    spec: PipelineSpec,
+    plan: MeshPlan,
+) -> Callable:
+    """Jitted ``step((params, opt_state), x_micro, targets) ->
+    ((params, opt_state), loss)``.
+
+    ``params``/``opt_state`` are stacked [n_stages, ...] pytrees sharded over
+    the pp axis; ``x_micro`` [n_micro, mb, H] and ``targets`` [n_micro, mb, ...]
+    are replicated (only stage 0 / the loss actually read them).
+    """
+    if spec.axis_name not in plan.mesh.axis_names:
+        raise ValueError(
+            f"PipelineSpec.axis_name {spec.axis_name!r} not a mesh axis "
+            f"{plan.mesh.axis_names}; build the mesh with "
+            f"make_mesh(n, axis={spec.axis_name!r})"
+        )
+    fwd = pipeline_forward(stage_apply, spec, broadcast=False)
+    ax = spec.axis_name
+
+    def local_step(state, x_micro, targets):
+        params, opt_state = state
+        p_local = jax.tree.map(lambda x: x[0], params)
+        o_local = jax.tree.map(lambda x: x[0], opt_state)
+
+        def batch_loss(p):
+            y = fwd(p, x_micro)  # [M, mb, H], zeros off the last stage
+            per_mb = jax.vmap(loss_fn)(y, targets)  # [M]
+            n = lax.axis_size(ax)
+            idx = lax.axis_index(ax)
+            # LOCAL masked loss: only the last stage's output seeds a
+            # cotangent; earlier stages still receive their grads through
+            # the transposed ppermutes. Summing/psum-ing INSIDE the
+            # differentiated function would seed every stage's copy and
+            # scale grads by n_stages (psum's transpose is psum).
+            return jnp.where(idx == n - 1, jnp.mean(per_mb), 0.0)
+
+        loss_local, grads = jax.value_and_grad(batch_loss)(p_local)
+        loss = lax.psum(loss_local, ax)  # reporting only, outside the grad
+        # grads arrive on the stage that owns each parameter (autodiff of
+        # ppermute routes them); the update pass is purely local —
+        # SectionWorker's kOptimize-on-microbatch-0 parity
+        updates, new_opt = dense_opt.update(grads, o_local, p_local)
+        new_p = optax.apply_updates(p_local, updates)
+        new_state = (
+            jax.tree.map(lambda x: x[None], new_p),
+            jax.tree.map(lambda x: x[None], new_opt),
+        )
+        return new_state, loss
+
+    pp = P(ax)
+    rep = P()
+
+    def step(state, x_micro, targets):
+        params, opt_state = state
+        specs_state = (
+            jax.tree.map(lambda _: pp, params),
+            jax.tree.map(lambda _: pp, opt_state),
+        )
+        mapped = jax.shard_map(
+            local_step,
+            mesh=plan.mesh,
+            in_specs=(specs_state, rep, rep),
+            out_specs=(specs_state, rep),
+            check_vma=False,
+        )
+        return mapped(state, x_micro, targets)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def init_pipeline_state(
+    plan: MeshPlan,
+    stage_params: Sequence[Any],  # one pytree per stage, identical structure
+    dense_opt: optax.GradientTransformation,
+) -> Tuple[Any, Any]:
+    """Stack per-stage params along a leading pp-sharded axis + opt state."""
+    n = plan.n_devices
+    if len(stage_params) != n:
+        raise ValueError(f"{len(stage_params)} stages for a {n}-device mesh")
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+    opt0 = jax.vmap(dense_opt.init)(stacked)
+    put = lambda t: jax.device_put(t, plan.batch_sharding)
+    return jax.tree.map(put, stacked), jax.tree.map(put, opt0)
+
+
+# ---- a simple homogeneous MLP stage for models/tests --------------------
+
+
+def mlp_stage_init(rng, hidden: int, layers_per_stage: int, n_stages: int):
+    """Per-stage params for a uniform [mb, H] -> [mb, H] relu MLP pipeline."""
+    out = []
+    for s in range(n_stages):
+        ws, bs = [], []
+        for l in range(layers_per_stage):
+            rng, k = jax.random.split(rng)
+            ws.append(jax.random.normal(k, (hidden, hidden)) * (1.0 / np.sqrt(hidden)))
+            bs.append(jnp.zeros((hidden,)))
+        out.append({"w": jnp.stack(ws), "b": jnp.stack(bs)})
+    return out
+
+
+def mlp_stage_apply(stage_params, x):
+    def layer(h, wb):
+        w, b = wb
+        return jax.nn.relu(h @ w + b), None
+
+    h, _ = lax.scan(layer, x, (stage_params["w"], stage_params["b"]))
+    return h

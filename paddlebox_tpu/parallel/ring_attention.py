@@ -1,0 +1,134 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference (a 2020 CTR stack) has no attention-model sharding — SURVEY.md
+§5 records the absence. This module is the framework's long-context tier,
+new TPU-first scope: attention over sequences longer than one chip's HBM by
+sharding the sequence axis across the mesh.
+
+Two standard schemes (PAPERS.md: Ring Attention / blockwise parallel
+transformers; DeepSpeed-Ulysses):
+
+- ``ring_attention``: q stays put; (k, v) blocks rotate around the ring via
+  ``lax.ppermute`` while a running flash-style log-sum-exp accumulator
+  merges each block's contribution. Communication is neighbor-only (rides
+  ICI), overlapping with the block matmuls; memory is O(S_local).
+
+- ``ulysses_attention``: two ``all_to_all``s re-partition
+  [seq-sharded, all heads] -> [full seq, head-sharded], run exact local
+  attention per head, and swap back. Cheaper compute layout when
+  n_heads >= n_devices; all-to-all traffic instead of neighbor traffic.
+
+Both run INSIDE shard_map over the sequence-parallel axis and are exact
+(not approximations) — verified against single-device full attention in
+tests/test_ring_attention.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30  # finite "-inf": keeps exp()=0 without NaN max/subtraction
+
+
+def _block_scores(q, k, scale):
+    # q [B, Sq, H, D], k [B, Sk, H, D] -> [B, H, Sq, Sk]
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+
+
+def _causal_mask(q_pos, k_pos):
+    # [Sq, Sk] True where attention is allowed (k position <= q position)
+    return q_pos[:, None] >= k_pos[None, :]
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, S_local, H, D] this device's query block
+    k: jnp.ndarray,  # [B, S_local, H, D]
+    v: jnp.ndarray,  # [B, S_local, H, D]
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention over the full (sharded) sequence. [B, S_local, H, D].
+
+    Sequence layout: device i holds global positions
+    [i*S_local, (i+1)*S_local); with ``causal`` the mask applies to global
+    positions, so fully-masked future blocks contribute exactly zero.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = idx * S + jnp.arange(S)
+
+    def body(carry, t):
+        kv, o, m, l = carry  # kv=(k,v) currently held; o/m/l accumulators
+        kt, vt = kv
+        # the block arriving at step t originated on device (idx - t) mod n
+        src = (idx - t) % n
+        s = _block_scores(q, kt, scale)  # [B, H, Sq, Sk]
+        if causal:
+            k_pos = src * S + jnp.arange(S)
+            allowed = _causal_mask(q_pos, k_pos)  # [Sq, Sk]
+            s = jnp.where(allowed[None, None], s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1)  # [B, H, Sq]
+        m_new = jnp.maximum(m, m_blk)
+        # renormalize previous accumulators to the new running max
+        alpha = jnp.exp(m - m_new)  # [B, H, Sq]
+        p = jnp.exp(s - m_new[..., None])  # [B, H, Sq, Sk]
+        if causal:  # exp(NEG_INF - m) underflows to 0 already; keep exact
+            p = jnp.where(allowed[None, None], p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vt)
+        kv_next = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (kt, vt)
+        )
+        return (kv_next, o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((B, H, S, D), q.dtype)
+    m0 = jnp.full((B, H, S), _NEG_INF, q.dtype)
+    l0 = jnp.zeros((B, H, S), q.dtype)
+    (_, o, m, l), _ = lax.scan(body, ((k, v), o0, m0, l0), jnp.arange(n))
+    # l == 0 can only happen for rows with NO allowed keys; causal layouts
+    # always allow self-attention, so guard only against degenerate inputs
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", o)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [B, S_local, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """DeepSpeed-Ulysses style: all_to_all to [full seq, H/n heads], exact
+    attention, all_to_all back. Requires H % axis_size == 0."""
+    n = lax.axis_size(axis_name)
+    B, S, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"n_heads {H} not divisible by axis size {n}")
+    scale = scale if scale is not None else D ** -0.5
+
+    def seq_to_head(x):  # [B, S, H, D] -> [B, S*n, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def head_to_seq(x):  # [B, S*n, H/n, D] -> [B, S, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qf, kf, vf = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    s = _block_scores(qf, kf, scale)  # [B, H/n, S*n, S*n]
+    if causal:
+        Sg = S * n
+        allowed = _causal_mask(jnp.arange(Sg), jnp.arange(Sg))
+        s = jnp.where(allowed[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    of = jnp.einsum("bhqk,bkhd->bqhd", p, vf)  # [B, S*n, H/n, D]
+    return head_to_seq(of)

@@ -67,8 +67,11 @@ class AsyncDenseTable:
         ]
 
         def leaf_lr(path: str) -> float:
-            for k, v in (lr_map or {}).items():
-                if path == k or path.endswith("/" + k):
+            m = lr_map or {}
+            if path in m:  # exact path beats any suffix entry
+                return m[path]
+            for k, v in m.items():
+                if path.endswith("/" + k):
                     return v
             return self.base_lr
 

@@ -250,10 +250,22 @@ def make_sharded_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
-def kstep_sync_params(state: TrainState) -> TrainState:
+def kstep_sync_params(state: TrainState, plan: MeshPlan) -> TrainState:
     """Average the per-device dense replicas of a kstep state (the final
     SyncParam at pass end, boxps_worker.cc:459-461). The mean over the
-    sharded device axis compiles to one all-reduce."""
+    sharded device axis compiles to one all-reduce.
+
+    Only valid on a state built with ``local_dense=True`` — the leading
+    replica axis is checked against the mesh so a replicated ('step'-mode)
+    state can't be silently averaged over its own first parameter dim.
+    """
+    n = plan.n_devices
+    for leaf in jax.tree.leaves(state.params):
+        if leaf.ndim < 1 or leaf.shape[0] != n:
+            raise ValueError(
+                f"param leaf shape {leaf.shape} has no leading [{n}] replica "
+                "axis — kstep_sync_params needs a local_dense/kstep state"
+            )
     avg = jax.tree.map(lambda x: jnp.mean(x, axis=0, keepdims=True), state.params)
     bcast = jax.tree.map(lambda x, a: jnp.broadcast_to(a, x.shape), state.params, avg)
     return state._replace(params=bcast)

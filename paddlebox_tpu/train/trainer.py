@@ -227,7 +227,7 @@ class CTRTrainer:
             # pass-end SyncParam (boxps_worker.cc:459-461), then store the
             # synced params un-stacked; momentum stays device-0's (the
             # reference likewise syncs only the fused param buffer)
-            state = kstep_sync_params(state)
+            state = kstep_sync_params(state, self.plan)
             self.params = jax.tree.map(lambda x: x[0], state.params)
             self.opt_state = jax.tree.map(lambda x: x[0], state.opt_state)
         else:

@@ -120,8 +120,14 @@ def test_kstep_localsgd_mesh(schema, setup):
     feed = {k: jax.device_put(v, plan.batch_sharding) for k, v in db.as_dict().items()}
     st, _ = step(st, feed)
     assert param_spread(st) > 0
-    st = kstep_sync_params(st)
+    st = kstep_sync_params(st, plan)
     assert param_spread(st) < 1e-6
+    # a replicated ('step'-mode) state is rejected, not silently averaged
+    rep_st = init_sharded_train_state(
+        plan, dev_table, model.init(jax.random.PRNGKey(1)), dense_opt, 1000
+    )
+    with pytest.raises(ValueError, match="replica axis"):
+        kstep_sync_params(rep_st, plan)
 
 
 def test_async_dense_update_rule():

@@ -1,0 +1,114 @@
+"""Pipeline parallelism tests (SectionWorker/PipelineTrainer parity).
+
+The pipelined program must be numerically identical to running the stages
+sequentially on one device — the schedule changes wall-clock structure, not
+math (like the reference's sections running one program's pieces).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.parallel import (
+    PipelineSpec,
+    init_pipeline_state,
+    make_mesh,
+    make_pipeline_train_step,
+    pipeline_forward,
+)
+from paddlebox_tpu.parallel.pipeline import mlp_stage_apply, mlp_stage_init
+from jax.sharding import PartitionSpec as P
+
+N_STAGES = 4
+HID = 16
+MB = 8
+M = 6  # microbatches
+
+
+@pytest.fixture(scope="module")
+def stages():
+    return mlp_stage_init(jax.random.PRNGKey(0), HID, layers_per_stage=2,
+                          n_stages=N_STAGES)
+
+
+def sequential_forward(stages, x):
+    for sp in stages:
+        x = mlp_stage_apply(sp, x)
+    return x
+
+
+def test_pipeline_forward_matches_sequential(stages):
+    plan = make_mesh(N_STAGES, axis="pp")
+    spec = PipelineSpec(n_micro=M, axis_name="pp")
+    fwd = pipeline_forward(mlp_stage_apply, spec)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(M, MB, HID)).astype(np.float32))
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+    def run(params, xm):
+        return fwd(jax.tree.map(lambda a: a[0], params), xm)
+
+    mapped = jax.jit(
+        jax.shard_map(
+            run, mesh=plan.mesh,
+            in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(mapped(jax.device_put(stacked, plan.batch_sharding), x))
+    want = np.asarray(jax.vmap(lambda xx: sequential_forward(stages, xx))(x))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_train_matches_sequential(stages):
+    """Grads through ppermute == sequential grads; training converges."""
+    plan = make_mesh(N_STAGES, axis="pp")
+    spec = PipelineSpec(n_micro=M, axis_name="pp")
+    opt = optax.adam(1e-2)
+
+    def loss_fn(y, tgt):
+        return jnp.mean((y - tgt) ** 2)
+
+    step = make_pipeline_train_step(mlp_stage_apply, loss_fn, opt, spec, plan)
+    state = init_pipeline_state(plan, stages, opt)
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(M, MB, HID)).astype(np.float32))
+    tgt = jnp.asarray(np.tanh(rng.normal(size=(M, MB, HID))).astype(np.float32))
+
+    # sequential reference: same loss, same params after one sgd step
+    seq_stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+    def seq_loss(stacked_p):
+        ps = [jax.tree.map(lambda a: a[s], stacked_p) for s in range(N_STAGES)]
+        y = jax.vmap(lambda xx: sequential_forward(ps, xx))(x)
+        return jnp.mean(jax.vmap(loss_fn)(y, tgt))
+
+    l0, g0 = jax.value_and_grad(seq_loss)(seq_stacked)
+    upd, _ = opt.update(g0, opt.init(seq_stacked), seq_stacked)
+    seq_after = optax.apply_updates(seq_stacked, upd)
+
+    state, loss = step(state, x, tgt)
+    np.testing.assert_allclose(float(loss), float(l0), rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(state[0]), jax.tree.leaves(seq_after)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
+
+    # optimization sanity: loss falls steadily (deep relu net memorizing
+    # random targets converges slowly; exact math parity is checked above)
+    losses = [float(loss)]
+    for _ in range(50):
+        state, loss = step(state, x, tgt)
+        losses.append(float(loss))
+    assert losses[-1] < 0.85 * losses[0]
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+
+def test_pipeline_stage_count_mismatch(stages):
+    plan = make_mesh(N_STAGES, axis="pp")
+    with pytest.raises(ValueError, match="stages"):
+        init_pipeline_state(plan, stages[:2], optax.sgd(0.1))
