@@ -1,0 +1,29 @@
+"""fleet: the distributed-strategy / role / launch tier.
+
+TPU-native parity layer for the reference's two fleet stacks:
+
+- fleet v1 (pslib mode, incubate/fleet/parameter_server/pslib/__init__.py:
+  43-761): init/init_worker/stop_worker/save surface over role makers;
+- fleet v2 (python/paddle/distributed/fleet): proto-backed
+  ``DistributedStrategy`` (distributed_strategy.py:101-829) whose flags pick
+  meta-optimizers (a_sync, localsgd, sharding, recompute, amp, pipeline),
+  env-driven ``PaddleCloudRoleMaker`` (role_maker.py:480), and the
+  multiprocess launcher.
+
+Here the strategy flags translate onto the framework's native mechanisms
+(strategy.py), the role maker reads TPU/host env and drives
+``jax.distributed`` (role_maker.py), and ZeRO-1 optimizer-state sharding
+(sharding_optimizer.py parity) is an exact chunked wrapper over any
+elementwise optax optimizer (zero.py).
+"""
+
+from paddlebox_tpu.fleet.strategy import DistributedStrategy
+from paddlebox_tpu.fleet.role_maker import RoleMaker, init_distributed
+from paddlebox_tpu.fleet.zero import Zero1Optimizer
+
+__all__ = [
+    "DistributedStrategy",
+    "RoleMaker",
+    "init_distributed",
+    "Zero1Optimizer",
+]

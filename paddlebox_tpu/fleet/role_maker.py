@@ -1,0 +1,90 @@
+"""Role maker + multi-host bootstrap.
+
+Parity targets: ``PaddleCloudRoleMaker`` reads PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / POD_IP / PADDLE_PORT from the scheduler environment
+(incubate/fleet/base/role_maker.py:480-690); ``MPISymetricRoleMaker`` gets
+the same from mpi4py (:265); Gloo HTTP/HDFS stores provide rendezvous
+(gloo_wrapper.h:136-149).
+
+On TPU the rendezvous/collective bootstrap is ``jax.distributed``
+(coordinator address + process id + process count), after which every
+collective is an XLA op over ICI/DCN — no Gloo/brpc tier. The role maker
+normalizes the env dialects (native JAX vars, TPU metadata, or the
+reference's PADDLE_* names) into (rank, world, coordinator).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class RoleMaker:
+    rank: int  # this process's index (worker_index parity)
+    world: int  # number of processes (worker_num parity)
+    coordinator: Optional[str] = None  # "host:port" of process 0
+
+    @property
+    def is_first_worker(self) -> bool:
+        return self.rank == 0
+
+    def worker_index(self) -> int:
+        return self.rank
+
+    def worker_num(self) -> int:
+        return self.world
+
+    @staticmethod
+    def from_env(env: Optional[dict] = None) -> "RoleMaker":
+        """Resolve rank/world/coordinator from the first env dialect found:
+        JAX native -> reference PADDLE_* -> single-process default."""
+        e = os.environ if env is None else env
+
+        def first(*names, default=None):
+            for n in names:
+                if e.get(n) not in (None, ""):
+                    return e[n]
+            return default
+
+        rank = int(first("JAX_PROCESS_ID", "PADDLE_TRAINER_ID", default="0"))
+        world = int(first("JAX_NUM_PROCESSES", "PADDLE_TRAINERS_NUM", default="1"))
+        coord = first("JAX_COORDINATOR_ADDRESS")
+        if coord is None:
+            ip, port = e.get("POD_IP"), e.get("PADDLE_PORT")
+            if ip and port:
+                coord = f"{ip}:{port}"
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} out of range for world {world}")
+        if world > 1 and coord is None:
+            raise ValueError(
+                "multi-process role needs a coordinator (set "
+                "JAX_COORDINATOR_ADDRESS or POD_IP+PADDLE_PORT)"
+            )
+        return RoleMaker(rank=rank, world=world, coordinator=coord)
+
+
+_initialized = False
+
+
+def init_distributed(role: Optional[RoleMaker] = None) -> RoleMaker:
+    """Bring up the multi-host runtime (fleet.init parity).
+
+    Single-process roles return immediately — local meshes need no
+    rendezvous. Multi-process roles call ``jax.distributed.initialize``,
+    the MPI/Gloo-store replacement: after it, ``jax.devices()`` spans all
+    hosts and mesh collectives ride ICI/DCN.
+    """
+    global _initialized
+    role = role if role is not None else RoleMaker.from_env()
+    if role.world > 1 and not _initialized:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=role.coordinator,
+            num_processes=role.world,
+            process_id=role.rank,
+        )
+        _initialized = True
+    return role

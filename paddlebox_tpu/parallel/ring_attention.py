@@ -36,8 +36,13 @@ _NEG_INF = -1e30  # finite "-inf": keeps exp()=0 without NaN max/subtraction
 
 
 def _block_scores(q, k, scale):
-    # q [B, Sq, H, D], k [B, Sk, H, D] -> [B, H, Sq, Sk]
-    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # q [B, Sq, H, D], k [B, Sk, H, D] -> [B, H, Sq, Sk]; f32 accumulation
+    # keeps the log-sum-exp exact for bf16 inputs (MXU-friendly: bf16 in,
+    # f32 out is the native TPU matmul mode)
+    return (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
 
 
 def _causal_mask(q_pos, k_pos):
@@ -85,20 +90,22 @@ def ring_attention(
         if causal:  # exp(NEG_INF - m) underflows to 0 already; keep exact
             p = jnp.where(allowed[None, None], p, 0.0)
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vt)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vt, preferred_element_type=jnp.float32
+        )
         kv_next = jax.tree.map(
             lambda x: lax.ppermute(x, axis_name, perm), (kt, vt)
         )
         return (kv_next, o_new, m_new, l_new), None
 
-    o0 = jnp.zeros((B, H, S, D), q.dtype)
-    m0 = jnp.full((B, H, S), _NEG_INF, q.dtype)
-    l0 = jnp.zeros((B, H, S), q.dtype)
+    o0 = jnp.zeros((B, H, S, D), jnp.float32)
+    m0 = jnp.full((B, H, S), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
     (_, o, m, l), _ = lax.scan(body, ((k, v), o0, m0, l0), jnp.arange(n))
     # l == 0 can only happen for rows with NO allowed keys; causal layouts
     # always allow self-attention, so guard only against degenerate inputs
     o = o / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.einsum("bhqd->bqhd", o)
+    return jnp.einsum("bhqd->bqhd", o).astype(q.dtype)
 
 
 def ulysses_attention(
@@ -129,6 +136,8 @@ def ulysses_attention(
         Sg = S * n
         allowed = _causal_mask(jnp.arange(Sg), jnp.arange(Sg))
         s = jnp.where(allowed[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    of = jnp.einsum("bhqk,bkhd->bqhd", p, vf)  # [B, S*n, H/n, D]
-    return head_to_seq(of)
+    p = jax.nn.softmax(s, axis=-1)  # f32 (scores accumulate in f32)
+    of = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, vf, preferred_element_type=jnp.float32
+    )  # [B, S*n, H/n, D]
+    return head_to_seq(of.astype(q.dtype))

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
+from paddlebox_tpu.fleet.zero import Zero1Optimizer
 from paddlebox_tpu.metrics.auc import AucState, auc_update
 from paddlebox_tpu.parallel.mesh import MeshPlan, put_replicated, put_sharded
 from paddlebox_tpu.parallel.sharded_pullpush import sharded_pull, sharded_push
@@ -51,6 +52,24 @@ def init_sharded_train_state(
         pos=jnp.zeros((n, auc_buckets), jnp.int32),
         neg=jnp.zeros((n, auc_buckets), jnp.int32),
     )
+    if isinstance(dense_opt, Zero1Optimizer):
+        if local_dense:
+            raise ValueError("ZeRO sharding and kstep local replicas conflict")
+        if dense_opt.n_dev != n:
+            raise ValueError(
+                f"Zero1Optimizer built for {dense_opt.n_dev} devices, mesh has {n}"
+            )
+        # moment chunks live dp-sharded: device i holds 1/n of the state
+        opt_state = (
+            opt_state if opt_state is not None else dense_opt.init_stacked(params)
+        )
+        return TrainState(
+            table=put_sharded(plan, table),
+            params=put_replicated(plan, params),
+            opt_state=jax.device_put(opt_state, plan.batch_sharding),
+            auc=put_sharded(plan, auc),
+            step=put_replicated(plan, jnp.zeros((), jnp.int32)),
+        )
     opt_state = opt_state if opt_state is not None else dense_opt.init(params)
     if local_dense:
         # K-step mode: every device carries its OWN dense params between
@@ -98,6 +117,16 @@ def make_sharded_train_step(
             "dense_sync_mode='async' (host AsyncDenseTable) is a "
             "single-device worker mode; on a mesh use 'step' or 'kstep'"
         )
+    is_zero = isinstance(dense_opt, Zero1Optimizer)
+    if is_zero and cfg.dense_sync_mode == "kstep":
+        raise ValueError(
+            "ZeRO state sharding needs identical (replicated) grads each "
+            "step; kstep's local grads would diverge the chunks"
+        )
+    if is_zero and dense_opt.axis_name != plan.axis:
+        raise ValueError(
+            f"Zero1Optimizer axis {dense_opt.axis_name!r} != mesh axis {plan.axis!r}"
+        )
     lay, opt = cfg.layout, cfg.sparse_opt
     S, b = cfg.num_slots, cfg.batch_size
     ax = plan.axis
@@ -142,12 +171,15 @@ def make_sharded_train_step(
         else:
             loss_denom = None
             grad_div = float(plan.n_devices)
-        # kstep keeps per-device dense replicas: strip their device axis
+        # kstep keeps per-device dense replicas, zero keeps per-device
+        # moment chunks: both strip their leading device axis here
         params = (
             jax.tree.map(lambda x: x[0], state.params) if kstep else state.params
         )
         opt_state = (
-            jax.tree.map(lambda x: x[0], state.opt_state) if kstep else state.opt_state
+            jax.tree.map(lambda x: x[0], state.opt_state)
+            if (kstep or is_zero)
+            else state.opt_state
         )
         loss, preds, gparams, gflat = local_forward_backward(
             model_apply, cfg, params, flat, segments, labels, dense,
@@ -188,7 +220,15 @@ def make_sharded_train_step(
         else:
             gparams = jax.lax.pmean(gparams, ax)
             loss = jax.lax.pmean(loss, ax)
-        updates, new_opt_state = dense_opt.update(gparams, opt_state, params)
+        if is_zero:
+            # each device updates its 1/n chunk, all_gather rebuilds the
+            # full update (sharding meta-optimizer parity)
+            updates, new_opt_state = dense_opt.update_local(
+                gparams, opt_state, params
+            )
+            new_opt_state = jax.tree.map(lambda x: x[None], new_opt_state)
+        else:
+            updates, new_opt_state = dense_opt.update(gparams, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         if kstep:
             # average params across the mesh every K steps (SyncParam scale
@@ -226,9 +266,13 @@ def make_sharded_train_step(
 
     dp = P(ax)
     rep = P()
-    dense_spec = dp if cfg.dense_sync_mode == "kstep" else rep
+    kstep_mode = cfg.dense_sync_mode == "kstep"
     state_specs = TrainState(
-        table=dp, params=dense_spec, opt_state=dense_spec, auc=dp, step=rep
+        table=dp,
+        params=dp if kstep_mode else rep,
+        opt_state=dp if (kstep_mode or is_zero) else rep,
+        auc=dp,
+        step=rep,
     )
 
     def batch_specs(batch):

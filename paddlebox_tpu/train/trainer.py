@@ -25,6 +25,7 @@ import numpy as np
 import optax
 
 from paddlebox_tpu.data.dataset import BoxPSDataset
+from paddlebox_tpu.fleet.zero import Zero1Optimizer
 from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
 from paddlebox_tpu.metrics.auc import auc_compute, auc_init
 from paddlebox_tpu.metrics.registry import MetricRegistry
@@ -60,6 +61,11 @@ class CTRTrainer:
         self.dense_opt = dense_opt or optax.adam(1e-3)
         self.plan = plan
         self.async_dense = async_dense
+        if isinstance(self.dense_opt, Zero1Optimizer) and plan is None:
+            raise ValueError(
+                "Zero1Optimizer (sharding strategy) needs a mesh plan — its "
+                "optimizer state lives sharded across devices"
+            )
         if cfg.dense_sync_mode == "async":
             if async_dense is None:
                 raise ValueError(
@@ -88,7 +94,12 @@ class CTRTrainer:
     def init_params(self, rng=None) -> None:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.params = self.model.init(rng)
-        self.opt_state = self.dense_opt.init(self.params)
+        if isinstance(self.dense_opt, Zero1Optimizer):
+            # chunked state is built (and placed sharded) by
+            # init_sharded_train_state at pass start
+            self.opt_state = None
+        else:
+            self.opt_state = self.dense_opt.init(self.params)
 
     def save_dense(self, path: str) -> None:
         """Dense checkpoint (worker-scope param dump parity,
@@ -106,6 +117,15 @@ class CTRTrainer:
         path = path if path.endswith(".npz") else path + ".npz"
         data = np.load(path, allow_pickle=False)
         leaves, treedef = jax.tree.flatten((self.params, self.opt_state))
+        n_saved = sum(1 for k in data.files if k.startswith("leaf_"))
+        if n_saved != len(leaves):
+            raise ValueError(
+                f"checkpoint holds {n_saved} leaves but the current "
+                f"(params, opt_state) tree has {len(leaves)} — optimizer "
+                "state mismatch (e.g. ZeRO chunked state not yet built: "
+                "restore it with the same opt_state structure it was saved "
+                "with, or load before switching optimizers)"
+            )
         loaded = [jnp.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))]
         for a, b in zip(leaves, loaded):
             if a.shape != b.shape:

@@ -105,3 +105,30 @@ def test_ulysses_head_divisibility():
             local, mesh=plan.mesh, in_specs=(P(None, "sp"),),
             out_specs=P(None, "sp"), check_vma=False,
         )(shard_seq(plan, jnp.tile(x, (1, N_DEV, 1, 1))[:, : S_LOC * N_DEV]))
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_bf16_inputs_accumulate_in_f32(impl):
+    """bf16 q/k/v must stay close to the f32 reference (f32 accumulators)."""
+    plan = make_mesh(N_DEV, axis="sp")
+    q, k, v = make_qkv(3)
+    fn = ring_attention if impl == "ring" else ulysses_attention
+
+    def local(ql, kl, vl):
+        return fn(ql, kl, vl, "sp", causal=True)
+
+    mapped = jax.jit(
+        jax.shard_map(
+            local, mesh=plan.mesh,
+            in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    got = mapped(shard_seq(plan, qb), shard_seq(plan, kb), shard_seq(plan, vb))
+    assert got.dtype == jnp.bfloat16
+    want = np.asarray(full_attention(q, k, v, True))
+    # error budget = bf16 input rounding only, not n_dev-compounded
+    # accumulator drift
+    err = np.abs(np.asarray(got, dtype=np.float32) - want).max()
+    assert err < 0.02, err
