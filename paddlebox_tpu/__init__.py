@@ -29,3 +29,4 @@ device hash tables, no dynamic shapes, XLA-friendly end to end.
 __version__ = "0.1.0"
 
 from paddlebox_tpu import config  # noqa: F401
+from paddlebox_tpu.boxps import BoxWrapper  # noqa: F401  (reference façade)

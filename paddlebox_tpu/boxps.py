@@ -1,0 +1,109 @@
+"""BoxWrapper façade: the reference's singleton surface in one object.
+
+For users coming from the reference, ``core.BoxWrapper`` is the center of
+the world (box_wrapper.h:362-774, pybind box_helper_py.cc:40-140): it owns
+the sparse model, the pass/phase machinery, the metric registry, and model
+publishing. This framework deliberately decomposes those into table/,
+metrics/, data/, and train/ — this façade packages them back behind the
+familiar names so migration is mechanical:
+
+    box = BoxWrapper(embedx_dim=16)                    # SetInstance parity
+    ds = box.make_dataset(schema, batch_size=4096)     # BoxPSDataset
+    box.init_metric("join_auc", phase=1)               # init_metric parity
+    ... pass loop via ds.begin_pass()/trainer/ds.end_pass() ...
+    box.save_base("ckpt", date)                        # SaveBase parity
+    box.get_metric_msg("join_auc")
+
+Everything here delegates; no behavior lives in the façade.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+from paddlebox_tpu.metrics.registry import MetricRegistry
+from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
+from paddlebox_tpu.table.sparse_table import HostSparseTable
+from paddlebox_tpu.table.value_layout import FeatureType, ValueLayout
+from paddlebox_tpu.train.checkpoint import CheckpointManager
+
+
+class BoxWrapper:
+    """One process's sparse model + phases + metrics + publishing."""
+
+    def __init__(
+        self,
+        embedx_dim: int = 8,
+        expand_embed_dim: int = 0,
+        feature_type: FeatureType = FeatureType.PLAIN,
+        pull_embedx_scale: float = 1.0,
+        sparse_opt: Optional[SparseOptimizerConfig] = None,
+        n_host_shards: int = 64,
+        seed: int = 0,
+    ):
+        self.layout = ValueLayout(
+            embedx_dim=embedx_dim,
+            expand_embed_dim=expand_embed_dim,
+            feature_type=feature_type,
+        )
+        self.pull_embedx_scale = pull_embedx_scale
+        self.sparse_opt = sparse_opt or SparseOptimizerConfig()
+        self.table = HostSparseTable(
+            self.layout, self.sparse_opt, n_shards=n_host_shards, seed=seed
+        )
+        self.metrics = MetricRegistry()
+        # two-phase join/update machinery (box_wrapper.h:620-622)
+        self.phase = 1
+        self.phase_num = 2
+        self.test_mode = False
+        self._ckpt: Optional[CheckpointManager] = None
+
+    # ---- phase machinery -------------------------------------------------
+
+    def flip_phase(self) -> int:
+        """FlipPhase parity: 1 (join) <-> 0 (update)."""
+        self.phase ^= 1
+        return self.phase
+
+    def set_test_mode(self, on: bool = True) -> None:
+        """SetTestMode parity (box_wrapper.cc:623): eval without pushes —
+        trainers should skip writeback when set."""
+        self.test_mode = on
+
+    # ---- dataset ---------------------------------------------------------
+
+    def make_dataset(self, schema, batch_size: int, **kw) -> "BoxPSDataset":
+        """BoxPSDataset bound to this wrapper's table (DatasetFactory +
+        BoxHelper binding parity)."""
+        from paddlebox_tpu.data.dataset import BoxPSDataset
+
+        return BoxPSDataset(schema, self.table, batch_size=batch_size, **kw)
+
+    # ---- metrics (init_metric/get_metric_msg parity, box_helper_py.cc:87-97)
+
+    def init_metric(self, name: str, **kw) -> None:
+        self.metrics.init_metric(name=name, **kw)
+
+    def get_metric_msg(self, name: str) -> str:
+        return self.metrics.get_metric_msg(name)
+
+    def get_metric(self, name: str) -> Dict[str, float]:
+        return self.metrics.get_metric(name)
+
+    # ---- model publishing (SaveBase/SaveDelta/load parity) ---------------
+
+    def checkpoint_manager(self, root: str) -> CheckpointManager:
+        if self._ckpt is None or self._ckpt.root != root:
+            self._ckpt = CheckpointManager(root)
+        return self._ckpt
+
+    def save_base(self, root: str, date: str, trainer=None) -> str:
+        return self.checkpoint_manager(root).save_base(date, self.table, trainer)
+
+    def save_delta(self, root: str, date: str, trainer=None) -> str:
+        return self.checkpoint_manager(root).save_delta(date, self.table, trainer)
+
+    def load_model(self, root: str, trainer=None):
+        """Day-level resume (InitializeGPUAndLoadModel + LoadSSD2Mem parity):
+        newest base + its deltas into the table, dense into the trainer."""
+        return self.checkpoint_manager(root).resume(self.table, trainer)

@@ -2,6 +2,7 @@ from paddlebox_tpu.data.slot_schema import SlotSchema, SlotInfo
 from paddlebox_tpu.data.slot_record import SlotRecord, SlotBatch, build_batch
 from paddlebox_tpu.data.parser import parse_line, parse_logkey
 from paddlebox_tpu.data.dataset import BoxPSDataset, LocalShuffleRouter
+from paddlebox_tpu.data.data_generator import DataGenerator, MultiSlotDataGenerator
 from paddlebox_tpu.data.pv_instance import (
     PvInstance,
     build_rank_offset,
@@ -17,6 +18,8 @@ __all__ = [
     "SlotBatch",
     "build_batch",
     "parse_line",
+    "DataGenerator",
+    "MultiSlotDataGenerator",
     "parse_logkey",
     "BoxPSDataset",
     "LocalShuffleRouter",

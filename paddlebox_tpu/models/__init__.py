@@ -1,6 +1,8 @@
 from paddlebox_tpu.models.layers import mlp_init, mlp_apply, linear_init, linear_apply
 from paddlebox_tpu.models.lr import LogisticRegression
 from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.models.wide_deep import WideDeep, DCN
+from paddlebox_tpu.models.mmoe import MMoE, task_head
 
 __all__ = [
     "mlp_init",
@@ -9,4 +11,8 @@ __all__ = [
     "linear_apply",
     "LogisticRegression",
     "DeepFM",
+    "WideDeep",
+    "DCN",
+    "MMoE",
+    "task_head",
 ]

@@ -1,0 +1,105 @@
+"""MMoE: multi-gate mixture-of-experts for multi-task CTR.
+
+The reference's two-phase join/update training often carries multi-task
+heads (click, conversion/PCOC q-values — cvm_offset 8 layouts) over shared
+embeddings; MMoE is the standard dense tower for that (SURVEY.md §7 step 10
+"MMoE/multi-phase"). Experts are one batched [E, in, h] matmul (vmapped —
+one MXU call, not E small ones); per-task softmax gates mix expert outputs.
+
+``apply`` returns [B, n_tasks] logits; single-task users take ``[:, 0]`` or
+wrap with ``task_head(model, i)`` to fit the scalar-logit train step.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import linear_apply, linear_init, mlp_apply, mlp_init
+
+
+class MMoE:
+    def __init__(
+        self,
+        num_slots: int,
+        feat_width: int,
+        dense_dim: int = 0,
+        n_experts: int = 4,
+        n_tasks: int = 2,
+        expert_hidden: Sequence[int] = (128, 64),
+        tower_hidden: Sequence[int] = (32,),
+    ):
+        self.num_slots = num_slots
+        self.feat_width = feat_width
+        self.dense_dim = dense_dim
+        self.n_experts = n_experts
+        self.n_tasks = n_tasks
+        self.expert_hidden = tuple(expert_hidden)
+        self.tower_hidden = tuple(tower_hidden)
+        self.in_dim = num_slots * feat_width + dense_dim
+
+    def init(self, rng):
+        keys = jax.random.split(rng, self.n_experts + 2 * self.n_tasks + 1)
+        experts = [
+            mlp_init(keys[e], self.in_dim, self.expert_hidden)
+            for e in range(self.n_experts)
+        ]
+        # stack expert layers: list over depth of {"w": [E,i,o], "b": [E,o]}
+        stacked = [
+            {
+                "w": jnp.stack([experts[e][l]["w"] for e in range(self.n_experts)]),
+                "b": jnp.stack([experts[e][l]["b"] for e in range(self.n_experts)]),
+            }
+            for l in range(len(self.expert_hidden))
+        ]
+        gates = [
+            linear_init(keys[self.n_experts + t], self.in_dim, self.n_experts)
+            for t in range(self.n_tasks)
+        ]
+        towers = []
+        for t in range(self.n_tasks):
+            k = keys[self.n_experts + self.n_tasks + t]
+            k1, k2 = jax.random.split(k)
+            towers.append(
+                {
+                    "mlp": mlp_init(k1, self.expert_hidden[-1], self.tower_hidden),
+                    "out": linear_init(k2, self.tower_hidden[-1], 1),
+                }
+            )
+        return {"experts": stacked, "gates": gates, "towers": towers}
+
+    def apply(self, params, slot_feats, dense=None):
+        B = slot_feats.shape[0]
+        x = slot_feats.reshape(B, -1)
+        if self.dense_dim and dense is not None:
+            x = jnp.concatenate([x, dense], axis=1)
+
+        # all experts in one batched matmul chain: h [E, B, h_l]
+        h = jnp.broadcast_to(x[None], (self.n_experts,) + x.shape)
+        for l, layer in enumerate(params["experts"]):
+            h = jnp.einsum("ebi,eio->ebo", h, layer["w"]) + layer["b"][:, None]
+            h = jax.nn.relu(h)
+        expert_out = jnp.einsum("ebh->beh", h)  # [B, E, h]
+
+        logits = []
+        for t in range(self.n_tasks):
+            g = jax.nn.softmax(linear_apply(params["gates"][t], x), axis=-1)  # [B, E]
+            mixed = jnp.einsum("be,beh->bh", g, expert_out)
+            ht = mlp_apply(params["towers"][t]["mlp"], mixed, final_activation=True)
+            logits.append(linear_apply(params["towers"][t]["out"], ht)[:, 0])
+        return jnp.stack(logits, axis=1)  # [B, n_tasks]
+
+
+def task_head(model: MMoE, task: int):
+    """Adapter: scalar-logit view of one task for the CTR train step."""
+
+    class _Head:
+        def init(self, rng):
+            return model.init(rng)
+
+        def apply(self, params, slot_feats, dense=None):
+            return model.apply(params, slot_feats, dense)[:, task]
+
+    return _Head()

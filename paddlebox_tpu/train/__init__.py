@@ -5,6 +5,7 @@ from paddlebox_tpu.train.sharded_step import (
     make_sharded_train_step,
 )
 from paddlebox_tpu.train.async_dense import AsyncDenseTable
+from paddlebox_tpu.train.checkpoint import CheckpointManager
 from paddlebox_tpu.train.trainer import CTRTrainer
 
 __all__ = [
@@ -16,4 +17,5 @@ __all__ = [
     "make_sharded_train_step",
     "AsyncDenseTable",
     "CTRTrainer",
+    "CheckpointManager",
 ]

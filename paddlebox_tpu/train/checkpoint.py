@@ -1,0 +1,111 @@
+"""CheckpointManager: base+delta model publishing and day-level resume.
+
+The reference checkpoints in the MODEL domain, not the tensor domain
+(SURVEY.md §5): BoxPS ``SaveBase(path, date)`` writes the full sparse model,
+``SaveDelta`` writes keys touched since the last save (box_wrapper.cc:
+1288-1331, driven per pass via end_pass(need_save_delta)), dense params dump
+from the worker scope at Finalize (boxps_trainer.cc:123-131), and resume is
+``InitializeGPUAndLoadModel(model_path)`` + day staging (:1205, :1325).
+
+Directory layout managed here:
+
+    root/
+      cursor.json                  {"date", "delta_idx"} — last durable state
+      <date>/base/                 full sparse snapshot (HostSparseTable dir)
+      <date>/delta-NNNN/           touched-keys snapshots, applied in order
+      <date>/dense.npz             dense params + optimizer state
+
+``resume()`` rebuilds the newest durable state: load the cursor date's base,
+apply its deltas in order, restore dense — then training re-enters at the
+next pass with deterministic file striping (the reference's day-level
+re-entry model).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from paddlebox_tpu.table.sparse_table import HostSparseTable
+
+
+class CheckpointManager:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    # ---- paths -----------------------------------------------------------
+
+    def _day(self, date: str) -> str:
+        return os.path.join(self.root, date)
+
+    def _cursor_path(self) -> str:
+        return os.path.join(self.root, "cursor.json")
+
+    def cursor(self) -> Optional[Dict[str, Any]]:
+        p = self._cursor_path()
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f)
+
+    def _write_cursor(self, date: str, delta_idx: int) -> None:
+        tmp = self._cursor_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"date": date, "delta_idx": delta_idx}, f)
+        os.replace(tmp, self._cursor_path())  # atomic: crash-safe cursor
+
+    # ---- save ------------------------------------------------------------
+
+    def save_base(self, date: str, table: HostSparseTable, trainer=None) -> str:
+        """Full sparse snapshot + dense (SaveBase parity). Resets the day's
+        delta counter — deltas are relative to this base."""
+        day = self._day(date)
+        table.save_base(os.path.join(day, "base"))
+        if trainer is not None:
+            trainer.save_dense(os.path.join(day, "dense"))
+        self._write_cursor(date, delta_idx=0)
+        return os.path.join(day, "base")
+
+    def save_delta(self, date: str, table: HostSparseTable, trainer=None) -> str:
+        """Touched-keys snapshot (SaveDelta / xbox online-publish parity).
+
+        Requires a base for ``date`` (deltas apply on top of it in order).
+        """
+        cur = self.cursor()
+        if cur is None or cur["date"] != date:
+            raise RuntimeError(
+                f"no base saved for date {date!r} — save_base first "
+                "(deltas are relative to a base)"
+            )
+        idx = cur["delta_idx"] + 1
+        day = self._day(date)
+        path = os.path.join(day, f"delta-{idx:04d}")
+        table.save_delta(path)
+        if trainer is not None:
+            trainer.save_dense(os.path.join(day, "dense"))
+        self._write_cursor(date, delta_idx=idx)
+        return path
+
+    # ---- resume ----------------------------------------------------------
+
+    def resume(self, table: HostSparseTable, trainer=None) -> Optional[Dict[str, Any]]:
+        """Rebuild the newest durable state into ``table`` (+ trainer dense).
+
+        Returns the cursor ({"date", "delta_idx"}) or None when nothing was
+        ever saved (cold start).
+        """
+        cur = self.cursor()
+        if cur is None:
+            return None
+        day = self._day(cur["date"])
+        table.load(os.path.join(day, "base"))
+        for i in range(1, cur["delta_idx"] + 1):
+            table.apply_delta(os.path.join(day, f"delta-{i:04d}"))
+        dense = os.path.join(day, "dense.npz")
+        if trainer is not None and os.path.exists(dense):
+            if trainer.params is None:
+                trainer.init_params()
+            trainer.load_dense(dense)
+        return cur

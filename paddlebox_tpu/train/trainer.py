@@ -103,17 +103,27 @@ class CTRTrainer:
 
     def save_dense(self, path: str) -> None:
         """Dense checkpoint (worker-scope param dump parity,
-        boxps_trainer.cc:123-131)."""
+        boxps_trainer.cc:123-131). Written tmp-then-rename so a crash
+        mid-write can't corrupt the checkpoint a cursor already points to."""
         path = path if path.endswith(".npz") else path + ".npz"
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         leaves, treedef = jax.tree.flatten((self.params, self.opt_state))
-        np.savez_compressed(
-            path, treedef=str(treedef), **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
-        )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f,
+                treedef=str(treedef),
+                **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+            )
+        os.replace(tmp, path)
 
     def load_dense(self, path: str) -> None:
         if self.params is None:
             raise RuntimeError("init_params first (defines the tree structure)")
+        if self.opt_state is None and isinstance(self.dense_opt, Zero1Optimizer):
+            # rebuild the chunked-state structure so the checkpoint's zero
+            # moment leaves have somewhere to land (fresh-process resume)
+            self.opt_state = self.dense_opt.init_stacked(self.params)
         path = path if path.endswith(".npz") else path + ".npz"
         data = np.load(path, allow_pickle=False)
         leaves, treedef = jax.tree.flatten((self.params, self.opt_state))

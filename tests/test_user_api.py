@@ -1,0 +1,292 @@
+"""User-API tier: DataGenerator protocol, CheckpointManager day resume,
+BoxWrapper façade, model zoo (WideDeep/DCN/MMoE) trainability."""
+
+import io
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu import BoxWrapper
+from paddlebox_tpu.data import (
+    BoxPSDataset,
+    MultiSlotDataGenerator,
+    SlotInfo,
+    SlotSchema,
+)
+from paddlebox_tpu.models import DCN, MMoE, WideDeep, task_head
+from paddlebox_tpu.table import HostSparseTable, SparseOptimizerConfig, ValueLayout
+from paddlebox_tpu.train import CheckpointManager, CTRTrainer, TrainStepConfig
+
+NUM_SLOTS = 4
+LAYOUT = ValueLayout(embedx_dim=8)
+OPT = SparseOptimizerConfig(
+    embed_lr=0.2, embedx_lr=0.2, embedx_threshold=0.0, initial_range=0.01,
+    show_clk_decay=1.0, shrink_threshold=0.0,
+)
+
+
+def make_schema():
+    return SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NUM_SLOTS)],
+        label_slot="label",
+    )
+
+
+# ---- data generator -----------------------------------------------------
+
+class MyGen(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def it():
+            if line is None:
+                return
+            toks = line.split(",")
+            yield [("label", [float(toks[0])])] + [
+                (f"s{i}", [int(t)]) for i, t in enumerate(toks[1:])
+            ]
+
+        return it
+
+
+def test_data_generator_pipe_protocol(tmp_path):
+    """Raw csv -> generator -> slot protocol -> parse_line round trip."""
+    gen = MyGen()
+    raw = io.StringIO("1.0,7,8,9,10\n0.0,11,12,13,14\n")
+    out = io.StringIO()
+    n = gen.run_from_stdin(stdin=raw, stdout=out)
+    assert n == 2
+    lines = out.getvalue().strip().split("\n")
+    assert lines[0] == "1 1.0 1 7 1 8 1 9 1 10"
+
+    from paddlebox_tpu.data.parser import parse_line
+
+    schema = make_schema()
+    rec = parse_line(lines[0], schema)
+    assert rec.slot_floats(0)[0] == 1.0
+    assert list(rec.slot_keys(0)) == [7]
+
+    # protocol violations raise
+    bad = MyGen()
+    with pytest.raises(ValueError, match="no values"):
+        bad._gen_str([("label", [])])
+    good = MyGen()
+    good._gen_str([("a", [1]), ("b", [2])])
+    with pytest.raises(ValueError, match="slots"):
+        good._gen_str([("a", [1])])
+    with pytest.raises(ValueError, match="order"):
+        good._gen_str([("b", [1]), ("a", [2])])
+    with pytest.raises(ValueError, match="float"):
+        good._gen_str([("a", [1.5]), ("b", [2])])
+
+
+# ---- checkpoint manager -------------------------------------------------
+
+def _write_day(tmp, rng, name, n=128):
+    key_w = rng.normal(size=60) * 1.5
+    lines = []
+    for _ in range(n):
+        ks = rng.integers(1, 55, NUM_SLOTS)
+        lab = 1.0 if key_w[ks].sum() + rng.normal() * 0.3 > 0 else 0.0
+        lines.append(f"1 {lab:.1f} " + " ".join(f"1 {k}" for k in ks))
+    p = os.path.join(tmp, name)
+    open(p, "w").write("\n".join(lines) + "\n")
+    return p
+
+
+def test_checkpoint_day_resume(tmp_path):
+    schema = make_schema()
+    rng = np.random.default_rng(9)
+    f1 = _write_day(str(tmp_path), rng, "d1.txt")
+    f2 = _write_day(str(tmp_path), rng, "d2.txt")
+
+    def build():
+        table = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+        ds = BoxPSDataset(schema, table, batch_size=32, read_threads=1)
+        from paddlebox_tpu.models import DeepFM
+
+        model = DeepFM(num_slots=NUM_SLOTS, feat_width=LAYOUT.pull_width,
+                       embedx_dim=8, hidden=(16,))
+        cfg = TrainStepConfig(num_slots=NUM_SLOTS, batch_size=32, layout=LAYOUT,
+                              sparse_opt=OPT, auc_buckets=1000)
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+        return table, ds, tr
+
+    root = str(tmp_path / "ckpt")
+    table, ds, tr = build()
+    cm = CheckpointManager(root)
+    assert cm.resume(table, tr) is None  # cold start
+
+    def run_pass(ds, tr, f, date):
+        ds.set_date(date)
+        ds.set_filelist([f])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=32)
+        tr.train_pass(ds)
+        ds.end_pass(tr.trained_table(), shrink=False)
+
+    run_pass(ds, tr, f1, "20260101")
+    cm.save_base("20260101", table, tr)
+    run_pass(ds, tr, f2, "20260101")
+    cm.save_delta("20260101", table, tr)
+
+    # delta without base for a new date is rejected
+    with pytest.raises(RuntimeError, match="base"):
+        cm.save_delta("20260102", table, tr)
+
+    # fresh process: resume == original state
+    table2, ds2, tr2 = build()
+    cur = CheckpointManager(root).resume(table2, tr2)
+    assert cur == {"date": "20260101", "delta_idx": 1}
+    keys = np.array(sorted(
+        k for s in table._shards for k in s.index
+    ), dtype=np.uint64)[:200]
+    np.testing.assert_allclose(
+        table2.pull_or_create(keys), table.pull_or_create(keys), rtol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(tr2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    # resumed trainer continues training
+    run_pass(ds2, tr2, f2, "20260102")
+
+
+# ---- boxps façade -------------------------------------------------------
+
+def test_boxwrapper_facade(tmp_path):
+    box = BoxWrapper(embedx_dim=8, sparse_opt=OPT, n_host_shards=4)
+    assert box.phase == 1
+    assert box.flip_phase() == 0 and box.flip_phase() == 1
+    box.set_test_mode()
+    assert box.test_mode
+
+    schema = make_schema()
+    rng = np.random.default_rng(3)
+    f = _write_day(str(tmp_path), rng, "d.txt", n=64)
+    ds = box.make_dataset(schema, batch_size=32, read_threads=1)
+    assert ds.table is box.table
+    ds.set_date("20260101")
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+
+    box.init_metric("join_auc", phase=1)
+    preds = jnp.asarray(rng.uniform(size=64).astype(np.float32))
+    labels = (preds > 0.5).astype(jnp.float32)  # perfectly separable
+    box.metrics.add_all({"preds": preds, "labels": labels}, phase=1)
+    # get_metric_msg reads AND resets (GetMetricMsg contract)
+    msg = box.get_metric_msg("join_auc")
+    assert "AUC=1.0" in msg, msg
+    assert box.get_metric("join_auc")["ins_num"] == 0  # reset happened
+
+    ds.end_pass(None, shrink=False)
+    box.save_base(str(tmp_path / "m"), "20260101")
+    box2 = BoxWrapper(embedx_dim=8, sparse_opt=OPT, n_host_shards=4)
+    assert box2.load_model(str(tmp_path / "m"))["date"] == "20260101"
+    assert len(box2.table) == len(box.table)
+
+
+# ---- model zoo ----------------------------------------------------------
+
+@pytest.mark.parametrize("model_fn", [
+    lambda: WideDeep(num_slots=NUM_SLOTS, feat_width=LAYOUT.pull_width, hidden=(16,)),
+    lambda: DCN(num_slots=NUM_SLOTS, feat_width=LAYOUT.pull_width, n_cross=2, hidden=(16,)),
+    lambda: task_head(MMoE(num_slots=NUM_SLOTS, feat_width=LAYOUT.pull_width,
+                           n_experts=3, n_tasks=2, expert_hidden=(16,),
+                           tower_hidden=(8,)), task=0),
+])
+def test_model_zoo_trains(model_fn, tmp_path):
+    from test_train_step import synth_records
+    from paddlebox_tpu.data.device_pack import pack_batch
+    from paddlebox_tpu.data.slot_record import build_batch
+    from paddlebox_tpu.table import PassWorkingSet
+    from paddlebox_tpu.train.train_step import (
+        init_train_state,
+        jit_train_step,
+        make_train_step,
+    )
+
+    schema = make_schema()
+    rng = np.random.default_rng(1)
+    table = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    recs = synth_records(rng, 32 * 6, schema)
+    ws = PassWorkingSet()
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev = ws.finalize(table, round_to=32)
+
+    model = model_fn()
+    cfg = TrainStepConfig(num_slots=NUM_SLOTS, batch_size=32, layout=LAYOUT,
+                          sparse_opt=OPT, auc_buckets=1000)
+    opt = optax.adam(1e-2)
+    step = jit_train_step(make_train_step(model.apply, opt, cfg))
+    st = init_train_state(jnp.asarray(dev.reshape(-1, LAYOUT.width)),
+                          model.init(jax.random.PRNGKey(0)), opt, 1000)
+    losses = []
+    for i in range(30):
+        br = [recs[(i * 32 + j) % len(recs)] for j in range(32)]
+        db = pack_batch(build_batch(br, schema), ws, schema, bucket=64)
+        st, m = step(st, {k: jnp.asarray(v) for k, v in db.as_dict().items()})
+        losses.append(float(m["loss"]))
+    assert losses[-1] < 0.8 * losses[0], losses[::10]
+
+
+def test_data_generator_numpy_floats_and_precision():
+    g = MultiSlotDataGenerator()
+    line = g._gen_str([("label", [np.float32(0.5)]), ("w", [0.12345678])])
+    toks = line.split()
+    assert toks[1] == "0.5" and float(toks[3]) == 0.12345678
+
+
+def test_zero_checkpoint_fresh_process_resume(tmp_path):
+    """Train with ZeRO, checkpoint, restore into a fresh trainer."""
+    from paddlebox_tpu.fleet import Zero1Optimizer
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import make_mesh
+    from test_train_step import synth_records
+    from paddlebox_tpu.table import PassWorkingSet
+
+    schema = make_schema()
+    N_DEV = 8
+    plan = make_mesh(N_DEV)
+    rng = np.random.default_rng(13)
+    table = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    recs = synth_records(rng, 64 * 2, schema)
+    ws = PassWorkingSet(n_mesh_shards=N_DEV)
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev = ws.finalize(table, round_to=32)
+
+    def build():
+        model = DeepFM(num_slots=NUM_SLOTS, feat_width=LAYOUT.pull_width,
+                       embedx_dim=8, hidden=(16,))
+        zero = Zero1Optimizer(optax.adam(1e-2), axis_name=plan.axis, n_dev=N_DEV)
+        cfg = TrainStepConfig(num_slots=NUM_SLOTS, batch_size=64 // N_DEV,
+                              layout=LAYOUT, sparse_opt=OPT, auc_buckets=1000,
+                              axis_name=plan.axis)
+        return CTRTrainer(model, cfg, dense_opt=zero, plan=plan)
+
+    from paddlebox_tpu.data.device_pack import pack_batch_sharded
+    from paddlebox_tpu.data.slot_record import build_batch
+
+    tr = build()
+    tr.init_params()
+    # one manual sharded pass to populate zero state
+    st = tr._make_state(dev)
+    db = pack_batch_sharded(build_batch(recs[:64], schema), ws, schema, N_DEV, bucket=32)
+    feed = {k: jax.device_put(v, plan.batch_sharding) for k, v in db.as_dict().items()}
+    st, _ = tr._step(st, feed)
+    tr.params, tr.opt_state = st.params, st.opt_state
+    tr.save_dense(str(tmp_path / "dense"))
+
+    tr2 = build()
+    tr2.init_params()
+    assert tr2.opt_state is None
+    tr2.load_dense(str(tmp_path / "dense"))  # rebuilds zero state, loads
+    for a, b in zip(jax.tree.leaves(tr.opt_state), jax.tree.leaves(tr2.opt_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(tr2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
