@@ -1,0 +1,277 @@
+// Native slot-format parser: the data-loader hot path in C++.
+//
+// The reference parses sample text in C++ worker threads
+// (SlotPaddleBoxDataFeed::ParseOneInstance, data_feed.cc:2951-3061, with
+// optional dlopen'd ISlotParser plugins, :2594-2655). This library is that
+// tier for the TPU framework: one call parses a whole file buffer into
+// columnar arrays (values + per-record offsets) that numpy wraps zero-copy
+// on the Python side (utils/native.py).
+//
+// Line format (identical to data/parser.py::parse_line):
+//   [1 <ins_id>] [1 <logkey>] {<num> <v...>} per slot in schema order
+// - counts must be nonzero (error)
+// - sparse (uint64) slots drop 0-valued feasigns unless dense
+// - float slots drop |v| < 1e-6 unless dense
+// - a record with zero remaining uint64 feasigns is skipped
+// - logkey hex fields: cmatch [11:14), rank [14:16), search_id [16:32)
+//
+// ABI: C, handle-based. The caller copies results out through pointer
+// getters then frees the handle. Thread-safe (no globals): one handle per
+// file per reader thread.
+
+#include <cctype>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Parsed {
+  // flat values; record r's slot s lives at
+  // [u64_base[r] + u64_off[r*(S1)+s], u64_base[r] + u64_off[r*(S1)+s+1])
+  std::vector<uint64_t> u64_values;
+  std::vector<uint32_t> u64_offsets;  // record-local, n_records*(n_sparse+1)
+  std::vector<int64_t> u64_base;
+  std::vector<float> f_values;
+  std::vector<uint32_t> f_offsets;  // record-local, n_records*(n_float+1)
+  std::vector<int64_t> f_base;
+  std::vector<uint64_t> search_id;
+  std::vector<int32_t> cmatch;
+  std::vector<int32_t> rank;
+  std::vector<int64_t> ins_id_off;  // offsets into ins_id_chars (n_records+1)
+  std::string ins_id_chars;
+  int64_t n_records = 0;
+  int64_t skipped = 0;
+  std::string error;
+};
+
+inline const char* skip_spaces(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+inline bool parse_u64(const char*& p, const char* end, uint64_t* out) {
+  p = skip_spaces(p, end);
+  if (p >= end || !isdigit((unsigned char)*p)) return false;
+  uint64_t v = 0;
+  while (p < end && isdigit((unsigned char)*p)) {
+    v = v * 10u + (uint64_t)(*p - '0');
+    ++p;
+  }
+  *out = v;
+  return true;
+}
+
+inline bool parse_f32(const char*& p, const char* end, float* out) {
+  p = skip_spaces(p, end);
+  if (p >= end) return false;
+  char* ep = nullptr;
+  // buffer is NUL-terminated by the caller; strtof stops at whitespace
+  float v = strtof(p, &ep);
+  if (ep == p) return false;
+  p = ep;
+  *out = v;
+  return true;
+}
+
+inline bool parse_token(const char*& p, const char* end, const char** tok,
+                        size_t* len) {
+  p = skip_spaces(p, end);
+  if (p >= end || *p == '\n') return false;
+  *tok = p;
+  while (p < end && *p != ' ' && *p != '\t' && *p != '\n' && *p != '\r') ++p;
+  *len = (size_t)(p - *tok);
+  return true;
+}
+
+inline uint64_t hex_field(const char* s, int a, int b) {
+  uint64_t v = 0;
+  for (int i = a; i < b; ++i) {
+    char c = s[i];
+    uint64_t d = (c >= '0' && c <= '9')   ? (uint64_t)(c - '0')
+                 : (c >= 'a' && c <= 'f') ? (uint64_t)(c - 'a' + 10)
+                 : (c >= 'A' && c <= 'F') ? (uint64_t)(c - 'A' + 10)
+                                          : 0;
+    v = (v << 4) | d;
+  }
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// slot_kinds[i]: 0 = sparse uint64, 1 = float; is_dense[i]: keep zeros;
+// is_used[i]: 0 = parse-and-discard (schema 'used' parity). Errors land in
+// errbuf and NULL is returned.
+void* pbx_parse_buffer(const char* data, int64_t len, int n_slots,
+                       const uint8_t* slot_kinds, const uint8_t* is_dense,
+                       const uint8_t* is_used, int parse_ins_id,
+                       int parse_logkey, char* errbuf, int errbuf_len) {
+  auto fail = [&](const std::string& msg, int64_t line_no) {
+    if (errbuf && errbuf_len > 0) {
+      snprintf(errbuf, (size_t)errbuf_len, "line %lld: %s",
+               (long long)(line_no + 1), msg.c_str());
+    }
+    return (void*)nullptr;
+  };
+  Parsed* out = new Parsed();
+  int n_sparse = 0, n_float = 0;
+  for (int i = 0; i < n_slots; ++i)
+    if (is_used[i]) (slot_kinds[i] ? n_float : n_sparse)++;
+
+  const char* p = data;
+  const char* end = data + len;
+  int64_t line_no = 0;
+  out->ins_id_off.push_back(0);
+
+  std::vector<uint64_t> u_tmp;
+  std::vector<float> f_tmp;
+  std::vector<uint32_t> u_off(n_sparse + 1), f_off(n_float + 1);
+
+  while (p < end) {
+    const char* line_end = (const char*)memchr(p, '\n', (size_t)(end - p));
+    if (!line_end) line_end = end;
+    const char* q = skip_spaces(p, line_end);
+    if (q == line_end) {  // blank line
+      p = line_end + 1;
+      ++line_no;
+      continue;
+    }
+    u_tmp.clear();
+    f_tmp.clear();
+    uint64_t sid = 0;
+    int32_t cm = 0, rk = 0;
+    const char* ins_tok = nullptr;
+    size_t ins_len = 0;
+
+    if (parse_ins_id) {
+      uint64_t one;
+      const char* tok;
+      size_t tl;
+      if (!parse_u64(q, line_end, &one) || one != 1 ||
+          !parse_token(q, line_end, &tok, &tl)) {
+        delete out;
+        return fail("bad ins_id field", line_no);
+      }
+      ins_tok = tok;
+      ins_len = tl;
+    }
+    if (parse_logkey) {
+      uint64_t one;
+      const char* tok;
+      size_t tl;
+      if (!parse_u64(q, line_end, &one) || one != 1 ||
+          !parse_token(q, line_end, &tok, &tl) || tl < 17) {
+        delete out;
+        return fail("bad logkey field (need > 16 hex chars)", line_no);
+      }
+      int e1 = tl < 14 ? (int)tl : 14;
+      int e2 = tl < 16 ? (int)tl : 16;
+      int e3 = tl < 32 ? (int)tl : 32;
+      cm = (int32_t)hex_field(tok, 11, e1);
+      rk = (int32_t)hex_field(tok, 14, e2);
+      sid = hex_field(tok, 16, e3);
+      // the logkey IS the ins_id (parser.py sets it unconditionally)
+      ins_tok = tok;
+      ins_len = tl;
+    }
+
+    int ui = 0, fi = 0;
+    u_off[0] = 0;
+    f_off[0] = 0;
+    for (int s = 0; s < n_slots; ++s) {
+      uint64_t cnt;
+      if (!parse_u64(q, line_end, &cnt)) {
+        delete out;
+        return fail("truncated slot line (ran out of tokens)", line_no);
+      }
+      if (cnt == 0) {
+        delete out;
+        return fail("zero-count slot (pad in the data generator)", line_no);
+      }
+      if (!is_used[s]) {  // consume and discard
+        const char* tok;
+        size_t tl;
+        for (uint64_t j = 0; j < cnt; ++j) {
+          if (!parse_token(q, line_end, &tok, &tl)) {
+            delete out;
+            return fail("truncated slot line (ran out of tokens)", line_no);
+          }
+        }
+        continue;
+      }
+      if (slot_kinds[s]) {  // float
+        for (uint64_t j = 0; j < cnt; ++j) {
+          float v;
+          if (!parse_f32(q, line_end, &v)) {
+            delete out;
+            return fail("truncated slot line (ran out of tokens)", line_no);
+          }
+          if (is_dense[s] || v >= 1e-6f || v <= -1e-6f) f_tmp.push_back(v);
+        }
+        f_off[++fi] = (uint32_t)f_tmp.size();
+      } else {
+        for (uint64_t j = 0; j < cnt; ++j) {
+          uint64_t v;
+          if (!parse_u64(q, line_end, &v)) {
+            delete out;
+            return fail("truncated slot line (ran out of tokens)", line_no);
+          }
+          if (v != 0 || is_dense[s]) u_tmp.push_back(v);
+        }
+        u_off[++ui] = (uint32_t)u_tmp.size();
+      }
+    }
+
+    if (u_tmp.empty()) {  // no surviving feasigns: skip record
+      out->skipped++;
+    } else {
+      out->u64_base.push_back((int64_t)out->u64_values.size());
+      out->u64_values.insert(out->u64_values.end(), u_tmp.begin(), u_tmp.end());
+      out->u64_offsets.insert(out->u64_offsets.end(), u_off.begin(), u_off.end());
+      out->f_base.push_back((int64_t)out->f_values.size());
+      out->f_values.insert(out->f_values.end(), f_tmp.begin(), f_tmp.end());
+      out->f_offsets.insert(out->f_offsets.end(), f_off.begin(), f_off.end());
+      out->search_id.push_back(sid);
+      out->cmatch.push_back(cm);
+      out->rank.push_back(rk);
+      if (ins_tok) out->ins_id_chars.append(ins_tok, ins_len);
+      out->ins_id_off.push_back((int64_t)out->ins_id_chars.size());
+      out->n_records++;
+    }
+    p = (line_end < end) ? line_end + 1 : end;
+    ++line_no;
+  }
+  return (void*)out;
+}
+
+int64_t pbx_num_records(void* h) { return ((Parsed*)h)->n_records; }
+int64_t pbx_num_skipped(void* h) { return ((Parsed*)h)->skipped; }
+int64_t pbx_num_u64(void* h) { return (int64_t)((Parsed*)h)->u64_values.size(); }
+int64_t pbx_num_f(void* h) { return (int64_t)((Parsed*)h)->f_values.size(); }
+int64_t pbx_ins_chars(void* h) {
+  return (int64_t)((Parsed*)h)->ins_id_chars.size();
+}
+
+const uint64_t* pbx_u64_values(void* h) { return ((Parsed*)h)->u64_values.data(); }
+const uint32_t* pbx_u64_offsets(void* h) { return ((Parsed*)h)->u64_offsets.data(); }
+const int64_t* pbx_u64_base(void* h) { return ((Parsed*)h)->u64_base.data(); }
+const float* pbx_f_values(void* h) { return ((Parsed*)h)->f_values.data(); }
+const uint32_t* pbx_f_offsets(void* h) { return ((Parsed*)h)->f_offsets.data(); }
+const int64_t* pbx_f_base(void* h) { return ((Parsed*)h)->f_base.data(); }
+const uint64_t* pbx_search_ids(void* h) { return ((Parsed*)h)->search_id.data(); }
+const int32_t* pbx_cmatch(void* h) { return ((Parsed*)h)->cmatch.data(); }
+const int32_t* pbx_rank(void* h) { return ((Parsed*)h)->rank.data(); }
+const int64_t* pbx_ins_id_off(void* h) { return ((Parsed*)h)->ins_id_off.data(); }
+const char* pbx_ins_id_chars_ptr(void* h) {
+  return ((Parsed*)h)->ins_id_chars.data();
+}
+
+void pbx_free(void* h) { delete (Parsed*)h; }
+
+}  // extern "C"

@@ -71,6 +71,7 @@ define_flag("record_pool_max_size", 50_000_000, "SlotRecord pool cap (reference:
 define_flag("slot_pool_thread_num", 1, "recycle threads for record pool")
 define_flag("data_read_buffer_mb", 16, "file read buffer size")
 define_flag("enable_ins_parser_file", False, "allow per-file parser plugin")
+define_flag("enable_native_parser", True, "use the C++ slot parser fast path when eligible")
 define_flag("sample_rate", 1.0, "line sampling rate on read (BufferedLineFileReader parity)")
 
 # --- sparse table ---

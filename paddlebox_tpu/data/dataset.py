@@ -250,6 +250,26 @@ class BoxPSDataset:
     # ---- load ------------------------------------------------------------
 
     def _read_one(self, path: str) -> List[SlotRecord]:
+        # native fast path: whole-file columnar parse in C++ when nothing
+        # needs the line-by-line machinery (pipe converter, sampling, custom
+        # parser). Falls back to the Python tier otherwise/on build failure.
+        if (
+            self.pipe_command is None
+            and self.line_parser is parse_line
+            and config.get_flag("sample_rate") >= 1.0
+            and config.get_flag("enable_native_parser")
+            and not path.startswith(("hdfs:", "afs:"))  # fs dispatch tier
+            and not path.endswith(".gz")
+        ):
+            from paddlebox_tpu.utils import native
+
+            if native.available():
+                nstats: dict = {}
+                recs = native.parse_file(path, self.schema, nstats)
+                with self._stats_lock:
+                    self._loading_stats.lines += len(recs) + nstats.get("skipped", 0)
+                return recs
+
         out = []
         n_lines = 0
         # per-file seed decorrelates sampling across part files (same-seeded
