@@ -84,6 +84,7 @@ define_flag("pull_embedx_scale", 1.0, "scale applied to pulled embedx (reference
 define_flag("batch_pad_quantile", 1.0, "key-bucket padding quantile for static shapes")
 define_flag("batch_bucket_rounding", 2048, "flat key-count buckets rounded to multiples of this")
 define_flag("enable_dense_nccl_barrier", False, "barrier before dense sync (reference flags.cc:597)")
+define_flag("use_pallas_sparse", False, "Pallas prefetch-DMA kernels for sparse pull/push on TPU")
 
 # --- metrics ---
 define_flag("auc_num_buckets", 1_000_000, "AUC wuauc bucket table size (reference box_wrapper.h:61)")

@@ -17,8 +17,30 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu import config
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
+
+
+def _use_pallas(table: jnp.ndarray, n_idx: int) -> bool:
+    """Pallas row-DMA kernels: opt-in, TPU-only, lane-aligned widths only
+    (see ops/pallas_kernels.py for measured XLA-vs-pallas numbers)."""
+    if not config.get_flag("use_pallas_sparse"):
+        return False
+    from paddlebox_tpu.ops.pallas_kernels import _BLK, LANE, backend_is_tpu
+
+    if table.shape[1] % LANE != 0 or n_idx % _BLK != 0:
+        return False
+    return backend_is_tpu()
+
+
+def _gather_rows(table: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Row gather: XLA take, or the Pallas row-DMA kernel when eligible."""
+    if _use_pallas(table, rows.shape[0]):
+        from paddlebox_tpu.ops.pallas_kernels import pull_rows_pallas
+
+        return pull_rows_pallas(table, rows)
+    return jnp.take(table, rows, axis=0)
 
 
 def pull_sparse_rows(
@@ -34,7 +56,7 @@ def pull_sparse_rows(
     activation threshold — the open analog of the closed lib's
     ``embedding_size > 0`` signal consumed by PullCopy (box_wrapper.cu:54-63).
     """
-    picked = jnp.take(table, rows, axis=0)  # [U, width]
+    picked = _gather_rows(table, rows)  # [U, width]
     cvm_block = picked[:, : layout.cvm_offset]
     embedx = picked[:, layout.embedx_col : layout.embedx_col + layout.embedx_dim]
     active = (picked[:, layout.SHOW] >= embedx_threshold)[:, None]
@@ -57,7 +79,7 @@ def pull_sparse_rows_extended(
     """
     if layout.expand_dim == 0:
         raise ValueError("layout has no expand block (expand_embed_dim == 0)")
-    picked = jnp.take(table, rows, axis=0)
+    picked = _gather_rows(table, rows)
     cvm_block = picked[:, : layout.cvm_offset]
     active = (picked[:, layout.SHOW] >= embedx_threshold)[:, None]
     embedx = picked[:, layout.embedx_col : layout.embedx_col + layout.embedx_dim]
@@ -83,10 +105,18 @@ def push_sparse_rows(
     grads; box_wrapper.cu PushCopy fills show/clk from the batch) with the
     optimizer semantics documented in table/optimizers.py.
     """
-    old = jnp.take(table, rows, axis=0)  # [U, width]
+    old = _gather_rows(table, rows)  # [U, width]
     new_rows = sparse_update_rows(
         old, grads, show_counts, clk_counts, layout, opt, lr_scale
     )
+    if _use_pallas(table, rows.shape[0]) and config.get_flag(
+        "enable_pullpush_dedup_keys"
+    ):
+        # dedup'd rows are unique (pad-row repeats write identical
+        # contents), so per-row set == scatter-add of deltas
+        from paddlebox_tpu.ops.pallas_kernels import write_rows_pallas
+
+        return write_rows_pallas(table, rows, new_rows)
     # Scatter the *delta* with add-semantics: with host dedup rows are unique
     # and this equals a set; without dedup (enable_pullpush_dedup_keys=0) a
     # key occurring in several slots contributes each occurrence's update
