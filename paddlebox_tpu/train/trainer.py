@@ -55,6 +55,11 @@ class CTRTrainer:
         pack_bucket: Optional[int] = None,
         metric_registry: Optional["MetricRegistry"] = None,
         async_dense: Optional["AsyncDenseTable"] = None,
+        dump_pool: Optional["DumpWorkerPool"] = None,
+        dump_fields_list: Sequence[str] = ("preds", "labels"),
+        dump_mode: int = 0,  # 0 all, 1 sample-by-ins-id-hash, 2 every Nth batch
+        dump_interval: int = 1,
+        dump_params_at_end: bool = False,
     ):
         self.model = model
         self.cfg = cfg
@@ -81,6 +86,13 @@ class CTRTrainer:
         self.dense_dim = dense_dim
         self.pack_bucket = pack_bucket
         self.metric_registry = metric_registry
+        # per-batch field/param debug dumps (DeviceWorker::DumpField/DumpParam
+        # parity, device_worker.cc:98-133; modes per device_worker.h:218-219)
+        self.dump_pool = dump_pool
+        self.dump_fields_list = tuple(dump_fields_list)
+        self.dump_mode = dump_mode
+        self.dump_interval = dump_interval
+        self.dump_params_at_end = dump_params_at_end
         self.params: Any = None
         self.opt_state: Any = None
         self._state: Optional[TrainState] = None
