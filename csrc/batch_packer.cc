@@ -1,0 +1,121 @@
+// Native per-batch packer: the MiniBatchGpuPack hot loop in C++.
+//
+// The reference packs minibatches on pinned host memory in C++ worker
+// threads (MiniBatchGpuPack::pack_instance, data_feed.h:1418-1542) and
+// dedups keys on device (DedupKeysAndFillIdx, box_wrapper_impl.h:103). On
+// TPU the whole resolution happens host-side once per batch: keys were
+// already mapped to pass-local table rows when the pass was finalized
+// (PassWorkingSet), so packing a batch is a ragged gather over the
+// columnar record store + first-occurrence dedup + segment-id emission —
+// one native call, no Python per-record work.
+//
+// Dedup uses an epoch-stamped scratch table sized by the pass row count:
+// O(L) per batch, no clearing, no hashing (rows are dense pass-local ids).
+//
+// ABI: C, handle-based; one handle per packer thread (the scratch is the
+// only mutable state). ctypes releases the GIL during calls, so packer
+// threads genuinely overlap with each other and the device step.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Packer {
+  // borrowed pass-scoped views (owned by numpy on the Python side; the
+  // pass object must outlive the handle)
+  const int32_t* rows;         // [total_keys] pass-local row per key
+  const int64_t* rec_base;     // [n_records] record base into rows
+  const uint32_t* rec_off;     // [n_records * (n_sparse+1)] record-local
+  int n_sparse;
+  int64_t n_records;
+  // dedup scratch, epoch-stamped
+  std::vector<int64_t> stamp;
+  std::vector<int32_t> uniq_of_row;
+  int64_t epoch = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pbx_packer_create(const int32_t* rows, const int64_t* rec_base,
+                        const uint32_t* rec_off, int64_t n_records,
+                        int n_sparse, int64_t n_table_rows) {
+  Packer* p = new Packer();
+  p->rows = rows;
+  p->rec_base = rec_base;
+  p->rec_off = rec_off;
+  p->n_sparse = n_sparse;
+  p->n_records = n_records;
+  p->stamp.assign((size_t)n_table_rows, -1);
+  p->uniq_of_row.resize((size_t)n_table_rows);
+  return (void*)p;
+}
+
+// Pack records `indices[0..B)` into slot-major arrays. Caller buffers:
+// uniq_rows [>=L], inverse [>=L], segments [>=L] where L = total key count
+// of the batch (caller computes it from the offsets; returns -1 if a
+// record index or row is out of range). Writes the first-occurrence unique
+// rows and per-key (uniq index, slot*B+ins segment); returns U, the unique
+// count. No padding here — the Python wrapper buckets and pads.
+int64_t pbx_pack_batch(void* h, const int64_t* indices, int64_t B,
+                       int32_t* uniq_rows, int32_t* inverse,
+                       int32_t* segments) {
+  Packer* p = (Packer*)h;
+  const int S1 = p->n_sparse + 1;
+  const int64_t epoch = ++p->epoch;
+  int64_t* stamp = p->stamp.data();
+  int32_t* uniq_of_row = p->uniq_of_row.data();
+  const int64_t n_rows = (int64_t)p->stamp.size();
+  int64_t k = 0, U = 0;
+  for (int s = 0; s < p->n_sparse; ++s) {
+    for (int64_t i = 0; i < B; ++i) {
+      const int64_t r = indices[i];
+      if (r < 0 || r >= p->n_records) return -1;
+      const uint32_t* off = p->rec_off + r * S1;
+      const int64_t a = p->rec_base[r] + off[s];
+      const int64_t b = p->rec_base[r] + off[s + 1];
+      const int32_t seg = (int32_t)(s * B + i);
+      for (int64_t j = a; j < b; ++j) {
+        const int32_t row = p->rows[j];
+        if (row < 0 || row >= n_rows) return -1;
+        if (stamp[row] != epoch) {
+          stamp[row] = epoch;
+          uniq_of_row[row] = (int32_t)U;
+          uniq_rows[U++] = row;
+        }
+        inverse[k] = uniq_of_row[row];
+        segments[k] = seg;
+        ++k;
+      }
+    }
+  }
+  return U;
+}
+
+void pbx_packer_free(void* h) { delete (Packer*)h; }
+
+// --- pass-scoped helpers (vectorized host work that is awkward/slow in
+// numpy but trivial here) ------------------------------------------------
+
+// Ragged gather: out[i] = concat of values[base[idx]+off[idx][slot]..+1)
+// for one slot over many records — used for whole-pass label extraction
+// and columnar select(). Lengths must be uniform (dim) per record.
+void pbx_gather_f32_slot(const float* values, const int64_t* base,
+                         const uint32_t* off, int n_float_p1,
+                         const int64_t* indices, int64_t n, int slot, int dim,
+                         float* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = indices[i];
+    const uint32_t* o = off + r * n_float_p1;
+    const int64_t a = base[r] + o[slot];
+    const int64_t len = (int64_t)(o[slot + 1] - o[slot]);
+    const int64_t c = len < dim ? len : dim;
+    for (int64_t d = 0; d < c; ++d) out[i * dim + d] = values[a + d];
+    for (int64_t d = c; d < dim; ++d) out[i * dim + d] = 0.0f;
+  }
+}
+
+}  // extern "C"

@@ -36,6 +36,7 @@ from paddlebox_tpu.data.pv_instance import (
     merge_pv_instances,
     pack_pv_batches,
 )
+from paddlebox_tpu.data.record_store import ColumnarRecords
 from paddlebox_tpu.data.slot_record import SlotBatch, SlotRecord, build_batch
 from paddlebox_tpu.data.slot_schema import SlotSchema
 from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
@@ -47,6 +48,16 @@ config.define_flag(
 )
 
 
+def _ins_id_dest(ins_id: str, n_parts: int) -> int:
+    # xxhash in the reference; any good string hash preserves semantics
+    import hashlib
+
+    return (
+        int.from_bytes(hashlib.blake2b(ins_id.encode(), digest_size=8).digest(), "little")
+        % n_parts
+    )
+
+
 def shuffle_route(records: Sequence[SlotRecord], n_parts: int, mode: str, seed: int) -> List[int]:
     """Destination part of each record (ShuffleData routing parity,
     data_set.cc:1772-1791): 'search_id' groups a query's ads on one node,
@@ -54,49 +65,64 @@ def shuffle_route(records: Sequence[SlotRecord], n_parts: int, mode: str, seed: 
     if mode == "search_id":
         return [r.search_id % n_parts for r in records]
     if mode == "ins_id":
-        # xxhash in the reference; any good string hash preserves semantics
-        import hashlib
-
-        return [
-            int.from_bytes(hashlib.blake2b(r.ins_id.encode(), digest_size=8).digest(), "little")
-            % n_parts
-            for r in records
-        ]
+        return [_ins_id_dest(r.ins_id, n_parts) for r in records]
     if mode == "random":
         rng = np.random.default_rng(seed)
         return list(rng.integers(0, n_parts, len(records)))
     raise ValueError(f"unknown shuffle mode {mode!r}")
 
 
+def shuffle_route_store(
+    store: ColumnarRecords, n_parts: int, mode: str, seed: int
+) -> np.ndarray:
+    """Vectorized shuffle_route over a columnar store -> int dest array."""
+    n = len(store)
+    if mode == "search_id":
+        return (store.search_ids % np.uint64(n_parts)).astype(np.int64)
+    if mode == "ins_id":
+        return np.array(
+            [_ins_id_dest(store.ins_id(i), n_parts) for i in range(n)], np.int64
+        )
+    if mode == "random":
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, n_parts, n)
+    raise ValueError(f"unknown shuffle mode {mode!r}")
+
+
 class LocalShuffleRouter:
     """In-process stand-in for the closed ``boxps::PaddleShuffler`` RPC tier:
-    exchanges records between n logical nodes living in one process. A real
-    multi-host deployment plugs a host-RPC/all_to_all implementation with the
-    same exchange() contract."""
+    exchanges record chunks between n logical nodes living in one process. A
+    multi-host deployment plugs a host-RPC implementation with the same
+    exchange()/collect() contract (parallel/shuffle_net.py). A chunk is
+    either a ``List[SlotRecord]`` or a ``ColumnarRecords``; the dataset
+    normalizes on collect."""
 
     def __init__(self, n_nodes: int):
         self.n_nodes = n_nodes
-        self._inboxes: List[List[SlotRecord]] = [[] for _ in range(n_nodes)]
+        self._inboxes: List[list] = [[] for _ in range(n_nodes)]
         self._cond = threading.Condition()
         self._done = 0
         self._collected = 0
 
-    def exchange(self, from_node: int, parts: List[List[SlotRecord]]) -> None:
-        """Deliver this node's outgoing parts; marks the node finished sending
-        (the zero-length completion message of the reference's protocol,
-        data_set.cc:1835-1866, collapses into this call). A node racing ahead
-        into the next pass blocks here until every node collected the current
-        one, so passes can never interleave in the inboxes."""
+    def exchange(self, from_node: int, parts: list) -> None:
+        """Deliver this node's outgoing chunks (one per destination); marks
+        the node finished sending (the zero-length completion message of the
+        reference's protocol, data_set.cc:1835-1866, collapses into this
+        call). A node racing ahead into the next pass blocks here until
+        every node collected the current one, so passes can never interleave
+        in the inboxes."""
         with self._cond:
             self._cond.wait_for(lambda: self._done < self.n_nodes)
-            for dst, recs in enumerate(parts):
-                self._inboxes[dst].extend(recs)
+            for dst, chunk in enumerate(parts):
+                if len(chunk):
+                    self._inboxes[dst].append(chunk)
             self._done += 1
             self._cond.notify_all()
 
-    def collect(self, node: int) -> List[SlotRecord]:
+    def collect(self, node: int) -> list:
         """Blocks until every node has exchanged (ShuffleResultWaitGroup
-        parity) so no late-arriving records are dropped."""
+        parity) so no late-arriving records are dropped. Returns the list
+        of received chunks."""
         with self._cond:
             self._cond.wait_for(lambda: self._done >= self.n_nodes)
             out = self._inboxes[node]
@@ -163,7 +189,12 @@ class BoxPSDataset:
         self.pass_id = 0
         self.current_phase = 1  # 1 join, 0 update (data_set.h:291)
         self._filelist: List[str] = []
-        self.records: List[SlotRecord] = []
+        # pass data lives EITHER columnar (store + shuffle order — the fast
+        # path) or as a SlotRecord list (fallback parser / pv / eval paths);
+        # the `records` property materializes a view list on demand.
+        self.store: Optional[ColumnarRecords] = None
+        self._order: Optional[np.ndarray] = None
+        self._records: List[SlotRecord] = []
         self.ws: Optional[PassWorkingSet] = None
         self.device_table: Optional[np.ndarray] = None
         self.stats = PassStats()
@@ -172,6 +203,30 @@ class BoxPSDataset:
         self._in_pass = False
         self._staged = None  # (records, ws, stats) loaded but not begun
         self._loading_stats = self.stats
+
+    # ---- record access ---------------------------------------------------
+
+    @property
+    def records(self) -> List[SlotRecord]:
+        """Materialized SlotRecord view of the pass (compat paths: pv merge,
+        AucRunner, direct inspection). Store-backed passes materialize
+        lazily; the columnar fast path stays live."""
+        if not self._records and self.store is not None and len(self.store):
+            order = (
+                self._order
+                if self._order is not None
+                else np.arange(len(self.store))
+            )
+            self._records = [self.store.record(int(i)) for i in order]
+        return self._records
+
+    @records.setter
+    def records(self, value) -> None:
+        # assigning a list makes it the source of truth (pv flatten etc.);
+        # the columnar store would be stale, so drop it
+        self._records = list(value)
+        self.store = None
+        self._order = None
 
     # ---- pass config -----------------------------------------------------
 
@@ -249,10 +304,11 @@ class BoxPSDataset:
 
     # ---- load ------------------------------------------------------------
 
-    def _read_one(self, path: str) -> List[SlotRecord]:
+    def _read_one(self, path: str):
         # native fast path: whole-file columnar parse in C++ when nothing
         # needs the line-by-line machinery (pipe converter, sampling, custom
-        # parser). Falls back to the Python tier otherwise/on build failure.
+        # parser). Returns a ColumnarRecords chunk (no per-record Python
+        # objects). Falls back to the Python tier otherwise/on build failure.
         if (
             self.pipe_command is None
             and self.line_parser is parse_line
@@ -265,10 +321,10 @@ class BoxPSDataset:
 
             if native.available():
                 nstats: dict = {}
-                recs = native.parse_file(path, self.schema, nstats)
+                chunk = native.parse_file_columnar(path, self.schema, nstats)
                 with self._stats_lock:
-                    self._loading_stats.lines += len(recs) + nstats.get("skipped", 0)
-                return recs
+                    self._loading_stats.lines += len(chunk) + nstats.get("skipped", 0)
+                return chunk
 
         out = []
         n_lines = 0
@@ -306,29 +362,93 @@ class BoxPSDataset:
         stats = PassStats(files=len(self._filelist))
         self._loading_stats = stats
         ws = PassWorkingSet(n_mesh_shards=self.n_mesh_shards)
-        records: List[SlotRecord] = []
+        parts: list = []
         if self._filelist:
             with ThreadPoolExecutor(max_workers=self.read_threads) as pool:
-                for part in pool.map(self._read_one, self._filelist):
-                    records.extend(part)
+                parts = list(pool.map(self._read_one, self._filelist))
 
-        records = self._shuffle_records(records)
+        store, order, records = self._normalize_and_shuffle(parts)
 
         # MergeInsKeys parity (data_set.cc:1628-1683): every feasign of the
         # pass feeds the working set. Runs post-shuffle (ownership is final
-        # only after routing); chunked so lock/unique cost is per-chunk, not
-        # per-record.
-        chunk = 4096
-        for i in range(0, len(records), chunk):
-            ws.add_keys(
-                np.concatenate([r.u64_values for r in records[i : i + chunk]])
-            )
-        stats.records = len(records)
-        self._staged = (records, ws, stats)
+        # only after routing).
+        if store is not None:
+            if len(store.u64_values):
+                ws.add_keys(store.u64_values)
+            stats.records = len(store)
+        else:
+            chunk = 4096
+            for i in range(0, len(records), chunk):
+                ws.add_keys(
+                    np.concatenate([r.u64_values for r in records[i : i + chunk]])
+                )
+            stats.records = len(records)
+        self._staged = (store, order, records, ws, stats)
         if not self._in_pass:
             # no pass training right now: publish immediately so
             # memory_data_size()/stats match reference post-load semantics
-            self.records, self.ws, self.stats = records, ws, stats
+            # (begin_pass still consumes the staged tuple)
+            self._publish(self._staged)
+
+    def _publish(self, staged) -> None:
+        store, order, records, ws, stats = staged
+        self.store = store
+        self._order = order
+        self._records = records if records is not None else []
+        self.ws = ws
+        self.stats = stats
+
+    def _normalize_and_shuffle(self, parts: list):
+        """File-part chunks -> (store, order, records): columnar when every
+        part is columnar (native parse), SlotRecord list otherwise."""
+        if parts and all(isinstance(p, ColumnarRecords) for p in parts):
+            non_empty = [p for p in parts if len(p)]
+            if non_empty:
+                store = (
+                    ColumnarRecords.concat(non_empty)
+                    if len(non_empty) > 1
+                    else non_empty[0]
+                )
+                return self._shuffle_store(store)
+        records: List[SlotRecord] = []
+        for p in parts:
+            records.extend(p.records() if isinstance(p, ColumnarRecords) else p)
+        return None, None, self._shuffle_records(records)
+
+    def _shuffle_store(self, store: ColumnarRecords):
+        """Columnar shuffle: routing moves arrays, local order is a
+        permutation (no data movement at all)."""
+        mode = self.shuffle_mode
+        rng = np.random.default_rng(self.seed + self.pass_id)
+        if mode == "none":
+            return store, None, []
+        if mode != "local" and self.router is not None:
+            dests = shuffle_route_store(
+                store, self.router.n_nodes, mode, self.seed + self.pass_id
+            )
+            parts = [
+                store.select(np.nonzero(dests == d)[0])
+                for d in range(self.router.n_nodes)
+            ]
+            self.router.exchange(self.rank, parts)
+            chunks = self.router.collect(self.rank)
+            cols = [c for c in chunks if isinstance(c, ColumnarRecords)]
+            lists = [c for c in chunks if not isinstance(c, ColumnarRecords)]
+            if lists:  # mixed transports: normalize to records
+                records = [r for c in lists for r in c]
+                for c in cols:
+                    records.extend(c.records())
+                order = rng.permutation(len(records))
+                return None, None, [records[i] for i in order]
+            store = (
+                ColumnarRecords.concat(cols)
+                if cols
+                else ColumnarRecords.empty(store.n_sparse, store.n_float)
+            )
+        elif mode != "local" and self.nranks != 1:
+            raise RuntimeError("global shuffle across ranks needs a router")
+        return store, rng.permutation(len(store)), []
+
 
     def preload_into_memory(self) -> None:
         """Overlap next pass's IO with current training
@@ -373,7 +493,11 @@ class BoxPSDataset:
         for r, d in zip(records, dests):
             parts[d].append(r)
         self.router.exchange(self.rank, parts)
-        mine = self.router.collect(self.rank)
+        mine = [
+            r
+            for chunk in self.router.collect(self.rank)
+            for r in (chunk.records() if isinstance(chunk, ColumnarRecords) else chunk)
+        ]
         order = rng.permutation(len(mine))
         return [mine[i] for i in order]
 
@@ -393,6 +517,7 @@ class BoxPSDataset:
 
         if not self.records:
             raise RuntimeError("slots_shuffle needs in-memory records")
+        recs = self.records  # materializes the store view if needed
         runner = getattr(self, "_auc_runner", None)
         if runner is None or getattr(self, "_auc_runner_pass", None) != self.pass_id:
             cap = config.get_flag("auc_runner_pool_size")
@@ -402,10 +527,18 @@ class BoxPSDataset:
                 capacity=cap,
                 seed=self.seed + self.pass_id,
             )
-            runner.observe(self.records)
+            runner.observe(recs)
             self._auc_runner = runner
             self._auc_runner_pass = self.pass_id
-        return runner.slots_shuffle(self.records, set(slots))
+        out = runner.slots_shuffle(recs, set(slots))
+        if self.store is not None:
+            # the runner rewrote record arrays; the columnar store is stale —
+            # rebuild it (order baked in) so the fast path serves the
+            # shuffled keys
+            self.store = ColumnarRecords.from_records(recs, self.schema)
+            self._order = None
+            self.store.invalidate_rows()
+        return out
 
     @property
     def auc_runner_phase(self) -> int:
@@ -421,7 +554,7 @@ class BoxPSDataset:
         if self._staged is not None:
             if self._in_pass:
                 raise RuntimeError("end_pass the previous pass before begin_pass")
-            self.records, self.ws, self.stats = self._staged
+            self._publish(self._staged)
             self._staged = None
         if self.ws is None:
             raise RuntimeError("load_into_memory first")
@@ -460,23 +593,45 @@ class BoxPSDataset:
     # ---- batch serving ---------------------------------------------------
 
     def memory_data_size(self) -> int:
-        return len(self.records)
+        if self.store is not None:
+            return len(self.store)
+        return len(self._records)
 
     def num_batches(self, global_count: Optional[int] = None) -> int:
         """Minibatch count this pass. With ``global_count`` (the allreduced
         max across nodes — compute_thread_batch_nccl parity) the tail is
         re-split so every node runs the same count."""
-        local = len(self.records) // self.batch_size
-        if not self.drop_remainder and len(self.records) % self.batch_size:
+        n = self.memory_data_size()
+        local = n // self.batch_size
+        if not self.drop_remainder and n % self.batch_size:
             local += 1
         return global_count if global_count is not None else local
+
+    def batch_indices(self, n_batches: Optional[int] = None) -> Iterator[np.ndarray]:
+        """Store-record indices of each minibatch (the fast-path analog of
+        ``batches()``): the pre-partitioned ``batch_offsets_`` of the
+        reference (PrepareTrain, data_set.cc:2155-2192) with the shuffle
+        order applied as a permutation. Wraps around past the tail so every
+        rank serves the same count (lockstep parity)."""
+        n = self.num_batches() if n_batches is None else n_batches
+        B = self.batch_size
+        N = self.memory_data_size()
+        if N == 0:
+            if n > 0:
+                raise RuntimeError(
+                    f"asked for {n} batches but this node holds 0 records "
+                    "(check file striping / shuffle routing)"
+                )
+            return
+        for i in range(n):
+            idx = np.arange(i * B, (i + 1) * B, dtype=np.int64) % N
+            yield self._order[idx] if self._order is not None else idx
 
     def batches(self, n_batches: Optional[int] = None) -> Iterator[SlotBatch]:
         """Yield equal-size SlotBatches; wraps around if asked for more than
         the pass holds (tail re-split parity: devices stay in lockstep)."""
         n = self.num_batches() if n_batches is None else n_batches
-        B = self.batch_size
-        if not self.records:
+        if self.memory_data_size() == 0:
             if n > 0:
                 # yielding fewer batches than asked would desync mesh
                 # collectives across ranks — fail loudly instead
@@ -485,8 +640,8 @@ class BoxPSDataset:
                     "(check file striping / shuffle routing)"
                 )
             return
+        B = self.batch_size
+        recs = self.records
         for i in range(n):
-            recs = [
-                self.records[(i * B + j) % len(self.records)] for j in range(B)
-            ]
-            yield build_batch(recs, self.schema)
+            batch = [recs[(i * B + j) % len(recs)] for j in range(B)]
+            yield build_batch(batch, self.schema)

@@ -108,6 +108,91 @@ class ShardedDeviceBatch:
         return d
 
 
+def _route_sharded(
+    rows: np.ndarray,
+    segments: np.ndarray,
+    B: int,
+    S: int,
+    ws: PassWorkingSet,
+    n_devices: int,
+    bucket: int,
+    labels: np.ndarray,
+    dense: Optional[np.ndarray],
+    dense_dim: int,
+    k_floor: int = 0,
+    l_floor: int = 0,
+) -> ShardedDeviceBatch:
+    """Shared mesh routing: flat (rows, segments) -> per-device buckets."""
+    ns = ws.n_mesh_shards
+    if n_devices != ns:
+        raise ValueError(f"n_devices {n_devices} != working-set mesh shards {ns}")
+    if B % n_devices:
+        raise ValueError(f"batch {B} not divisible by {n_devices} devices")
+    b = B // n_devices
+    cap = ws.capacity
+    ins = segments % B
+    slot = segments // B
+    dev = ins // b
+
+    per_dev = []  # (uniq_rows, inverse, local_segments) per device
+    max_L = 1
+    max_bucket = 1
+    for d in range(n_devices):
+        sel = np.nonzero(dev == d)[0]
+        uniq, inv = np.unique(rows[sel], return_inverse=True)
+        local_seg = slot[sel] * b + (ins[sel] - d * b)
+        per_dev.append((uniq, inv, local_seg))
+        max_L = max(max_L, len(sel))
+        if len(uniq):
+            counts = np.bincount(uniq // cap, minlength=ns)
+            max_bucket = max(max_bucket, int(counts.max()))
+
+    # K-1 is always a pad slot; L_pad/K identical across devices so the mesh
+    # program has one shape (compute_thread_batch_nccl lockstep parity,
+    # data_set.cc:2069-2135); floors let a pass-scoped packer keep shapes
+    # sticky across batches (one compiled program per pass). k_floor == -1
+    # requests first-batch headroom (25%) so later batches rarely grow K.
+    if k_floor == -1:
+        K = _round_bucket(max_bucket + 1 + max(bucket, max_bucket // 4), bucket)
+    else:
+        K = max(_round_bucket(max_bucket + 1, bucket), k_floor)
+    L_pad = max(_round_bucket(max_L, bucket), l_floor)
+
+    req_ranks = np.full((n_devices, ns, K), cap - 1, dtype=np.int32)
+    inverse = np.full((n_devices, L_pad), K - 1, dtype=np.int32)
+    seg_out = np.full((n_devices, L_pad), S * b, dtype=np.int32)
+
+    for d, (uniq, inv, local_seg) in enumerate(per_dev):
+        shard_of = (uniq // cap).astype(np.int64)
+        rank_of = (uniq % cap).astype(np.int64)
+        order = np.argsort(shard_of, kind="stable")
+        counts = np.bincount(shard_of, minlength=ns)
+        # bucket position of each unique row: owner_shard*K + slot-in-bucket
+        pos_in_bucket = np.empty(len(uniq), dtype=np.int64)
+        start = 0
+        for s in range(ns):
+            c = int(counts[s])
+            req_ranks[d, s, :c] = rank_of[order[start : start + c]]
+            pos_in_bucket[order[start : start + c]] = s * K + np.arange(c)
+            start += c
+        inverse[d, : len(inv)] = pos_in_bucket[inv]
+        seg_out[d, : len(local_seg)] = local_seg
+
+    labels = labels.reshape(n_devices, b)
+    if dense is not None:
+        dense = dense.reshape(n_devices, b, dense_dim)
+
+    return ShardedDeviceBatch(
+        local_batch=b,
+        num_slots=S,
+        req_ranks=req_ranks,
+        inverse=inverse,
+        segments=seg_out,
+        labels=labels,
+        dense=dense,
+    )
+
+
 def pack_batch_sharded(
     batch: SlotBatch,
     ws: PassWorkingSet,
@@ -130,74 +215,20 @@ def pack_batch_sharded(
     axis == dp axis), and the batch size must divide evenly.
     """
     bucket = bucket or config.get_flag("batch_bucket_rounding")
-    ns = ws.n_mesh_shards
-    if n_devices != ns:
-        raise ValueError(f"n_devices {n_devices} != working-set mesh shards {ns}")
-    B = batch.batch_size
-    if B % n_devices:
-        raise ValueError(f"batch {B} not divisible by {n_devices} devices")
-    b = B // n_devices
-    S = batch.num_sparse_slots
-    cap = ws.capacity
-
     rows = ws.lookup(batch.keys)  # int32 [L] global rows (shard*cap + rank)
     segments = batch.segment_ids()  # int32 [L] slot*B + ins
-    ins = segments % B
-    slot = segments // B
-    dev = ins // b
-
-    per_dev = []  # (uniq_rows, inverse, local_segments) per device
-    max_L = 1
-    max_bucket = 1
-    for d in range(n_devices):
-        sel = np.nonzero(dev == d)[0]
-        uniq, inv = np.unique(rows[sel], return_inverse=True)
-        local_seg = slot[sel] * b + (ins[sel] - d * b)
-        per_dev.append((uniq, inv, local_seg))
-        max_L = max(max_L, len(sel))
-        if len(uniq):
-            counts = np.bincount(uniq // cap, minlength=ns)
-            max_bucket = max(max_bucket, int(counts.max()))
-
-    # K-1 is always a pad slot; L_pad/K identical across devices so the mesh
-    # program has one shape (compute_thread_batch_nccl lockstep parity,
-    # data_set.cc:2069-2135)
-    K = _round_bucket(max_bucket + 1, bucket)
-    L_pad = _round_bucket(max_L, bucket)
-
-    req_ranks = np.full((n_devices, ns, K), cap - 1, dtype=np.int32)
-    inverse = np.full((n_devices, L_pad), K - 1, dtype=np.int32)
-    seg_out = np.full((n_devices, L_pad), S * b, dtype=np.int32)
-
-    for d, (uniq, inv, local_seg) in enumerate(per_dev):
-        shard_of = (uniq // cap).astype(np.int64)
-        rank_of = (uniq % cap).astype(np.int64)
-        order = np.argsort(shard_of, kind="stable")
-        counts = np.bincount(shard_of, minlength=ns)
-        # bucket position of each unique row: owner_shard*K + slot-in-bucket
-        pos_in_bucket = np.empty(len(uniq), dtype=np.int64)
-        start = 0
-        for s in range(ns):
-            c = int(counts[s])
-            req_ranks[d, s, :c] = rank_of[order[start : start + c]]
-            pos_in_bucket[order[start : start + c]] = s * K + np.arange(c)
-            start += c
-        inverse[d, : len(inv)] = pos_in_bucket[inv]
-        seg_out[d, : len(local_seg)] = local_seg
-
     labels, dense = _extract_labels_dense(batch, schema, label_slot, dense_slot, dense_dim)
-    labels = labels.reshape(n_devices, b)
-    if dense is not None:
-        dense = dense.reshape(n_devices, b, dense_dim)
-
-    return ShardedDeviceBatch(
-        local_batch=b,
-        num_slots=S,
-        req_ranks=req_ranks,
-        inverse=inverse,
-        segments=seg_out,
-        labels=labels,
-        dense=dense,
+    return _route_sharded(
+        rows,
+        segments,
+        batch.batch_size,
+        batch.num_sparse_slots,
+        ws,
+        n_devices,
+        bucket,
+        labels,
+        dense,
+        dense_dim,
     )
 
 
@@ -250,3 +281,189 @@ def pack_batch(
         n_keys=L,
         n_uniq=U,
     )
+
+
+class BatchPacker:
+    """Pass-scoped fast packer over a ColumnarRecords store.
+
+    Precomputes once per pass: key->row resolution for every key of the
+    store (vectorized), whole-pass label/dense-feature matrices. Per batch,
+    a single native call (csrc/batch_packer.cc) does the ragged row gather
+    + first-occurrence dedup + segment ids — the MiniBatchGpuPack::
+    pack_instance hot loop (data_feed.h:1418-1542) without any per-record
+    Python. Falls back to vectorized numpy when the native lib is absent.
+
+    Thread contract: pack()/pack_sharded() are safe to call from multiple
+    packer threads (each thread gets its own native scratch handle).
+    """
+
+    def __init__(
+        self,
+        store,  # ColumnarRecords
+        ws: PassWorkingSet,
+        schema: SlotSchema,
+        dense_slot: Optional[str] = None,
+        dense_dim: int = 0,
+        label_slot: Optional[str] = None,
+        bucket: Optional[int] = None,
+    ):
+        import threading
+
+        self.store = store
+        self.ws = ws
+        self.schema = schema
+        self.bucket = bucket or config.get_flag("batch_bucket_rounding")
+        self.dense_dim = dense_dim
+        self._rows = store.resolve_rows(ws)
+        self._key_counts = store.key_counts()
+        label_name = label_slot or schema.label_slot
+        if label_name is not None:
+            li = schema.float_slot_index(label_name)
+            self._labels = store.float_slot_matrix(li, 1)[:, 0].astype(np.float32)
+        else:
+            self._labels = np.zeros(len(store), np.float32)
+        if dense_slot is not None and dense_dim:
+            di = schema.float_slot_index(dense_slot)
+            self._dense = store.float_slot_matrix(di, dense_dim)
+        else:
+            self._dense = None
+        self._n_table_rows = ws.n_mesh_shards * ws.capacity
+        self._tls = threading.local()
+        self._use_native = config.get_flag("enable_native_parser")
+        self._dedup = config.get_flag("enable_pullpush_dedup_keys")
+        # sticky pad shapes: XLA compiles one program per distinct feed
+        # shape, so per-batch rounding would trigger a recompile whenever the
+        # unique-key count crosses a bucket boundary. Freeze L_pad/U_pad at
+        # first use (with headroom) and only ever grow — the reused-pack-
+        # buffer discipline of MiniBatchGpuPack (data_feed.h:1418-1542),
+        # re-motivated by the compiler. Updates happen under _shape_lock
+        # (prefetch packs from several threads; shapes must not diverge).
+        self._shape_lock = threading.Lock()
+        self._L_pad = 0  # pack(): whole-batch; pack_sharded(): per-device
+        self._U_pad = 0
+        self._K_pad = 0
+
+    def freeze_shapes(self, batch_indices, n_devices: int = 0) -> None:
+        """Fix L_pad for a whole pass upfront so every batch compiles to ONE
+        device program: L is exactly computable per batch from the record
+        key counts (per device when ``n_devices`` > 0 — the sharded feed's
+        L dimension is per-device). Call with the pass's batch partition
+        before the first pack."""
+        max_L = 1
+        for idx in batch_indices:
+            counts = self._key_counts[np.asarray(idx)]
+            if n_devices:
+                per_dev = counts.reshape(n_devices, -1).sum(axis=1)
+                max_L = max(max_L, int(per_dev.max()))
+            else:
+                max_L = max(max_L, int(counts.sum()))
+        with self._shape_lock:
+            self._L_pad = max(self._L_pad, _round_bucket(max_L, self.bucket))
+
+    def _native(self):
+        from paddlebox_tpu.utils import native
+
+        p = getattr(self._tls, "packer", None)
+        if p is None and self._use_native and native.available():
+            p = native.NativePacker(
+                self._rows,
+                self.store.u64_base,
+                self.store.u64_offsets,
+                self.store.n_sparse,
+                self._n_table_rows,
+            )
+            self._tls.packer = p
+        return p
+
+    def _gather_flat(self, indices: np.ndarray):
+        """(uniq[U], inverse[L], segments[L]) for the batch, unpadded."""
+        indices = np.asarray(indices, dtype=np.int64)
+        L = int(self._key_counts[indices].sum())
+        p = self._native() if self._dedup else None
+        if p is not None:
+            return (*p.pack(indices, L), L)
+        # numpy fallback: per-slot ragged gather (slot-major), then unique
+        from paddlebox_tpu.data.record_store import _ragged_indices
+
+        S = self.store.n_sparse
+        B = len(indices)
+        off = self.store.u64_offsets[indices].astype(np.int64)
+        base = self.store.u64_base[indices]
+        parts, segs = [], []
+        for s in range(S):
+            starts = base + off[:, s]
+            lens = off[:, s + 1] - off[:, s]
+            parts.append(self._rows[_ragged_indices(starts, lens)])
+            segs.append(np.repeat(s * B + np.arange(B, dtype=np.int32), lens))
+        rows = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+        segments = np.concatenate(segs) if segs else np.zeros(0, np.int32)
+        if self._dedup:
+            uniq, inverse = np.unique(rows, return_inverse=True)
+        else:
+            uniq, inverse = rows, np.arange(L, dtype=np.int64)
+        return uniq.astype(np.int32), inverse.astype(np.int32), segments, L
+
+    def pack(self, indices: np.ndarray) -> DeviceBatch:
+        """Batch of store records ``indices`` -> single-device DeviceBatch."""
+        uniq, inverse, segments, L = self._gather_flat(indices)
+        B = len(indices)
+        S = self.store.n_sparse
+        U = len(uniq)
+        with self._shape_lock:
+            self._L_pad = max(self._L_pad, _round_bucket(L, self.bucket))
+            if self._U_pad == 0:
+                # generous first-batch headroom (25%) so later batches rarely
+                # grow the shape; capped at L_pad+1 since U <= L always
+                self._U_pad = _round_bucket(U + max(self.bucket, U // 4), self.bucket)
+            else:
+                self._U_pad = max(self._U_pad, _round_bucket(U + 1, self.bucket))
+            self._U_pad = min(self._U_pad, _round_bucket(self._L_pad + 1, self.bucket))
+            L_pad, U_pad = self._L_pad, self._U_pad
+        uniq_p = np.full(U_pad, self.ws.padding_row, dtype=np.int32)
+        uniq_p[:U] = uniq
+        inv_p = np.full(L_pad, U_pad - 1, dtype=np.int32)
+        inv_p[:L] = inverse
+        seg_p = np.full(L_pad, S * B, dtype=np.int32)
+        seg_p[:L] = segments
+        return DeviceBatch(
+            batch_size=B,
+            num_slots=S,
+            uniq_rows=uniq_p,
+            inverse=inv_p,
+            segments=seg_p,
+            labels=self._labels[indices],
+            dense=self._dense[indices] if self._dense is not None else None,
+            n_keys=L,
+            n_uniq=U,
+        )
+
+    def pack_sharded(self, indices: np.ndarray, n_devices: int) -> ShardedDeviceBatch:
+        """Batch -> mesh-routed ShardedDeviceBatch (fast gather + routing)."""
+        uniq, inverse, segments, L = self._gather_flat(indices)
+        rows = uniq[inverse] if len(uniq) else np.zeros(0, np.int32)
+        with self._shape_lock:
+            k_floor, l_floor = self._K_pad or -1, self._L_pad
+        out = _route_sharded(
+            rows,
+            segments,
+            len(indices),
+            self.store.n_sparse,
+            self.ws,
+            n_devices,
+            self.bucket,
+            self._labels[indices],
+            self._dense[indices] if self._dense is not None else None,
+            self.dense_dim,
+            k_floor=k_floor,
+            l_floor=l_floor,
+        )
+        with self._shape_lock:
+            self._K_pad = max(self._K_pad, out.req_ranks.shape[2])
+            self._L_pad = max(self._L_pad, out.inverse.shape[1])
+        return out
+
+    def close(self) -> None:
+        p = getattr(self._tls, "packer", None)
+        if p is not None:
+            p.close()
+            self._tls.packer = None

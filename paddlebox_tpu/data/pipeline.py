@@ -1,0 +1,60 @@
+"""Overlapped feed pipeline: background pack + device upload.
+
+The reference keeps GPUs fed by packing minibatches on pinned host buffers
+in worker threads and issuing async H2D copies ahead of compute
+(MiniBatchGpuPack + copy_host2device, data_feed.h:1418-1542, :1492-1504).
+The TPU analog: a small thread pool runs pack (native C++, GIL-released)
+and ``device_put`` (async under the hood — it returns before the transfer
+completes) for batch N+1..N+depth while the device steps batch N. The
+consumer sees feeds strictly in batch order; depth bounds host memory the
+way the reference's reused pack buffers do.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+from paddlebox_tpu import config
+
+config.define_flag("feed_pipeline_workers", 3, "background packer thread count")
+config.define_flag(
+    "feed_pipeline_depth", 6, "max batches packed/uploaded ahead of compute"
+)
+
+
+def prefetch(
+    jobs: Iterable[T],
+    fn: Callable[[T], R],
+    workers: int | None = None,
+    depth: int | None = None,
+) -> Iterator[R]:
+    """Yield ``fn(job)`` in order, computing up to ``depth`` jobs ahead on
+    ``workers`` threads. Exceptions surface at the failing job's position;
+    the window keeps order deterministic (same batches, same sequence, with
+    or without the pipeline)."""
+    workers = workers or config.get_flag("feed_pipeline_workers")
+    depth = depth or config.get_flag("feed_pipeline_depth")
+    it = iter(jobs)
+    ex = ThreadPoolExecutor(max_workers=workers)
+    futs: deque = deque()
+    try:
+        for job in it:
+            futs.append(ex.submit(fn, job))
+            if len(futs) >= depth:
+                break
+        sentinel = object()
+        while futs:
+            f = futs.popleft()
+            nxt = next(it, sentinel)
+            if nxt is not sentinel:
+                futs.append(ex.submit(fn, nxt))
+            yield f.result()
+    finally:
+        for f in futs:
+            f.cancel()
+        ex.shutdown(wait=True, cancel_futures=True)

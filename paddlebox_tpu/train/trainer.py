@@ -26,7 +26,8 @@ import optax
 
 from paddlebox_tpu.data.dataset import BoxPSDataset
 from paddlebox_tpu.fleet.zero import Zero1Optimizer
-from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
+from paddlebox_tpu.data.device_pack import BatchPacker, pack_batch, pack_batch_sharded
+from paddlebox_tpu.data.pipeline import prefetch
 from paddlebox_tpu.metrics.auc import auc_compute, auc_init
 from paddlebox_tpu.metrics.registry import MetricRegistry
 from paddlebox_tpu.parallel.mesh import MeshPlan
@@ -156,7 +157,19 @@ class CTRTrainer:
 
     # ---- pass loop -------------------------------------------------------
 
-    def _make_state(self, dev_table: np.ndarray) -> TrainState:
+    def _make_state(self, dev_table: np.ndarray, ws_key: Optional[int] = None) -> TrainState:
+        # within one pass (same working set), later train_pass calls — the
+        # update phase after join, extra epochs, eval — must see the rows
+        # the earlier calls trained, exactly as the reference's device table
+        # persists between phases (BeginPass..EndPass, box_wrapper.cc:
+        # 615-651). Rebuild only when the working set changes.
+        if (
+            self._state is not None
+            and ws_key is not None
+            and getattr(self, "_state_ws", None) is ws_key
+        ):
+            return self._state
+        self._state_ws = ws_key
         if self.params is None:
             self.init_params()
         if self.plan is None:
@@ -202,6 +215,93 @@ class CTRTrainer:
             k: jax.device_put(v, self.plan.batch_sharding) for k, v in db.as_dict().items()
         }
 
+    def _feed_aux(self, feed, batch=None, ins_weight=None, cmatch=None, rank=None):
+        """(device feed, registry aux) tuple for the step loop."""
+        aux = {}
+        if batch is not None:
+            cmatch, rank = batch.cmatch, batch.rank
+        if cmatch is not None:
+            aux["cmatch"] = cmatch
+        if rank is not None:
+            aux["rank"] = rank
+        if ins_weight is not None:
+            aux["ins_weight"] = ins_weight
+        return feed, aux
+
+    def _pv_feed_iter(self, dataset, n_batches):
+        for batch, ins_weight in dataset.pv_batches(n_batches):
+            feed = self._pack_and_put(batch, dataset.ws)
+            if ins_weight is not None:
+                feed["ins_weight"] = jnp.asarray(ins_weight)
+            if batch.rank_offset is not None:
+                feed["rank_offset"] = jnp.asarray(batch.rank_offset)
+            yield self._feed_aux(feed, batch=batch, ins_weight=ins_weight)
+
+    def _slow_feed_iter(self, dataset, n_batches):
+        for batch in dataset.batches(n_batches):
+            yield self._feed_aux(
+                self._pack_and_put(batch, dataset.ws), batch=batch
+            )
+
+    def _get_packer(self, dataset) -> BatchPacker:
+        """One BatchPacker per (store, working set): keeps pad shapes — and
+        thus the compiled device program — stable across train_pass calls
+        within a pass (warmup + epochs share one XLA executable)."""
+        cached = getattr(self, "_packer_cache", None)
+        if (
+            cached is not None
+            and cached[0] is dataset.store
+            and cached[1] is dataset.ws
+        ):
+            return cached[2]
+        if cached is not None:
+            cached[2].close()
+        packer = BatchPacker(
+            dataset.store,
+            dataset.ws,
+            self._schema,
+            dense_slot=self.dense_slot,
+            dense_dim=self.dense_dim,
+            bucket=self.pack_bucket,
+        )
+        self._packer_cache = (dataset.store, dataset.ws, packer)
+        return packer
+
+    def _fast_feed_iter(self, dataset, n_batches):
+        """Columnar fast path: native pack + device upload in background
+        threads, overlapped with the device step (MiniBatchGpuPack async
+        pipeline parity, data_feed.h:1418-1542)."""
+        store = dataset.store
+        packer = self._get_packer(dataset)
+        # one compiled program for the whole pass: L_pad frozen from the
+        # full batch partition (U_pad/K self-stabilize with headroom)
+        packer.freeze_shapes(
+            dataset.batch_indices(n_batches),
+            n_devices=self.plan.n_devices if self.plan is not None else 0,
+        )
+        has_meta = store.ins_id_off is not None
+
+        def prep(idx):
+            if self.plan is None:
+                db = packer.pack(idx)
+                feed = {
+                    k: jax.device_put(v) for k, v in db.as_dict().items()
+                }
+            else:
+                db = packer.pack_sharded(idx, self.plan.n_devices)
+                feed = {
+                    k: jax.device_put(v, self.plan.batch_sharding)
+                    for k, v in db.as_dict().items()
+                }
+            return idx, feed
+
+        for idx, feed in prefetch(dataset.batch_indices(n_batches), prep):
+            yield self._feed_aux(
+                feed,
+                cmatch=store.cmatch[idx] if has_meta else None,
+                rank=store.rank[idx] if has_meta else None,
+            )
+
     def train_pass(
         self,
         dataset: BoxPSDataset,
@@ -217,7 +317,9 @@ class CTRTrainer:
         if dataset.device_table is None:
             raise RuntimeError("dataset.begin_pass() first")
         self._schema = dataset.schema
-        state = self._make_state(dataset.device_table)
+        # the ws OBJECT is the cache key (an id() could be recycled across
+        # passes and silently serve the previous pass's state)
+        state = self._make_state(dataset.device_table, ws_key=dataset.ws)
         losses = []
         # join phase serves pv-merged batches with rank_offset + ghost
         # weights; update phase serves flat batches (EnablePvMerge branch,
@@ -229,16 +331,13 @@ class CTRTrainer:
                     "join-phase pv batches are single-device for now; shard "
                     "the update phase or run join on one chip"
                 )
-            iterator = dataset.pv_batches(n_batches)
+            iterator = self._pv_feed_iter(dataset, n_batches)
+        elif dataset.store is not None:
+            iterator = self._fast_feed_iter(dataset, n_batches)
         else:
-            iterator = ((b, None) for b in dataset.batches(n_batches))
+            iterator = self._slow_feed_iter(dataset, n_batches)
         is_async = self.cfg.dense_sync_mode == "async"
-        for i, (batch, ins_weight) in enumerate(iterator):
-            feed = self._pack_and_put(batch, dataset.ws)
-            if ins_weight is not None:
-                feed["ins_weight"] = jnp.asarray(ins_weight)
-            if batch.rank_offset is not None:
-                feed["rank_offset"] = jnp.asarray(batch.rank_offset)
+        for i, (feed, aux) in enumerate(iterator):
             if is_async:  # PullDense / PushDense worker loop (B6)
                 state = state._replace(
                     params=jax.device_put(self.async_dense.pull_dense())
@@ -250,12 +349,7 @@ class CTRTrainer:
                 # per-batch registry feed with phase + logkey-derived vars
                 # (AddAucMonitor parity, boxps_worker.cc:408-418)
                 outputs = dict(m)
-                if batch.cmatch is not None:
-                    outputs["cmatch"] = batch.cmatch
-                if batch.rank is not None:
-                    outputs["rank"] = batch.rank
-                if ins_weight is not None:
-                    outputs["ins_weight"] = ins_weight
+                outputs.update(aux)
                 self.metric_registry.add_all(outputs, phase=dataset.current_phase)
             if on_batch is not None:
                 on_batch(i, m)

@@ -26,7 +26,10 @@ from paddlebox_tpu.data.slot_record import SlotRecord
 from paddlebox_tpu.data.slot_schema import SlotSchema
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO, "csrc", "slot_parser.cc")
+_SRCS = [
+    os.path.join(_REPO, "csrc", "slot_parser.cc"),
+    os.path.join(_REPO, "csrc", "batch_packer.cc"),
+]
 _LIB = os.path.join(_REPO, "csrc", "build", "libpbx_parser.so")
 
 _lock = threading.Lock()
@@ -44,7 +47,7 @@ def _build() -> bool:
     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB] + _SRCS,
             check=True,
             capture_output=True,
             timeout=120,
@@ -54,14 +57,23 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """Rebuild when any source is newer than the cached .so."""
+    try:
+        t = os.path.getmtime(_LIB)
+        return any(os.path.getmtime(s) > t for s in _SRCS)
+    except OSError:
+        return True
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB):
-            if not (os.path.exists(_SRC) and _build()):
+        if not os.path.exists(_LIB) or _stale():
+            if not (all(os.path.exists(s) for s in _SRCS) and _build()):
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
@@ -90,8 +102,108 @@ def _load() -> Optional[ctypes.CDLL]:
             getattr(lib, name).argtypes = [ctypes.c_void_p]
         lib.pbx_free.restype = None
         lib.pbx_free.argtypes = [ctypes.c_void_p]
+        lib.pbx_packer_create.restype = ctypes.c_void_p
+        lib.pbx_packer_create.argtypes = [
+            _i32p, _i64p, _u32p, ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+        ]
+        lib.pbx_pack_batch.restype = ctypes.c_int64
+        lib.pbx_pack_batch.argtypes = [
+            ctypes.c_void_p, _i64p, ctypes.c_int64, _i32p, _i32p, _i32p,
+        ]
+        lib.pbx_packer_free.restype = None
+        lib.pbx_packer_free.argtypes = [ctypes.c_void_p]
+        lib.pbx_gather_f32_slot.restype = None
+        lib.pbx_gather_f32_slot.argtypes = [
+            _f32p, _i64p, _u32p, ctypes.c_int, _i64p, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, _f32p,
+        ]
         _lib = lib
         return _lib
+
+
+def _as_ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def gather_f32_slot(
+    f_values: np.ndarray,
+    f_base: np.ndarray,
+    f_offsets: np.ndarray,
+    indices: np.ndarray,
+    slot: int,
+    dim: int,
+) -> np.ndarray:
+    """[n, dim] ragged float-slot gather (short rows zero-padded, long rows
+    truncated) — native tier for ColumnarRecords.float_slot_matrix."""
+    lib = _load()
+    f_values = np.ascontiguousarray(f_values, dtype=np.float32)
+    f_base = np.ascontiguousarray(f_base, dtype=np.int64)
+    f_offsets = np.ascontiguousarray(f_offsets, dtype=np.uint32)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    out = np.empty((len(indices), dim), np.float32)
+    lib.pbx_gather_f32_slot(
+        _as_ptr(f_values, ctypes.c_float),
+        _as_ptr(f_base, ctypes.c_int64),
+        _as_ptr(f_offsets, ctypes.c_uint32),
+        f_offsets.shape[1],
+        _as_ptr(indices, ctypes.c_int64),
+        len(indices),
+        slot,
+        dim,
+        _as_ptr(out, ctypes.c_float),
+    )
+    return out
+
+
+class NativePacker:
+    """Per-thread handle over one pass's row-resolved columnar records.
+
+    ``pack(indices)`` -> (uniq_rows[U], inverse[L], segments[L]) unpadded;
+    the device_pack wrapper buckets/pads. The referenced arrays are pinned
+    on the instance so the C++ side's borrowed pointers stay alive.
+    """
+
+    def __init__(self, rows: np.ndarray, rec_base: np.ndarray,
+                 rec_off: np.ndarray, n_sparse: int, n_table_rows: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native packer unavailable (g++ build failed?)")
+        self._lib = lib
+        # keep contiguous copies alive for the borrowed C++ pointers
+        self._rows = np.ascontiguousarray(rows, dtype=np.int32)
+        self._base = np.ascontiguousarray(rec_base, dtype=np.int64)
+        self._off = np.ascontiguousarray(rec_off, dtype=np.uint32)
+        self._h = lib.pbx_packer_create(
+            _as_ptr(self._rows, ctypes.c_int32),
+            _as_ptr(self._base, ctypes.c_int64),
+            _as_ptr(self._off, ctypes.c_uint32),
+            len(self._base), n_sparse, int(n_table_rows),
+        )
+
+    def pack(self, indices: np.ndarray, n_keys: int):
+        indices = np.ascontiguousarray(indices, dtype=np.int64)
+        uniq = np.empty(n_keys, np.int32)
+        inv = np.empty(n_keys, np.int32)
+        seg = np.empty(n_keys, np.int32)
+        U = self._lib.pbx_pack_batch(
+            self._h, _as_ptr(indices, ctypes.c_int64), len(indices),
+            _as_ptr(uniq, ctypes.c_int32), _as_ptr(inv, ctypes.c_int32),
+            _as_ptr(seg, ctypes.c_int32),
+        )
+        if U < 0:
+            raise ValueError("native pack: record index or row out of range")
+        return uniq[:U], inv, seg
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pbx_packer_free(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort; close() is the real contract
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def available() -> bool:
@@ -104,12 +216,14 @@ def _copy(ptr, n, dtype):
     return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
 
 
-def parse_buffer(
+def parse_buffer_columnar(
     data: bytes, schema: SlotSchema, stats: Optional[dict] = None
-) -> List[SlotRecord]:
-    """Parse a whole file's bytes natively -> SlotRecords (views over two
-    flat arrays). Raises ValueError with the native line diagnostic.
-    ``stats["skipped"]`` receives the no-feasign-record count."""
+):
+    """Parse a whole file's bytes natively -> ColumnarRecords (one copy per
+    array, zero per-record Python work). Raises ValueError with the native
+    line diagnostic. ``stats["skipped"]`` receives the no-feasign count."""
+    from paddlebox_tpu.data.record_store import ColumnarRecords
+
     lib = _load()
     if lib is None:
         raise RuntimeError("native parser unavailable (g++ build failed?)")
@@ -131,44 +245,35 @@ def parse_buffer(
         if stats is not None:
             stats["skipped"] = int(lib.pbx_num_skipped(h))
         n_u, n_f = lib.pbx_num_u64(h), lib.pbx_num_f(h)
-        u_vals = _copy(lib.pbx_u64_values(h), n_u, np.uint64)
-        f_vals = _copy(lib.pbx_f_values(h), n_f, np.float32)
         Su, Sf = schema.num_sparse, schema.num_float
-        u_off = _copy(lib.pbx_u64_offsets(h), n * (Su + 1), np.uint32).reshape(n, Su + 1)
-        f_off = _copy(lib.pbx_f_offsets(h), n * (Sf + 1), np.uint32).reshape(n, Sf + 1)
-        u_base = _copy(lib.pbx_u64_base(h), n, np.int64)
-        f_base = _copy(lib.pbx_f_base(h), n, np.int64)
-        sids = _copy(lib.pbx_search_ids(h), n, np.uint64)
-        cms = _copy(lib.pbx_cmatch(h), n, np.int32)
-        rks = _copy(lib.pbx_rank(h), n, np.int32)
         want_ids = schema.parse_ins_id or schema.parse_logkey
+        ins_off = None
+        chars = b""
         if want_ids and n:
-            ioff = _copy(lib.pbx_ins_id_off(h), n + 1, np.int64)
-            # offsets are BYTE offsets: slice the raw bytes, decode per id
-            chars = ctypes.string_at(
-                lib.pbx_ins_id_chars_ptr(h), lib.pbx_ins_chars(h)
-            )
-        recs: List[SlotRecord] = []
-        for r in range(n):
-            recs.append(
-                SlotRecord(
-                    u64_values=u_vals[u_base[r] : u_base[r] + u_off[r, -1]],
-                    u64_offsets=u_off[r],
-                    f_values=f_vals[f_base[r] : f_base[r] + f_off[r, -1]],
-                    f_offsets=f_off[r],
-                    ins_id=(
-                        chars[ioff[r] : ioff[r + 1]].decode(errors="replace")
-                        if want_ids
-                        else ""
-                    ),
-                    search_id=int(sids[r]),
-                    cmatch=int(cms[r]),
-                    rank=int(rks[r]),
-                )
-            )
-        return recs
+            ins_off = _copy(lib.pbx_ins_id_off(h), n + 1, np.int64)
+            chars = ctypes.string_at(lib.pbx_ins_id_chars_ptr(h), lib.pbx_ins_chars(h))
+        return ColumnarRecords(
+            _copy(lib.pbx_u64_values(h), n_u, np.uint64),
+            _copy(lib.pbx_u64_offsets(h), n * (Su + 1), np.uint32).reshape(n, Su + 1),
+            _copy(lib.pbx_u64_base(h), n, np.int64),
+            _copy(lib.pbx_f_values(h), n_f, np.float32),
+            _copy(lib.pbx_f_offsets(h), n * (Sf + 1), np.uint32).reshape(n, Sf + 1),
+            _copy(lib.pbx_f_base(h), n, np.int64),
+            search_ids=_copy(lib.pbx_search_ids(h), n, np.uint64),
+            cmatch=_copy(lib.pbx_cmatch(h), n, np.int32),
+            rank=_copy(lib.pbx_rank(h), n, np.int32),
+            ins_id_off=ins_off,
+            ins_id_chars=chars,
+        )
     finally:
         lib.pbx_free(h)
+
+
+def parse_buffer(
+    data: bytes, schema: SlotSchema, stats: Optional[dict] = None
+) -> List[SlotRecord]:
+    """Compat wrapper: columnar parse, then materialize SlotRecord views."""
+    return parse_buffer_columnar(data, schema, stats).records()
 
 
 def parse_file(
@@ -176,3 +281,8 @@ def parse_file(
 ) -> List[SlotRecord]:
     with open(path, "rb") as f:
         return parse_buffer(f.read(), schema, stats)
+
+
+def parse_file_columnar(path: str, schema: SlotSchema, stats: Optional[dict] = None):
+    with open(path, "rb") as f:
+        return parse_buffer_columnar(f.read(), schema, stats)
