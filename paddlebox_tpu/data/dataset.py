@@ -162,6 +162,7 @@ class BoxPSDataset:
         nranks: int = 1,
         shuffle_mode: str = "none",  # none|local|search_id|ins_id|random
         router: Optional[LocalShuffleRouter] = None,
+        transport=None,  # parallel.transport.TcpTransport for multi-host
         pipe_command: Optional[str] = None,
         line_parser: Optional[Callable[[str, SlotSchema], Optional[SlotRecord]]] = None,
         drop_remainder: bool = True,
@@ -180,6 +181,7 @@ class BoxPSDataset:
         self.nranks = nranks
         self.shuffle_mode = shuffle_mode
         self.router = router
+        self.transport = transport
         self.pipe_command = pipe_command
         self.line_parser = line_parser or parse_line
         self.drop_remainder = drop_remainder
@@ -361,7 +363,16 @@ class BoxPSDataset:
         self._stats_lock = threading.Lock()
         stats = PassStats(files=len(self._filelist))
         self._loading_stats = stats
-        ws = PassWorkingSet(n_mesh_shards=self.n_mesh_shards)
+        if self.transport is not None and self.transport.n_ranks > 1:
+            # multi-host: host-sharded table ownership + key exchange;
+            # n_mesh_shards is the GLOBAL mesh shard count
+            from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+
+            ws = DistributedWorkingSet(
+                self.transport, self.n_mesh_shards, pass_id=self.pass_id
+            )
+        else:
+            ws = PassWorkingSet(n_mesh_shards=self.n_mesh_shards)
         parts: list = []
         if self._filelist:
             with ThreadPoolExecutor(max_workers=self.read_threads) as pool:

@@ -228,6 +228,45 @@ class ColumnarRecords:
             ins_id_off=ins_off, ins_id_chars=chars,
         )
 
+    # ---- wire format (cross-process shuffle / working-set exchange) ------
+
+    def to_bytes(self) -> bytes:
+        """Serialize for the host transport (npz container: versioned,
+        self-describing, no pickle)."""
+        import io
+
+        bio = io.BytesIO()
+        arrays = {
+            "u64_values": self.u64_values,
+            "u64_offsets": self.u64_offsets,
+            "u64_base": self.u64_base,
+            "f_values": self.f_values,
+            "f_offsets": self.f_offsets,
+            "f_base": self.f_base,
+            "search_ids": self.search_ids,
+            "cmatch": self.cmatch,
+            "rank": self.rank,
+        }
+        if self.ins_id_off is not None:
+            arrays["ins_id_off"] = self.ins_id_off
+            arrays["ins_id_chars"] = np.frombuffer(self.ins_id_chars, np.uint8)
+        np.savez(bio, **arrays)
+        return bio.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ColumnarRecords":
+        import io
+
+        z = np.load(io.BytesIO(data))
+        ins_off = z["ins_id_off"] if "ins_id_off" in z.files else None
+        chars = z["ins_id_chars"].tobytes() if "ins_id_chars" in z.files else b""
+        return cls(
+            z["u64_values"], z["u64_offsets"], z["u64_base"],
+            z["f_values"], z["f_offsets"], z["f_base"],
+            search_ids=z["search_ids"], cmatch=z["cmatch"], rank=z["rank"],
+            ins_id_off=ins_off, ins_id_chars=chars,
+        )
+
     # ---- pass-scoped precomputation -------------------------------------
 
     def resolve_rows(self, ws) -> np.ndarray:

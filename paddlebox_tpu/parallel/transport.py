@@ -1,0 +1,215 @@
+"""Cross-process host transport: the open PaddleShuffler/MPICluster tier.
+
+The reference moves records between nodes through the closed
+``boxps::PaddleShuffler`` (data_set.cc:1757-1926) and coordinates dense
+sync/membership through the closed ``boxps::MPICluster`` (box_wrapper.h:
+415-566). On TPU the *device* plane needs neither (XLA collectives over
+ICI/DCN do dense sync); what remains is the *host* plane — record shuffle,
+pass working-set key exchange, batch-count lockstep — which this module
+provides over plain TCP:
+
+- ``TcpTransport``: rank<->rank tagged message frames with persistent
+  connections; primitives ``alltoall`` / ``allgather`` / ``allreduce_max``
+  / ``barrier``. Peers are ``host:port`` strings, so the same code runs
+  2 localhost subprocesses (the reference's own test pattern,
+  test_dist_fleet_base.py:158-260) or N real hosts over DCN.
+- ``TcpShuffleRouter``: the LocalShuffleRouter exchange/collect contract
+  across processes, chunks = serialized ColumnarRecords.
+
+Tags scope rounds (e.g. ``shuffle:3``): a fast rank's frames for round
+N+1 queue in the inbox without corrupting a slow rank's round N collect.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_HDR = struct.Struct("<III")  # src_rank, tag_len, payload_len
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class TcpTransport:
+    """Tagged rank-to-rank byte transport over TCP."""
+
+    def __init__(self, rank: int, endpoints: List[str], timeout: float = 120.0):
+        self.rank = rank
+        self.n_ranks = len(endpoints)
+        self.timeout = timeout
+        self._endpoints = [self._parse(e) for e in endpoints]
+        self._inbox: Dict[Tuple[str, int], bytes] = {}
+        self._cond = threading.Condition()
+        self._send_socks: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {
+            r: threading.Lock() for r in range(self.n_ranks)
+        }
+        self._closed = False
+        # listener
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        host, port = self._endpoints[rank]
+        self._server.bind((host, port))
+        # rebind with the OS-assigned port if 0 was requested
+        self._endpoints[rank] = self._server.getsockname()
+        self._server.listen(self.n_ranks * 4)
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    @staticmethod
+    def _parse(ep: str) -> Tuple[str, int]:
+        host, port = ep.rsplit(":", 1)
+        return host, int(port)
+
+    @property
+    def port(self) -> int:
+        return self._endpoints[self.rank][1]
+
+    # ---- receive side ----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._reader, args=(conn,), daemon=True
+            ).start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = _recv_exact(conn, _HDR.size)
+                src, tag_len, n = _HDR.unpack(hdr)
+                tag = _recv_exact(conn, tag_len).decode()
+                payload = _recv_exact(conn, n)
+                with self._cond:
+                    self._inbox[(tag, src)] = payload
+                    self._cond.notify_all()
+        except (ConnectionError, OSError):
+            return
+
+    def _take(self, tag: str, src: int) -> bytes:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: (tag, src) in self._inbox, timeout=self.timeout
+            )
+            if not ok:
+                raise TimeoutError(
+                    f"rank {self.rank}: no frame tag={tag!r} from rank {src} "
+                    f"within {self.timeout}s"
+                )
+            return self._inbox.pop((tag, src))
+
+    # ---- send side -------------------------------------------------------
+
+    def _sock_to(self, dst: int) -> socket.socket:
+        s = self._send_socks.get(dst)
+        if s is None:
+            s = socket.create_connection(self._endpoints[dst], timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._send_socks[dst] = s
+        return s
+
+    def send(self, dst: int, tag: str, payload: bytes) -> None:
+        tb = tag.encode()
+        if dst == self.rank:
+            with self._cond:
+                self._inbox[(tag, self.rank)] = payload
+                self._cond.notify_all()
+            return
+        with self._send_locks[dst]:
+            s = self._sock_to(dst)
+            s.sendall(_HDR.pack(self.rank, len(tb), len(payload)) + tb + payload)
+
+    # ---- collectives -----------------------------------------------------
+
+    def alltoall(self, payloads: List[bytes], tag: str) -> List[bytes]:
+        """payloads[d] goes to rank d; returns what every rank sent here."""
+        if len(payloads) != self.n_ranks:
+            raise ValueError(f"need {self.n_ranks} payloads, got {len(payloads)}")
+        for dst in range(self.n_ranks):
+            self.send(dst, tag, payloads[dst])
+        return [self._take(tag, src) for src in range(self.n_ranks)]
+
+    def allgather(self, payload: bytes, tag: str) -> List[bytes]:
+        return self.alltoall([payload] * self.n_ranks, tag)
+
+    def allreduce_max(self, value: int, tag: str) -> int:
+        vals = self.allgather(struct.pack("<q", int(value)), tag)
+        return max(struct.unpack("<q", v)[0] for v in vals)
+
+    def barrier(self, tag: str) -> None:
+        self.allgather(b"", "barrier:" + tag)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for s in self._send_socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class TcpShuffleRouter:
+    """LocalShuffleRouter's exchange/collect contract across processes.
+
+    One router per (transport, dataset); ``exchange`` serializes each
+    destination's ColumnarRecords chunk and all-to-alls them; ``collect``
+    deserializes what arrived. The zero-length completion message of the
+    reference's protocol (data_set.cc:1835-1866) is implicit: alltoall
+    always delivers exactly one (possibly empty) chunk per peer.
+    """
+
+    def __init__(self, transport: TcpTransport):
+        self.transport = transport
+        self.n_nodes = transport.n_ranks
+        self._round = 0
+
+    def exchange(self, from_node: int, parts: list) -> None:
+        from paddlebox_tpu.data.record_store import ColumnarRecords
+
+        if from_node != self.transport.rank:
+            raise ValueError("exchange must be called by the owning rank")
+        payloads = []
+        for chunk in parts:
+            if isinstance(chunk, ColumnarRecords):
+                payloads.append(chunk.to_bytes())
+            elif len(chunk) == 0:
+                payloads.append(b"")
+            else:
+                raise TypeError(
+                    "TcpShuffleRouter moves ColumnarRecords chunks; got "
+                    f"{type(chunk).__name__} (enable the native parser or "
+                    "convert with ColumnarRecords.from_records)"
+                )
+        self._received = self.transport.alltoall(
+            payloads, f"shuffle:{self._round}"
+        )
+
+    def collect(self, node: int) -> list:
+        from paddlebox_tpu.data.record_store import ColumnarRecords
+
+        if node != self.transport.rank:
+            raise ValueError("collect must be called by the owning rank")
+        out = [
+            ColumnarRecords.from_bytes(p) for p in self._received if p
+        ]
+        self._received = None
+        self._round += 1
+        return out

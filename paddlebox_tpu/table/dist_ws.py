@@ -1,0 +1,180 @@
+"""Multi-host pass working set: host-sharded table ownership + key exchange.
+
+The reference's pass open (`BeginFeedPass`, box_wrapper.cc:580) hands every
+feasign of the pass to the closed boxps lib, which shards keys across MPI
+nodes and stages each node's slice into its GPUs. This module is that tier
+in the open: mesh shards partition keys (`key_to_shard(key, n_mesh)`), each
+host OWNS the contiguous shard range of its local devices, and a two-round
+host exchange builds the pass:
+
+  round 1 (request):  every host all-to-alls the pass keys it saw to the
+                      keys' owner hosts;
+  round 2 (reply):    each owner dedups, assigns ranks (ascending key order
+                      per shard — identical layout to the single-process
+                      PassWorkingSet), pulls/creates rows in its LOCAL
+                      HostSparseTable slice, and replies to each requester
+                      with the global row ids of the keys it asked about.
+
+Capacity is allreduce-max'd so every host compiles the same shapes
+(lockstep parity, compute_thread_batch_nccl data_set.cc:2069-2135), and
+writeback is purely local: a host's trained device slice lands in its own
+host table — no cross-host traffic at pass end.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from paddlebox_tpu.table.sparse_table import HostSparseTable, key_to_shard
+
+
+class DistributedWorkingSet:
+    """Pass working set across hosts; same pack-time surface as
+    PassWorkingSet (n_mesh_shards / capacity / padding_row / lookup)."""
+
+    def __init__(self, transport, n_mesh_shards: int, pass_id: int = 0):
+        self.transport = transport
+        self.n_mesh_shards = n_mesh_shards
+        n_hosts = transport.n_ranks
+        if n_mesh_shards % n_hosts:
+            raise ValueError(
+                f"{n_mesh_shards} mesh shards not divisible by {n_hosts} hosts"
+            )
+        self.shards_per_host = n_mesh_shards // n_hosts
+        self.shard_lo = transport.rank * self.shards_per_host
+        self.pass_id = pass_id
+        self._key_chunks: List[np.ndarray] = []
+        self._lock = threading.Lock()
+        self._finalized = False
+        # set by finalize():
+        self.sorted_keys: Optional[np.ndarray] = None  # referenced keys
+        self.row_of_sorted: Optional[np.ndarray] = None
+        self.capacity = 0
+        self.n_keys = 0  # locally referenced
+        self.owned_shard_keys: Optional[List[np.ndarray]] = None
+
+    def add_keys(self, keys: np.ndarray) -> None:
+        if self._finalized:
+            raise RuntimeError("working set already finalized")
+        if len(keys):
+            with self._lock:
+                self._key_chunks.append(np.unique(keys.astype(np.uint64)))
+
+    def _owner_host(self, keys: np.ndarray) -> np.ndarray:
+        return key_to_shard(keys, self.n_mesh_shards) // self.shards_per_host
+
+    def finalize(self, table: HostSparseTable, round_to: int = 512) -> np.ndarray:
+        """Two-round exchange; returns THIS host's device slice
+        ``[shards_per_host, capacity, width]`` (global row of key =
+        global_shard * capacity + rank, exactly the single-process layout).
+        """
+        t = self.transport
+        with self._lock:
+            if self._key_chunks:
+                referenced = np.unique(np.concatenate(self._key_chunks))
+            else:
+                referenced = np.zeros(0, dtype=np.uint64)
+            self._key_chunks = []
+        self.n_keys = len(referenced)
+
+        # round 1: route referenced keys to their owner hosts
+        owners = self._owner_host(referenced)
+        req_out = []
+        for h in range(t.n_ranks):
+            req_out.append(referenced[owners == h].tobytes())
+        req_in = t.alltoall(req_out, f"ws-req:{self.pass_id}")
+        req_keys = [np.frombuffer(b, dtype=np.uint64) for b in req_in]
+
+        # owner side: union, per-shard rank assignment (ascending key order)
+        owned = (
+            np.unique(np.concatenate([k for k in req_keys]))
+            if any(len(k) for k in req_keys)
+            else np.zeros(0, np.uint64)
+        )
+        shard_of = key_to_shard(owned, self.n_mesh_shards) - self.shard_lo
+        counts = np.bincount(shard_of, minlength=self.shards_per_host)
+        local_max = int(counts.max()) + 1 if len(owned) else 1
+        cap = t.allreduce_max(local_max, f"ws-cap:{self.pass_id}")
+        cap = -(-cap // round_to) * round_to
+        self.capacity = cap
+
+        order = np.argsort(shard_of, kind="stable")  # keys sorted => rank order
+        rank_in_shard = np.empty(len(owned), dtype=np.int64)
+        start = 0
+        self.owned_shard_keys = []
+        for s in range(self.shards_per_host):
+            c = int(counts[s])
+            rank_in_shard[order[start : start + c]] = np.arange(c)
+            self.owned_shard_keys.append(owned[order[start : start + c]])
+            start += c
+        owned_rows = (
+            (key_to_shard(owned, self.n_mesh_shards)) * cap + rank_in_shard
+        )
+
+        # build the local device slice from the local host table
+        vals = (
+            table.pull_or_create(owned)
+            if len(owned)
+            else np.zeros((0, table.layout.width), np.float32)
+        )
+        dev = np.zeros((self.shards_per_host, cap, table.layout.width), np.float32)
+        local_rows = shard_of * cap + rank_in_shard
+        dev.reshape(self.shards_per_host * cap, -1)[local_rows] = vals
+
+        # round 2: reply global rows for each requester's keys (their order)
+        rep_out = []
+        pos_all = np.searchsorted(owned, np.concatenate(req_keys)) if len(owned) else None
+        off = 0
+        for h in range(t.n_ranks):
+            k = req_keys[h]
+            if len(k):
+                rep_out.append(owned_rows[pos_all[off : off + len(k)]].astype(np.int64).tobytes())
+            else:
+                rep_out.append(b"")
+            off += len(k)
+        rep_in = t.alltoall(rep_out, f"ws-rep:{self.pass_id}")
+
+        # assemble local lookup over referenced keys
+        rows = np.empty(len(referenced), dtype=np.int64)
+        for h in range(t.n_ranks):
+            sel = owners == h
+            got = np.frombuffer(rep_in[h], dtype=np.int64)
+            rows[sel] = got
+        self.sorted_keys = referenced  # np.unique output: sorted
+        self.row_of_sorted = rows
+        self._finalized = True
+        self._table = table
+        return dev
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Batch keys -> GLOBAL row ids (int32); keys must be in the pass."""
+        pos = np.searchsorted(self.sorted_keys, keys.astype(np.uint64))
+        pos = np.minimum(pos, len(self.sorted_keys) - 1)
+        if not np.all(self.sorted_keys[pos] == keys):
+            missing = keys[self.sorted_keys[pos] != keys]
+            raise KeyError(
+                f"{len(missing)} batch keys not in pass working set (e.g. {missing[:5]})"
+            )
+        return self.row_of_sorted[pos].astype(np.int32)
+
+    @property
+    def padding_row(self) -> int:
+        return self.capacity - 1
+
+    @property
+    def _finalized_ok(self) -> bool:
+        return self._finalized
+
+    def writeback(self, local_slice: np.ndarray) -> None:
+        """Flush THIS host's trained shard slice into its own host table —
+        ownership == device placement, so nothing crosses hosts (EndPass
+        parity, box_wrapper.cc:627)."""
+        if self.owned_shard_keys is None:
+            return
+        flat = np.asarray(local_slice).reshape(self.shards_per_host, self.capacity, -1)
+        for s, keys in enumerate(self.owned_shard_keys):
+            if len(keys):
+                self._table.push(keys, flat[s, : len(keys)])
