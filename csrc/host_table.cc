@@ -1,0 +1,671 @@
+// Native host sparse-table store: the mem + SSD tiers of BoxPS in C++.
+//
+// The reference keeps its 1e10..1e11-key feature table inside the closed
+// libbox_ps.so, tiered across SSD and host RAM and promoted to HBM per pass
+// (box_wrapper.cc:1325 LoadSSD2Mem; cmake/external/box_ps.cmake). This file
+// is the open TPU-side equivalent of that host tier: a sharded open-
+// addressing uint64 -> fp32-row store with
+//
+//   - batch pull_or_create / push (the pass finalize + writeback hot path;
+//     the Python-dict fallback measured ~160k keys/s, this runs tens of
+//     millions/s and threads across shards with the GIL released),
+//   - deterministic per-key initialization (splitmix64 counter RNG, so
+//     init is order- and shard-independent — stronger than the reference's
+//     sequential RNG, and required for multi-host reproducibility),
+//   - touched-row tracking for delta saves (SaveDelta parity,
+//     box_wrapper.cc:1288-1331),
+//   - pass-boundary decay+shrink (pslib show_click_decay_rate + shrink),
+//   - a per-shard disk spill tier: cold rows are evicted to append-only
+//     shard files and lazily promoted (with catch-up decay) when a later
+//     pass touches them — LoadSSD2Mem semantics inverted for the host side.
+//
+// ABI: plain C, handle-based, ctypes-bound (utils/native.py); all calls are
+// thread-safe via per-shard mutexes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kHashMult = 0x9E3779B97F4A7C15ull;
+
+inline uint64_t mix_shard(uint64_t key) { return (key * kHashMult) >> 33; }
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+// uniform in [-range, range), deterministic in (seed, key, col)
+inline float init_uniform(uint64_t seed, uint64_t key, uint32_t col,
+                          float range) {
+  uint64_t r = splitmix64(seed ^ splitmix64(key ^ ((uint64_t)col << 32)));
+  // top 24 bits -> [0, 1)
+  float u = (float)(r >> 40) * (1.0f / 16777216.0f);
+  return (2.0f * u - 1.0f) * range;
+}
+
+// Hash-slot states. kDisk entries hold a byte offset into the shard's
+// spill file instead of a mem row id.
+enum : uint8_t { kEmpty = 0, kMem = 1, kDisk = 2 };
+
+struct SpillRec {  // on-disk record header, followed by width floats
+  uint64_t key;
+  int64_t epoch;    // table pass-epoch at spill time (for catch-up decay)
+  uint64_t touched; // delta-save flag survives the disk tier
+};
+
+struct Shard {
+  // open-addressing hash: slot -> (key, where)
+  std::vector<uint64_t> hkeys;
+  std::vector<int64_t> hval;  // mem row id (kMem) or file offset (kDisk)
+  std::vector<uint8_t> hstate;
+  uint64_t mask = 0;  // capacity - 1 (power of two)
+  int64_t n_used = 0;  // mem + disk entries in the hash
+
+  // mem tier rows
+  std::vector<float> values;        // [n_rows * width]
+  std::vector<uint64_t> row_key;    // [n_rows]
+  std::vector<uint8_t> row_touched; // [n_rows]
+  int64_t n_rows = 0;
+
+  // disk tier
+  FILE* spill = nullptr;
+  std::string spill_path;
+  int64_t n_disk = 0;
+  int64_t n_disk_touched = 0;
+
+  std::mutex mtx;
+
+  ~Shard() {
+    if (spill) fclose(spill);
+  }
+};
+
+struct Table {
+  int n_shards;
+  int width;
+  int show_col;
+  int clk_col;
+  uint64_t seed;
+  std::vector<int32_t> init_cols;  // columns getting uniform(-r, r) init
+  float init_range;
+  std::string spill_dir;  // empty => spill disabled
+  int64_t epoch = 0;      // incremented by decay_shrink (pass boundary)
+  float last_decay = 1.0f;
+  float last_threshold = 0.0f;
+  std::vector<Shard> shards;
+
+  Table(int ns) : shards(ns) {}
+};
+
+inline int shard_of(const Table* t, uint64_t key) {
+  return (int)(mix_shard(key) % (uint64_t)t->n_shards);
+}
+
+void shard_grow_hash(Shard* s) {
+  uint64_t new_cap = s->mask ? (s->mask + 1) * 2 : 1024;
+  std::vector<uint64_t> nk(new_cap);
+  std::vector<int64_t> nv(new_cap);
+  std::vector<uint8_t> ns(new_cap, kEmpty);
+  uint64_t nmask = new_cap - 1;
+  if (s->mask) {
+    for (uint64_t i = 0; i <= s->mask; ++i) {
+      if (s->hstate[i] == kEmpty) continue;
+      uint64_t j = splitmix64(s->hkeys[i]) & nmask;
+      while (ns[j] != kEmpty) j = (j + 1) & nmask;
+      nk[j] = s->hkeys[i];
+      nv[j] = s->hval[i];
+      ns[j] = s->hstate[i];
+    }
+  }
+  s->hkeys.swap(nk);
+  s->hval.swap(nv);
+  s->hstate.swap(ns);
+  s->mask = nmask;
+}
+
+// find slot of key; returns slot index, or the empty slot to insert into.
+// *found says whether the key is present.
+inline uint64_t shard_find(Shard* s, uint64_t key, bool* found) {
+  uint64_t j = splitmix64(key) & s->mask;
+  while (true) {
+    if (s->hstate[j] == kEmpty) {
+      *found = false;
+      return j;
+    }
+    if (s->hkeys[j] == key) {
+      *found = true;
+      return j;
+    }
+    j = (j + 1) & s->mask;
+  }
+}
+
+inline void shard_maybe_grow(Shard* s) {
+  if (s->mask == 0 || (uint64_t)s->n_used * 10 >= (s->mask + 1) * 7)
+    shard_grow_hash(s);
+}
+
+int64_t shard_new_row(const Table* t, Shard* s, uint64_t key) {
+  int64_t row = s->n_rows++;
+  if ((int64_t)s->row_key.size() < s->n_rows) {
+    int64_t cap = s->row_key.size() ? (int64_t)s->row_key.size() * 2 : 1024;
+    if (cap < s->n_rows) cap = s->n_rows;
+    s->row_key.resize(cap);
+    s->row_touched.resize(cap, 0);
+    s->values.resize(cap * (int64_t)t->width);
+  }
+  s->row_key[row] = key;
+  s->row_touched[row] = 0;
+  return row;
+}
+
+void init_row(const Table* t, uint64_t key, float* dst) {
+  std::memset(dst, 0, sizeof(float) * t->width);
+  for (int32_t c : t->init_cols)
+    dst[c] = init_uniform(t->seed, key, (uint32_t)c, t->init_range);
+}
+
+bool shard_open_spill(Table* t, int si) {
+  Shard* s = &t->shards[si];
+  if (s->spill) return true;
+  if (t->spill_dir.empty()) return false;
+  char buf[64];
+  snprintf(buf, sizeof(buf), "/spill-%05d.bin", si);
+  s->spill_path = t->spill_dir + buf;
+  s->spill = fopen(s->spill_path.c_str(), "w+b");
+  return s->spill != nullptr;
+}
+
+// Promote a disk entry at hash slot j to a mem row, applying catch-up
+// decay for the passes it slept through. Returns the new row id, or -1 if
+// the decayed row falls below the shrink threshold (entry is dropped).
+int64_t promote(Table* t, Shard* s, uint64_t j) {
+  int64_t off = s->hval[j];
+  SpillRec rec;
+  std::vector<float> buf(t->width);
+  fseeko(s->spill, off, SEEK_SET);
+  if (fread(&rec, sizeof(rec), 1, s->spill) != 1 ||
+      fread(buf.data(), sizeof(float), t->width, s->spill) != (size_t)t->width)
+    return -2;  // IO error
+  fseeko(s->spill, 0, SEEK_END);
+  int64_t missed = t->epoch - rec.epoch;
+  if (missed > 0 && t->last_decay < 1.0f) {
+    float d = 1.0f;
+    for (int64_t i = 0; i < missed; ++i) d *= t->last_decay;
+    buf[t->show_col] *= d;
+    buf[t->clk_col] *= d;
+  }
+  s->n_disk--;
+  if (rec.touched) s->n_disk_touched--;
+  if (missed > 0 && buf[t->show_col] < t->last_threshold) {
+    // lazily shrunk: delete the entry entirely
+    s->hstate[j] = kEmpty;
+    s->n_used--;
+    // re-insert any displaced linear-probe followers
+    uint64_t k = (j + 1) & s->mask;
+    while (s->hstate[k] != kEmpty) {
+      uint64_t kk = s->hkeys[k];
+      int64_t vv = s->hval[k];
+      uint8_t st = s->hstate[k];
+      s->hstate[k] = kEmpty;
+      s->n_used--;
+      bool f;
+      uint64_t slot = shard_find(s, kk, &f);
+      s->hkeys[slot] = kk;
+      s->hval[slot] = vv;
+      s->hstate[slot] = st;
+      s->n_used++;
+      k = (k + 1) & s->mask;
+    }
+    return -1;
+  }
+  int64_t row = shard_new_row(t, s, s->hkeys[j]);
+  std::memcpy(&s->values[row * t->width], buf.data(),
+              sizeof(float) * t->width);
+  s->row_touched[row] = rec.touched ? 1 : 0;
+  s->hval[j] = row;
+  s->hstate[j] = kMem;
+  return row;
+}
+
+// Partition keys by shard once, then run fn(shard_id, key_positions) over
+// shards on a small thread pool (ctypes released the GIL for us).
+template <typename Fn>
+int for_shards(const Table* t, const uint64_t* keys, int64_t n, Fn fn) {
+  int ns = t->n_shards;
+  std::vector<int64_t> count(ns, 0);
+  std::vector<int> sh((size_t)n);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = shard_of(t, keys[i]);
+    sh[i] = s;
+    count[s]++;
+  }
+  std::vector<int64_t> start(ns + 1, 0);
+  for (int s = 0; s < ns; ++s) start[s + 1] = start[s] + count[s];
+  std::vector<int64_t> pos(start.begin(), start.end() - 1);
+  std::vector<int64_t> order((size_t)n);
+  for (int64_t i = 0; i < n; ++i) order[pos[sh[i]]++] = i;
+
+  int nt = (int)std::thread::hardware_concurrency();
+  if (nt > ns) nt = ns;
+  if (nt > 16) nt = 16;
+  if (n < 65536 || nt <= 1) nt = 1;
+  std::vector<int> rc(nt > 0 ? nt : 1, 0);
+  auto work = [&](int w) {
+    for (int s = w; s < ns; s += nt) {
+      int r = fn(s, order.data() + start[s], count[s]);
+      if (r != 0) rc[w] = r;
+    }
+  };
+  if (nt == 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> th;
+    for (int w = 0; w < nt; ++w) th.emplace_back(work, w);
+    for (auto& x : th) x.join();
+  }
+  for (int w = 0; w < (int)rc.size(); ++w)
+    if (rc[w] != 0) return rc[w];
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pbx_table_create(int n_shards, int width, int show_col, int clk_col,
+                       uint64_t seed, const int32_t* init_cols,
+                       int n_init_cols, float init_range,
+                       const char* spill_dir) {
+  Table* t = new Table(n_shards);
+  t->n_shards = n_shards;
+  t->width = width;
+  t->show_col = show_col;
+  t->clk_col = clk_col;
+  t->seed = seed;
+  t->init_cols.assign(init_cols, init_cols + n_init_cols);
+  t->init_range = init_range;
+  if (spill_dir && spill_dir[0]) t->spill_dir = spill_dir;
+  return (void*)t;
+}
+
+void pbx_table_free(void* h) { delete (Table*)h; }
+
+int64_t pbx_table_size(void* h) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mtx);
+    n += s.n_used;
+  }
+  return n;
+}
+
+int64_t pbx_table_mem_rows(void* h) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mtx);
+    n += s.n_used - s.n_disk;
+  }
+  return n;
+}
+
+int64_t pbx_table_disk_rows(void* h) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mtx);
+    n += s.n_disk;
+  }
+  return n;
+}
+
+// Batch pull: rows for keys[i] -> out[i*width .. ], creating (with
+// deterministic init) or promoting from disk as needed. Returns 0, or
+// negative on IO error.
+int pbx_table_pull_or_create(void* h, const uint64_t* keys, int64_t n,
+                             float* out) {
+  Table* t = (Table*)h;
+  return for_shards(t, keys, n, [&](int si, const int64_t* idx, int64_t m) {
+    Shard* s = &t->shards[si];
+    std::lock_guard<std::mutex> g(s->mtx);
+    for (int64_t q = 0; q < m; ++q) {
+      int64_t i = idx[q];
+      uint64_t key = keys[i];
+      shard_maybe_grow(s);
+      bool found;
+      uint64_t j = shard_find(s, key, &found);
+      int64_t row;
+      if (!found) {
+        row = shard_new_row(t, s, key);
+        init_row(t, key, &s->values[row * t->width]);
+        s->hkeys[j] = key;
+        s->hval[j] = row;
+        s->hstate[j] = kMem;
+        s->n_used++;
+      } else if (s->hstate[j] == kDisk) {
+        row = promote(t, s, j);
+        if (row == -2) return -2;
+        if (row == -1) {  // lazily shrunk: recreate fresh
+          shard_maybe_grow(s);
+          bool f2;
+          j = shard_find(s, key, &f2);
+          row = shard_new_row(t, s, key);
+          init_row(t, key, &s->values[row * t->width]);
+          s->hkeys[j] = key;
+          s->hval[j] = row;
+          s->hstate[j] = kMem;
+          s->n_used++;
+        }
+      } else {
+        row = s->hval[j];
+      }
+      std::memcpy(out + i * t->width, &s->values[row * t->width],
+                  sizeof(float) * t->width);
+    }
+    return 0;
+  });
+}
+
+// Batch push (upsert full rows) + mark touched. Returns 0 or negative.
+int pbx_table_push(void* h, const uint64_t* keys, const float* rows,
+                   int64_t n) {
+  Table* t = (Table*)h;
+  return for_shards(t, keys, n, [&](int si, const int64_t* idx, int64_t m) {
+    Shard* s = &t->shards[si];
+    std::lock_guard<std::mutex> g(s->mtx);
+    for (int64_t q = 0; q < m; ++q) {
+      int64_t i = idx[q];
+      uint64_t key = keys[i];
+      shard_maybe_grow(s);
+      bool found;
+      uint64_t j = shard_find(s, key, &found);
+      int64_t row;
+      if (!found) {
+        row = shard_new_row(t, s, key);
+        s->hkeys[j] = key;
+        s->hval[j] = row;
+        s->hstate[j] = kMem;
+        s->n_used++;
+      } else if (s->hstate[j] == kDisk) {
+        // full-row overwrite: only the header's touched bit matters
+        SpillRec rec;
+        fseeko(s->spill, s->hval[j], SEEK_SET);
+        if (fread(&rec, sizeof(rec), 1, s->spill) != 1) return -2;
+        fseeko(s->spill, 0, SEEK_END);
+        if (rec.touched) s->n_disk_touched--;
+        s->n_disk--;
+        row = shard_new_row(t, s, key);
+        s->hval[j] = row;
+        s->hstate[j] = kMem;
+      } else {
+        row = s->hval[j];
+      }
+      std::memcpy(&s->values[row * t->width], rows + i * t->width,
+                  sizeof(float) * t->width);
+      s->row_touched[row] = 1;
+    }
+    return 0;
+  });
+}
+
+// Pass-boundary decay + shrink over the MEM tier (disk rows catch up
+// lazily at promotion). Returns number of mem rows dropped.
+int64_t pbx_table_decay_shrink(void* h, float decay, float threshold) {
+  Table* t = (Table*)h;
+  t->epoch++;
+  t->last_decay = decay;
+  t->last_threshold = threshold;
+  int64_t dropped = 0;
+  std::mutex dm;
+  int nt = (int)std::thread::hardware_concurrency();
+  if (nt > t->n_shards) nt = t->n_shards;
+  if (nt > 16) nt = 16;
+  if (nt < 1) nt = 1;
+  auto work = [&](int w) {
+    int64_t local = 0;
+    for (int si = w; si < t->n_shards; si += nt) {
+      Shard* s = &t->shards[si];
+      std::lock_guard<std::mutex> g(s->mtx);
+      // decay all rows; collect keep mask
+      int64_t keep = 0;
+      std::vector<int64_t> remap(s->n_rows, -1);
+      for (int64_t r = 0; r < s->n_rows; ++r) {
+        float* v = &s->values[r * t->width];
+        v[t->show_col] *= decay;
+        v[t->clk_col] *= decay;
+        if (v[t->show_col] >= threshold) remap[r] = keep++;
+      }
+      if (keep == s->n_rows) continue;
+      local += s->n_rows - keep;
+      // compact rows in place (remap is monotone)
+      for (int64_t r = 0; r < s->n_rows; ++r) {
+        int64_t nr = remap[r];
+        if (nr < 0 || nr == r) continue;
+        std::memcpy(&s->values[nr * t->width], &s->values[r * t->width],
+                    sizeof(float) * t->width);
+        s->row_key[nr] = s->row_key[r];
+        s->row_touched[nr] = s->row_touched[r];
+      }
+      s->n_rows = keep;
+      // rebuild the hash from scratch: survivors remapped, disk entries
+      // carried over, dropped rows simply not reinserted (O(cap), no
+      // probe-chain deletion subtleties)
+      std::vector<uint64_t> ok;
+      std::vector<int64_t> ov;
+      std::vector<uint8_t> os;
+      ok.swap(s->hkeys);
+      ov.swap(s->hval);
+      os.swap(s->hstate);
+      uint64_t omask = s->mask;
+      s->mask = 0;
+      s->n_used = 0;
+      shard_grow_hash(s);
+      while ((s->mask + 1) * 7 < (uint64_t)(keep + s->n_disk) * 10)
+        shard_grow_hash(s);
+      for (uint64_t j = 0; j <= omask && omask; ++j) {
+        if (os[j] == kEmpty) continue;
+        int64_t v = os[j] == kMem ? remap[ov[j]] : ov[j];
+        if (os[j] == kMem && v < 0) continue;  // dropped
+        bool f;
+        uint64_t slot = shard_find(s, ok[j], &f);
+        s->hkeys[slot] = ok[j];
+        s->hval[slot] = v;
+        s->hstate[slot] = os[j];
+        s->n_used++;
+      }
+    }
+    std::lock_guard<std::mutex> g(dm);
+    dropped += local;
+  };
+  if (nt == 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> th;
+    for (int w = 0; w < nt; ++w) th.emplace_back(work, w);
+    for (auto& x : th) x.join();
+  }
+  return dropped;
+}
+
+// Spill cold mem rows to the shard's disk file until total mem rows <=
+// max_mem_rows. Untouched (not pushed since last delta save) rows go
+// first; touched rows are spilled only if still over cap, with the touched
+// bit preserved in the on-disk record so delta saves stay exact. Returns
+// rows spilled, or negative if spill is disabled / IO fails.
+int64_t pbx_table_spill_cold(void* h, int64_t max_mem_rows) {
+  Table* t = (Table*)h;
+  if (t->spill_dir.empty()) return -1;
+  int64_t mem = pbx_table_mem_rows(h);
+  if (mem <= max_mem_rows) return 0;
+  int64_t need = mem - max_mem_rows;
+  int64_t spilled_total = 0;
+  for (int si = 0; si < t->n_shards && need > 0; ++si) {
+    Shard* s = &t->shards[si];
+    std::lock_guard<std::mutex> g(s->mtx);
+    if (s->n_rows == 0) continue;
+    if (!shard_open_spill(t, si)) return -2;
+    fseeko(s->spill, 0, SEEK_END);
+    // cold-first: untouched rows in creation order, then touched rows
+    std::vector<int64_t> victims;
+    for (int64_t r = 0; r < s->n_rows && (int64_t)victims.size() < need; ++r)
+      if (!s->row_touched[r]) victims.push_back(r);
+    for (int64_t r = 0; r < s->n_rows && (int64_t)victims.size() < need; ++r)
+      if (s->row_touched[r]) victims.push_back(r);
+    if (victims.empty()) continue;
+    // write victims to disk, update hash entries
+    std::vector<uint8_t> is_victim(s->n_rows, 0);
+    std::vector<int64_t> disk_off(s->n_rows, 0);
+    for (int64_t r : victims) {
+      int64_t off = ftello(s->spill);
+      SpillRec rec{s->row_key[r], t->epoch, s->row_touched[r] ? 1ull : 0ull};
+      if (fwrite(&rec, sizeof(rec), 1, s->spill) != 1 ||
+          fwrite(&s->values[r * t->width], sizeof(float), t->width,
+                 s->spill) != (size_t)t->width)
+        return -2;
+      is_victim[r] = 1;
+      disk_off[r] = off;
+      if (s->row_touched[r]) s->n_disk_touched++;
+    }
+    fflush(s->spill);
+    // compact survivors
+    std::vector<int64_t> remap(s->n_rows, -1);
+    int64_t keep = 0;
+    for (int64_t r = 0; r < s->n_rows; ++r)
+      if (!is_victim[r]) remap[r] = keep++;
+    for (int64_t r = 0; r < s->n_rows; ++r) {
+      int64_t nr = remap[r];
+      if (nr < 0 || nr == r) continue;
+      std::memcpy(&s->values[nr * t->width], &s->values[r * t->width],
+                  sizeof(float) * t->width);
+      s->row_key[nr] = s->row_key[r];
+      s->row_touched[nr] = s->row_touched[r];
+    }
+    for (uint64_t j = 0; j <= s->mask && s->mask; ++j) {
+      if (s->hstate[j] != kMem) continue;
+      int64_t r = s->hval[j];
+      if (is_victim[r]) {
+        s->hstate[j] = kDisk;
+        s->hval[j] = disk_off[r];
+        s->n_disk++;
+      } else {
+        s->hval[j] = remap[r];
+      }
+    }
+    s->n_rows = keep;
+    need -= victims.size();
+    spilled_total += victims.size();
+  }
+  return spilled_total;
+}
+
+// Drop all touched flags (after a load, which arrives via push).
+void pbx_table_clear_touched(void* h) {
+  Table* t = (Table*)h;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mtx);
+    for (int64_t r = 0; r < s.n_rows; ++r) s.row_touched[r] = 0;
+    // disk rows: touched bits live in the file; a load never spills, so
+    // n_disk_touched entries (if any) are rewritten lazily at next
+    // snapshot — clear the counter's view by scanning only if needed
+    if (s.n_disk_touched > 0 && s.spill) {
+      for (uint64_t j = 0; j <= s.mask && s.mask; ++j) {
+        if (s.hstate[j] != kDisk) continue;
+        SpillRec rec;
+        fseeko(s.spill, s.hval[j], SEEK_SET);
+        if (fread(&rec, sizeof(rec), 1, s.spill) != 1) break;
+        if (rec.touched) {
+          rec.touched = 0;
+          fseeko(s.spill, s.hval[j], SEEK_SET);
+          fwrite(&rec, sizeof(rec), 1, s.spill);
+          if (--s.n_disk_touched == 0) break;
+        }
+      }
+      fflush(s.spill);
+      fseeko(s.spill, 0, SEEK_END);
+    }
+  }
+}
+
+// Snapshot item count for one shard: touched rows (mem + disk) when
+// only_touched, everything otherwise.
+int64_t pbx_table_snapshot_count(void* h, int shard, int only_touched) {
+  Table* t = (Table*)h;
+  Shard* s = &t->shards[shard];
+  std::lock_guard<std::mutex> g(s->mtx);
+  if (only_touched) {
+    int64_t n = s->n_disk_touched;
+    for (int64_t r = 0; r < s->n_rows; ++r) n += s->row_touched[r] ? 1 : 0;
+    return n;
+  }
+  return s->n_used;
+}
+
+// Fill keys_out / vals_out (caller-sized via snapshot_count with the same
+// only_touched under no concurrent mutation). Disk rows are read back with
+// catch-up decay applied so a base save reflects current semantics; with
+// clear_touched the on-disk header's touched bit is rewritten in place.
+// Returns count written, or negative on IO error.
+int64_t pbx_table_snapshot(void* h, int shard, int only_touched,
+                           int clear_touched, uint64_t* keys_out,
+                           float* vals_out) {
+  Table* t = (Table*)h;
+  Shard* s = &t->shards[shard];
+  std::lock_guard<std::mutex> g(s->mtx);
+  int64_t n = 0;
+  for (int64_t r = 0; r < s->n_rows; ++r) {
+    if (only_touched && !s->row_touched[r]) continue;
+    keys_out[n] = s->row_key[r];
+    std::memcpy(vals_out + n * t->width, &s->values[r * t->width],
+                sizeof(float) * t->width);
+    n++;
+    if (clear_touched) s->row_touched[r] = 0;
+  }
+  bool scan_disk =
+      s->spill && (only_touched ? s->n_disk_touched > 0 : s->n_disk > 0);
+  if (scan_disk) {
+    std::vector<float> buf(t->width);
+    for (uint64_t j = 0; j <= s->mask && s->mask; ++j) {
+      if (s->hstate[j] != kDisk) continue;
+      SpillRec rec;
+      fseeko(s->spill, s->hval[j], SEEK_SET);
+      if (fread(&rec, sizeof(rec), 1, s->spill) != 1 ||
+          fread(buf.data(), sizeof(float), t->width, s->spill) !=
+              (size_t)t->width)
+        return -2;
+      if (only_touched && !rec.touched) continue;
+      int64_t missed = t->epoch - rec.epoch;
+      if (missed > 0 && t->last_decay < 1.0f) {
+        float d = 1.0f;
+        for (int64_t i = 0; i < missed; ++i) d *= t->last_decay;
+        buf[t->show_col] *= d;
+        buf[t->clk_col] *= d;
+      }
+      keys_out[n] = s->hkeys[j];
+      std::memcpy(vals_out + n * t->width, buf.data(),
+                  sizeof(float) * t->width);
+      n++;
+      if (clear_touched && rec.touched) {
+        rec.touched = 0;
+        fseeko(s->spill, s->hval[j], SEEK_SET);
+        if (fwrite(&rec, sizeof(rec), 1, s->spill) != 1) return -2;
+        s->n_disk_touched--;
+      }
+    }
+    fflush(s->spill);
+    fseeko(s->spill, 0, SEEK_END);
+  }
+  return n;
+}
+
+}  // extern "C"

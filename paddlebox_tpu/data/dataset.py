@@ -594,6 +594,10 @@ class BoxPSDataset:
             if delta_dir is None:
                 raise ValueError("need_save_delta requires delta_dir")
             saved = self.table.save_delta(delta_dir)
+        # enforce the host-RAM cap: evict cold rows to the disk tier
+        # (LoadSSD2Mem inverse; next pass's finalize promotes what it needs)
+        if getattr(self.table, "mem_cap_rows", None) is not None:
+            self.table.maybe_spill()
         self.records = []
         self.ws = None
         self.device_table = None

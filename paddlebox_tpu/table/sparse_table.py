@@ -5,9 +5,12 @@ BeginFeedPass/EndFeedPass/BeginPass/EndPass/PullSparseGPU/PushSparseGPU/
 SaveBase/SaveDelta (box_wrapper.cc:580-1331). This module implements that
 surface openly, re-shaped for TPU:
 
-- ``HostSparseTable``: the full 1e9..1e11-key store living in host RAM
-  (optionally spilled to disk per shard — the mem/SSD tiers), sharded by key
-  hash across ``n_shards`` locks for concurrent working-set builds.
+- ``HostSparseTable``: the full 1e9..1e11-key store, sharded by key hash
+  across ``n_shards``. Native-backed (csrc/host_table.cc): the RAM tier is
+  a C++ open-addressing store, and when constructed with ``spill_dir`` /
+  ``mem_cap_rows`` cold rows are evicted to per-shard disk files and
+  promoted lazily with catch-up decay — the mem/SSD tiers of BoxPS
+  (LoadSSD2Mem, box_wrapper.cc:1325).
 
 - ``PassWorkingSet``: the HBM tier. During load, every feasign of the pass is
   fed in (PSAgent::AddKeys parity, data_set.cc:1647); ``finalize`` dedups,
@@ -76,7 +79,18 @@ class _Shard:
 
 
 class HostSparseTable:
-    """Host-RAM sharded key -> fp32 row store (the mem tier of BoxPS)."""
+    """Host sharded key -> fp32 row store: the mem + disk tiers of BoxPS.
+
+    Backed by the native C++ store (csrc/host_table.cc) when the toolchain
+    is available: batch pull/push run with the GIL released and thread
+    across shards, and cold rows spill to per-shard disk files under
+    ``spill_dir`` with lazy promotion + catch-up decay (``LoadSSD2Mem``
+    parity, box_wrapper.cc:1325). Falls back to a pure-Python dict store
+    (no spill) when g++ is unavailable or ``PBOX_NATIVE_TABLE=0``.
+
+    ``mem_cap_rows`` bounds the RAM tier: ``maybe_spill()`` (called by the
+    dataset at pass end) evicts cold rows to disk until under the cap.
+    """
 
     def __init__(
         self,
@@ -84,17 +98,89 @@ class HostSparseTable:
         opt: SparseOptimizerConfig = SparseOptimizerConfig(),
         n_shards: int = 64,
         seed: int = 0,
+        spill_dir: Optional[str] = None,
+        mem_cap_rows: Optional[int] = None,
     ):
         self.layout = layout
         self.opt = opt
         self.n_shards = n_shards
-        self._shards = [_Shard(layout.width) for _ in range(n_shards)]
+        self.mem_cap_rows = mem_cap_rows
+        self._native = None
+        if os.environ.get("PBOX_NATIVE_TABLE", "1") != "0":
+            try:
+                from paddlebox_tpu.utils import native as _native_mod
+
+                if _native_mod.available():
+                    lay = layout
+                    n_emb = lay.embedx_dim + lay.expand_dim
+                    init_cols = np.concatenate(
+                        [
+                            [lay.embed_w_col],
+                            np.arange(lay.embedx_col, lay.embedx_col + n_emb),
+                        ]
+                    ).astype(np.int32)
+                    if spill_dir:
+                        os.makedirs(spill_dir, exist_ok=True)
+                    self._native = _native_mod.NativeHostStore(
+                        n_shards, lay.width, lay.SHOW, lay.CLK, seed,
+                        init_cols, opt.initial_range, spill_dir,
+                    )
+            except Exception:
+                self._native = None
+        if self._native is None and spill_dir is not None:
+            raise RuntimeError(
+                "disk spill requires the native table store "
+                "(g++ build failed or PBOX_NATIVE_TABLE=0)"
+            )
+        self._shards = (
+            [] if self._native else [_Shard(layout.width) for _ in range(n_shards)]
+        )
         self._rng = np.random.default_rng(seed)
         self._size = 0
         self._size_lock = threading.Lock()
 
+    @property
+    def native(self) -> bool:
+        return self._native is not None
+
+    @property
+    def mem_rows(self) -> int:
+        return self._native.mem_rows if self._native else self._size
+
+    @property
+    def disk_rows(self) -> int:
+        return self._native.disk_rows if self._native else 0
+
+    def spill_cold(self, max_mem_rows: int) -> int:
+        """Evict cold rows to disk until RAM tier <= max_mem_rows."""
+        if self._native is None:
+            raise RuntimeError("spill requires the native table store")
+        return self._native.spill_cold(max_mem_rows)
+
+    def maybe_spill(self) -> int:
+        """Enforce ``mem_cap_rows`` if configured (pass-end hook)."""
+        if self.mem_cap_rows is None or self._native is None:
+            return 0
+        return self._native.spill_cold(self.mem_cap_rows)
+
     def __len__(self) -> int:
+        if self._native is not None:
+            return len(self._native)
         return self._size
+
+    def keys(self) -> np.ndarray:
+        """All keys currently stored (mem + disk tiers), unsorted."""
+        if self._native is not None:
+            parts = [
+                self._native.snapshot_shard(s, only_touched=False, clear_touched=False)[0]
+                for s in range(self.n_shards)
+            ]
+        else:
+            parts = [
+                np.fromiter(sh.index.keys(), dtype=np.uint64, count=len(sh.index))
+                for sh in self._shards
+            ]
+        return np.concatenate(parts) if parts else np.zeros(0, np.uint64)
 
     def _init_rows(self, n: int) -> np.ndarray:
         lay = self.layout
@@ -109,6 +195,8 @@ class HostSparseTable:
 
     def pull_or_create(self, keys: np.ndarray) -> np.ndarray:
         """Rows for unique ``keys`` (creating missing ones). [n, width]."""
+        if self._native is not None:
+            return self._native.pull_or_create(keys)
         out = np.empty((len(keys), self.layout.width), dtype=np.float32)
         shard_ids = key_to_shard(keys, self.n_shards)
         created = 0
@@ -119,8 +207,9 @@ class HostSparseTable:
             shard = self._shards[s]
             with shard.lock:
                 idx = shard.index
-                # .tolist() converts uint64->int in C; dict lookups via map
-                # keep the per-key cost minimal until the C++ store lands
+                # pure-Python fallback path (native store unavailable):
+                # .tolist() converts uint64->int in C so dict lookups stay
+                # as cheap as the interpreter allows
                 klist = keys[sel].tolist()
                 get = idx.get
                 rows = np.fromiter(
@@ -145,6 +234,9 @@ class HostSparseTable:
 
     def push(self, keys: np.ndarray, rows: np.ndarray) -> None:
         """Write back full rows for existing keys (end-of-pass flush)."""
+        if self._native is not None:
+            self._native.push(keys, rows)
+            return
         shard_ids = key_to_shard(keys, self.n_shards)
         created = 0
         for s in range(self.n_shards):
@@ -182,6 +274,10 @@ class HostSparseTable:
         fleet_wrapper.h:258-310.)
         """
         lay, opt = self.layout, self.opt
+        if self._native is not None:
+            return self._native.decay_and_shrink(
+                opt.show_clk_decay, opt.shrink_threshold
+            )
         dropped = 0
         for shard in self._shards:
             with shard.lock:
@@ -223,6 +319,8 @@ class HostSparseTable:
         push() either lands in this snapshot or stays marked touched for the
         next delta — no update can fall between and be lost.
         """
+        if self._native is not None:
+            return self._native.snapshot_shard(s, only_touched, clear_touched=True)
         shard = self._shards[s]
         with shard.lock:
             if only_touched:
@@ -275,7 +373,10 @@ class HostSparseTable:
             keys, vals = data["keys"], data["values"]
             if len(keys):
                 self.push(keys, vals)
-            self._shards[s].touched.clear()
+            if self._native is None:
+                self._shards[s].touched.clear()
+        if self._native is not None:
+            self._native.clear_touched()
 
     apply_delta = load  # a delta dir has the same format; push() upserts
 
@@ -354,6 +455,12 @@ class PassWorkingSet:
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Batch keys -> global row ids (int32). Keys must be in the pass."""
+        if len(self.sorted_keys) == 0:
+            if len(keys):
+                raise KeyError(
+                    f"{len(keys)} batch keys but the pass working set is empty"
+                )
+            return np.zeros(0, np.int32)
         pos = np.searchsorted(self.sorted_keys, keys.astype(np.uint64))
         pos = np.minimum(pos, len(self.sorted_keys) - 1)
         if not np.all(self.sorted_keys[pos] == keys):

@@ -29,6 +29,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 _SRCS = [
     os.path.join(_REPO, "csrc", "slot_parser.cc"),
     os.path.join(_REPO, "csrc", "batch_packer.cc"),
+    os.path.join(_REPO, "csrc", "host_table.cc"),
 ]
 _LIB = os.path.join(_REPO, "csrc", "build", "libpbx_parser.so")
 
@@ -117,6 +118,43 @@ def _load() -> Optional[ctypes.CDLL]:
             _f32p, _i64p, _u32p, ctypes.c_int, _i64p, ctypes.c_int64,
             ctypes.c_int, ctypes.c_int, _f32p,
         ]
+        # --- host table store (csrc/host_table.cc) ---
+        lib.pbx_table_create.restype = ctypes.c_void_p
+        lib.pbx_table_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, _i32p, ctypes.c_int, ctypes.c_float,
+            ctypes.c_char_p,
+        ]
+        lib.pbx_table_free.restype = None
+        lib.pbx_table_free.argtypes = [ctypes.c_void_p]
+        for name in ("pbx_table_size", "pbx_table_mem_rows", "pbx_table_disk_rows"):
+            getattr(lib, name).restype = ctypes.c_int64
+            getattr(lib, name).argtypes = [ctypes.c_void_p]
+        lib.pbx_table_pull_or_create.restype = ctypes.c_int
+        lib.pbx_table_pull_or_create.argtypes = [
+            ctypes.c_void_p, _u64p, ctypes.c_int64, _f32p,
+        ]
+        lib.pbx_table_push.restype = ctypes.c_int
+        lib.pbx_table_push.argtypes = [
+            ctypes.c_void_p, _u64p, _f32p, ctypes.c_int64,
+        ]
+        lib.pbx_table_decay_shrink.restype = ctypes.c_int64
+        lib.pbx_table_decay_shrink.argtypes = [
+            ctypes.c_void_p, ctypes.c_float, ctypes.c_float,
+        ]
+        lib.pbx_table_spill_cold.restype = ctypes.c_int64
+        lib.pbx_table_spill_cold.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pbx_table_clear_touched.restype = None
+        lib.pbx_table_clear_touched.argtypes = [ctypes.c_void_p]
+        lib.pbx_table_snapshot_count.restype = ctypes.c_int64
+        lib.pbx_table_snapshot_count.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.pbx_table_snapshot.restype = ctypes.c_int64
+        lib.pbx_table_snapshot.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            _u64p, _f32p,
+        ]
         _lib = lib
         return _lib
 
@@ -181,6 +219,8 @@ class NativePacker:
         )
 
     def pack(self, indices: np.ndarray, n_keys: int):
+        if not self._h:
+            raise RuntimeError("NativePacker used after close()")
         indices = np.ascontiguousarray(indices, dtype=np.int64)
         uniq = np.empty(n_keys, np.int32)
         inv = np.empty(n_keys, np.int32)
@@ -200,6 +240,110 @@ class NativePacker:
             self._h = None
 
     def __del__(self):  # best-effort; close() is the real contract
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeHostStore:
+    """Handle over the C++ sharded key->row store (csrc/host_table.cc).
+
+    The mem+disk host tiers of the sparse table: batch pull_or_create /
+    push run natively with the GIL released and thread across shards;
+    cold rows spill to per-shard disk files and promote lazily with
+    catch-up show/clk decay (LoadSSD2Mem parity, box_wrapper.cc:1325).
+    """
+
+    def __init__(
+        self,
+        n_shards: int,
+        width: int,
+        show_col: int,
+        clk_col: int,
+        seed: int,
+        init_cols: np.ndarray,
+        init_range: float,
+        spill_dir: Optional[str] = None,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native host table unavailable (g++ build failed?)")
+        self._lib = lib
+        self.width = width
+        ic = np.ascontiguousarray(init_cols, dtype=np.int32)
+        self._h = lib.pbx_table_create(
+            n_shards, width, show_col, clk_col,
+            ctypes.c_uint64(seed), _as_ptr(ic, ctypes.c_int32), len(ic),
+            float(init_range),
+            spill_dir.encode() if spill_dir else None,
+        )
+        self.n_shards = n_shards
+
+    def __len__(self) -> int:
+        return int(self._lib.pbx_table_size(self._h))
+
+    @property
+    def mem_rows(self) -> int:
+        return int(self._lib.pbx_table_mem_rows(self._h))
+
+    @property
+    def disk_rows(self) -> int:
+        return int(self._lib.pbx_table_disk_rows(self._h))
+
+    def pull_or_create(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.empty((len(keys), self.width), np.float32)
+        rc = self._lib.pbx_table_pull_or_create(
+            self._h, _as_ptr(keys, ctypes.c_uint64), len(keys),
+            _as_ptr(out, ctypes.c_float),
+        )
+        if rc != 0:
+            raise IOError(f"native table pull failed rc={rc} (spill IO error?)")
+        return out
+
+    def push(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        rc = self._lib.pbx_table_push(
+            self._h, _as_ptr(keys, ctypes.c_uint64),
+            _as_ptr(rows, ctypes.c_float), len(keys),
+        )
+        if rc != 0:
+            raise IOError(f"native table push failed rc={rc} (spill IO error?)")
+
+    def decay_and_shrink(self, decay: float, threshold: float) -> int:
+        return int(self._lib.pbx_table_decay_shrink(self._h, decay, threshold))
+
+    def spill_cold(self, max_mem_rows: int) -> int:
+        n = int(self._lib.pbx_table_spill_cold(self._h, max_mem_rows))
+        if n < 0:
+            raise IOError(f"native table spill failed rc={n}")
+        return n
+
+    def clear_touched(self) -> None:
+        self._lib.pbx_table_clear_touched(self._h)
+
+    def snapshot_shard(self, shard: int, only_touched: bool, clear_touched: bool):
+        n = int(self._lib.pbx_table_snapshot_count(self._h, shard, int(only_touched)))
+        keys = np.empty(n, np.uint64)
+        vals = np.empty((n, self.width), np.float32)
+        if n:
+            got = int(self._lib.pbx_table_snapshot(
+                self._h, shard, int(only_touched), int(clear_touched),
+                _as_ptr(keys, ctypes.c_uint64), _as_ptr(vals, ctypes.c_float),
+            ))
+            if got < 0:
+                raise IOError(f"native table snapshot failed rc={got}")
+            keys, vals = keys[:got], vals[:got]
+        return keys, vals
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.pbx_table_free(self._h)
+            self._h = None
+
+    def __del__(self):
         try:
             self.close()
         except Exception:

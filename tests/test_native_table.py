@@ -1,0 +1,190 @@
+"""Native host table store: RAM tier parity, disk spill tier, throughput.
+
+The reference's host table is the closed libbox_ps.so mem/SSD store
+(box_wrapper.cc:1325 LoadSSD2Mem); these tests pin the open C++ analog
+(csrc/host_table.cc): same observable behavior as the Python fallback,
+plus the disk tier the fallback doesn't have.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    PassWorkingSet,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+LAYOUT = ValueLayout(embedx_dim=4)
+OPT = SparseOptimizerConfig(
+    initial_range=0.1, show_clk_decay=0.5, shrink_threshold=1.0
+)
+
+
+def test_native_backend_selected():
+    t = HostSparseTable(LAYOUT, OPT, n_shards=4)
+    assert t.native
+
+
+def test_init_deterministic_and_in_range():
+    keys = np.array([7, 123456789, 1 << 60], dtype=np.uint64)
+    t1 = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=3)
+    t2 = HostSparseTable(LAYOUT, OPT, n_shards=8, seed=3)  # sharding-independent
+    r1, r2 = t1.pull_or_create(keys), t2.pull_or_create(keys[::-1])[::-1]
+    np.testing.assert_array_equal(r1, r2)
+    assert np.all(np.abs(r1[:, LAYOUT.embed_w_col]) <= 0.1)
+    emb = r1[:, LAYOUT.embedx_col : LAYOUT.embedx_col + LAYOUT.embedx_dim]
+    assert np.all(np.abs(emb) <= 0.1)
+    assert not np.allclose(emb, 0.0)
+    # optimizer-state columns start at zero
+    assert np.all(r1[:, LAYOUT.SHOW] == 0)
+    # different seed -> different init
+    t3 = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=4)
+    assert not np.array_equal(t3.pull_or_create(keys), r1)
+
+
+def test_spill_and_promote(tmp_path):
+    t = HostSparseTable(
+        LAYOUT, OPT, n_shards=4, seed=0, spill_dir=str(tmp_path / "spill")
+    )
+    keys = np.arange(1, 2001, dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    rows[:, LAYOUT.SHOW] = 100.0
+    t.push(keys, rows)
+    assert t.mem_rows == 2000 and t.disk_rows == 0
+
+    spilled = t.spill_cold(500)
+    assert spilled == 1500
+    assert t.mem_rows == 500 and t.disk_rows == 1500
+    assert len(t) == 2000
+
+    # promotion returns the exact spilled rows
+    got = t.pull_or_create(keys)
+    np.testing.assert_array_equal(got, rows)
+    assert t.disk_rows == 0 and t.mem_rows == 2000
+
+
+def test_spill_catchup_decay(tmp_path):
+    t = HostSparseTable(
+        LAYOUT, OPT, n_shards=2, seed=0, spill_dir=str(tmp_path / "spill")
+    )
+    keys = np.array([10, 20], dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    rows[:, LAYOUT.SHOW] = [64.0, 1.5]  # key 20 will lazily shrink
+    t.push(keys, rows)
+    t.save_base(str(tmp_path / "b"))  # clears touched so spill evicts all
+    t.spill_cold(0)
+    assert t.disk_rows == 2
+    # two pass boundaries of decay (0.5 each) happen while spilled
+    t.decay_and_shrink()
+    t.decay_and_shrink()
+    got = t.pull_or_create(keys)
+    # key 10: 64 * 0.25 = 16 survives; key 20: 1.5*0.25 < 1.0 -> lazily
+    # dropped and recreated fresh (show back to 0)
+    assert got[0, LAYOUT.SHOW] == pytest.approx(16.0)
+    assert got[1, LAYOUT.SHOW] == 0.0
+
+
+def test_delta_save_sees_spilled_touched_rows(tmp_path):
+    t = HostSparseTable(
+        LAYOUT, OPT, n_shards=2, seed=0, spill_dir=str(tmp_path / "spill")
+    )
+    keys = np.arange(1, 101, dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    t.push(keys, rows + 1.0)  # all touched
+    t.spill_cold(0)  # touched rows forced to disk, bit preserved
+    assert t.disk_rows == 100
+    n = t.save_delta(str(tmp_path / "delta"))
+    assert n == 100
+    # delta cleared the touched bits, including on-disk ones
+    assert t.save_delta(str(tmp_path / "d2")) == 0
+    # round-trip through a fresh table
+    t2 = HostSparseTable(LAYOUT, OPT, n_shards=2)
+    t2.apply_delta(str(tmp_path / "delta"))
+    np.testing.assert_allclose(t2.pull_or_create(keys), rows + 1.0)
+
+
+def test_train_pass_with_table_over_ram_cap(tmp_path):
+    """A pass trains correctly while the host table exceeds mem_cap_rows:
+    pass keys promote from disk at finalize, writeback lands, cold rows
+    re-spill at the pass-end hook."""
+    t = HostSparseTable(
+        LAYOUT,
+        SparseOptimizerConfig(initial_range=0.1, embedx_threshold=0.0),
+        n_shards=4,
+        seed=0,
+        spill_dir=str(tmp_path / "spill"),
+        mem_cap_rows=300,
+    )
+    # pre-populate 1000 keys then evict: table is 3x over its RAM cap
+    all_keys = np.arange(1, 1001, dtype=np.uint64)
+    base = t.pull_or_create(all_keys)
+    t.maybe_spill()
+    assert t.mem_rows <= 300 and t.disk_rows >= 700
+
+    # a pass touching a 200-key working subset
+    pass_keys = all_keys[100:300]
+    ws = PassWorkingSet(n_mesh_shards=1)
+    ws.add_keys(pass_keys)
+    dev = ws.finalize(t, round_to=64)
+    flat = dev.reshape(-1, LAYOUT.width)
+    np.testing.assert_array_equal(flat[ws.lookup(pass_keys)], base[100:300])
+
+    flat[ws.lookup(pass_keys)] += 2.0
+    ws.writeback(flat.reshape(dev.shape))
+    spilled = t.maybe_spill()
+    assert t.mem_rows <= 300
+    assert spilled > 0
+    # trained values survive the spill round-trip
+    got = t.pull_or_create(pass_keys)
+    np.testing.assert_allclose(got, base[100:300] + 2.0)
+    # untouched keys unchanged
+    np.testing.assert_array_equal(t.pull_or_create(all_keys[:100]), base[:100])
+
+
+def test_python_fallback_matches_contract(tmp_path, monkeypatch):
+    """The dict fallback still honors the same surface (no spill)."""
+    monkeypatch.setenv("PBOX_NATIVE_TABLE", "0")
+    t = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=1)
+    assert not t.native
+    keys = np.array([1, 2, 3], dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    np.testing.assert_array_equal(t.pull_or_create(keys), rows)
+    with pytest.raises(RuntimeError):
+        HostSparseTable(LAYOUT, OPT, spill_dir=str(tmp_path / "s"))
+    with pytest.raises(RuntimeError):
+        t.spill_cold(10)
+
+
+def test_pull_or_create_throughput():
+    """The native store must beat the measured dict-store wall (~160k/s) by
+    a wide margin; the VERDICT target is >=10M keys/s on unique pulls."""
+    import time
+
+    t = HostSparseTable(ValueLayout(embedx_dim=16), OPT, n_shards=64, seed=0)
+    n = 2_000_000
+    keys = np.random.default_rng(0).permutation(np.arange(1, n + 1)).astype(np.uint64)
+    t0 = time.perf_counter()
+    rows = t.pull_or_create(keys)
+    create_s = time.perf_counter() - t0
+    pull_s = min(
+        (lambda t0: (t.pull_or_create(keys), time.perf_counter() - t0)[1])(
+            time.perf_counter()
+        )
+        for _ in range(3)
+    )
+    t0 = time.perf_counter()
+    t.push(keys, rows)
+    push_s = time.perf_counter() - t0
+    rate = n / max(pull_s, 1e-9)
+    print(
+        f"\nnative table: create {n/create_s/1e6:.1f}M/s, "
+        f"pull {rate/1e6:.1f}M/s, push {n/push_s/1e6:.1f}M/s"
+    )
+    assert rate > 4e6, f"native pull rate {rate/1e6:.1f}M/s below floor"
