@@ -21,6 +21,7 @@
 
 #include <cctype>
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -88,14 +89,18 @@ inline bool parse_token(const char*& p, const char* end, const char** tok,
   return true;
 }
 
-inline uint64_t hex_field(const char* s, int a, int b) {
+// Parse s[a..b) as hex; a non-hex char sets *ok = false (the Python
+// oracle's int(_, 16) raises — mapping bad chars to 0 would silently
+// corrupt cmatch/rank/search_id).
+inline uint64_t hex_field(const char* s, int a, int b, bool* ok) {
   uint64_t v = 0;
   for (int i = a; i < b; ++i) {
     char c = s[i];
-    uint64_t d = (c >= '0' && c <= '9')   ? (uint64_t)(c - '0')
-                 : (c >= 'a' && c <= 'f') ? (uint64_t)(c - 'a' + 10)
-                 : (c >= 'A' && c <= 'F') ? (uint64_t)(c - 'A' + 10)
-                                          : 0;
+    uint64_t d;
+    if (c >= '0' && c <= '9') d = (uint64_t)(c - '0');
+    else if (c >= 'a' && c <= 'f') d = (uint64_t)(c - 'a' + 10);
+    else if (c >= 'A' && c <= 'F') d = (uint64_t)(c - 'A' + 10);
+    else { *ok = false; return 0; }
     v = (v << 4) | d;
   }
   return v;
@@ -173,9 +178,14 @@ void* pbx_parse_buffer(const char* data, int64_t len, int n_slots,
       int e1 = tl < 14 ? (int)tl : 14;
       int e2 = tl < 16 ? (int)tl : 16;
       int e3 = tl < 32 ? (int)tl : 32;
-      cm = (int32_t)hex_field(tok, 11, e1);
-      rk = (int32_t)hex_field(tok, 14, e2);
-      sid = hex_field(tok, 16, e3);
+      bool hex_ok = true;
+      cm = (int32_t)hex_field(tok, 11, e1, &hex_ok);
+      rk = (int32_t)hex_field(tok, 14, e2, &hex_ok);
+      sid = hex_field(tok, 16, e3, &hex_ok);
+      if (!hex_ok) {
+        delete out;
+        return fail("non-hex character in logkey", line_no);
+      }
       // the logkey IS the ins_id (parser.py sets it unconditionally)
       ins_tok = tok;
       ins_len = tl;
@@ -212,7 +222,9 @@ void* pbx_parse_buffer(const char* data, int64_t len, int n_slots,
             delete out;
             return fail("truncated slot line (ran out of tokens)", line_no);
           }
-          if (is_dense[s] || v >= 1e-6f || v <= -1e-6f) f_tmp.push_back(v);
+          // keep-test must be !(|v| < eps): NaN fails every comparison, and
+          // the Python oracle (abs(v) < 1e-6 -> skip) KEEPS NaN values
+          if (is_dense[s] || !(fabsf(v) < 1e-6f)) f_tmp.push_back(v);
         }
         f_off[++fi] = (uint32_t)f_tmp.size();
       } else {

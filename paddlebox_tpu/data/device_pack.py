@@ -342,6 +342,10 @@ class BatchPacker:
         self._L_pad = 0  # pack(): whole-batch; pack_sharded(): per-device
         self._U_pad = 0
         self._K_pad = 0
+        # every native handle ever spawned (any thread): close() frees the
+        # per-thread O(n_table_rows) scratch eagerly instead of waiting for
+        # executor threads to die and __del__ to fire
+        self._all_native: list = []
 
     def freeze_shapes(self, batch_indices, n_devices: int = 0) -> None:
         """Fix L_pad for a whole pass upfront so every batch compiles to ONE
@@ -373,6 +377,8 @@ class BatchPacker:
                 self._n_table_rows,
             )
             self._tls.packer = p
+            with self._shape_lock:
+                self._all_native.append(p)
         return p
 
     def _gather_flat(self, indices: np.ndarray):
@@ -463,7 +469,11 @@ class BatchPacker:
         return out
 
     def close(self) -> None:
-        p = getattr(self._tls, "packer", None)
-        if p is not None:
+        """Free every native scratch handle this packer spawned, including
+        ones created inside prefetch worker threads (close() may be called
+        from a thread that never packed)."""
+        with self._shape_lock:
+            handles, self._all_native = self._all_native, []
+        for p in handles:
             p.close()
-            self._tls.packer = None
+        self._tls.packer = None

@@ -48,7 +48,10 @@ class TcpTransport:
         self.n_ranks = len(endpoints)
         self.timeout = timeout
         self._endpoints = [self._parse(e) for e in endpoints]
-        self._inbox: Dict[Tuple[str, int], bytes] = {}
+        # (tag, src) -> FIFO of frames: a duplicate tag from one peer queues
+        # behind the unconsumed first frame instead of overwriting it (a
+        # dataset driven without set_date reuses pass-id-derived tags)
+        self._inbox: Dict[Tuple[str, int], List[bytes]] = {}
         self._cond = threading.Condition()
         self._send_socks: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {
@@ -95,7 +98,7 @@ class TcpTransport:
                 tag = _recv_exact(conn, tag_len).decode()
                 payload = _recv_exact(conn, n)
                 with self._cond:
-                    self._inbox[(tag, src)] = payload
+                    self._inbox.setdefault((tag, src), []).append(payload)
                     self._cond.notify_all()
         except (ConnectionError, OSError):
             return
@@ -110,7 +113,11 @@ class TcpTransport:
                     f"rank {self.rank}: no frame tag={tag!r} from rank {src} "
                     f"within {self.timeout}s"
                 )
-            return self._inbox.pop((tag, src))
+            q = self._inbox[(tag, src)]
+            payload = q.pop(0)
+            if not q:
+                del self._inbox[(tag, src)]
+            return payload
 
     # ---- send side -------------------------------------------------------
 
@@ -126,7 +133,7 @@ class TcpTransport:
         tb = tag.encode()
         if dst == self.rank:
             with self._cond:
-                self._inbox[(tag, self.rank)] = payload
+                self._inbox.setdefault((tag, self.rank), []).append(payload)
                 self._cond.notify_all()
             return
         with self._send_locks[dst]:

@@ -151,6 +151,12 @@ class DistributedWorkingSet:
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Batch keys -> GLOBAL row ids (int32); keys must be in the pass."""
+        if len(self.sorted_keys) == 0:
+            if len(keys):
+                raise KeyError(
+                    f"{len(keys)} batch keys but the pass working set is empty"
+                )
+            return np.zeros(0, np.int32)
         pos = np.searchsorted(self.sorted_keys, keys.astype(np.uint64))
         pos = np.minimum(pos, len(self.sorted_keys) - 1)
         if not np.all(self.sorted_keys[pos] == keys):

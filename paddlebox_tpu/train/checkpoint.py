@@ -50,10 +50,13 @@ class CheckpointManager:
         with open(p) as f:
             return json.load(f)
 
-    def _write_cursor(self, date: str, delta_idx: int) -> None:
+    def _write_cursor(self, date: str, delta_idx: int, dense: Optional[str]) -> None:
         tmp = self._cursor_path() + ".tmp"
+        cur = {"date": date, "delta_idx": delta_idx}
+        if dense is not None:
+            cur["dense"] = dense  # the dense file this sparse state pairs with
         with open(tmp, "w") as f:
-            json.dump({"date": date, "delta_idx": delta_idx}, f)
+            json.dump(cur, f)
         os.replace(tmp, self._cursor_path())  # atomic: crash-safe cursor
 
     # ---- save ------------------------------------------------------------
@@ -63,15 +66,21 @@ class CheckpointManager:
         delta counter — deltas are relative to this base."""
         day = self._day(date)
         table.save_base(os.path.join(day, "base"))
+        dense = None
         if trainer is not None:
-            trainer.save_dense(os.path.join(day, "dense"))
-        self._write_cursor(date, delta_idx=0)
+            dense = "dense-0000.npz"
+            trainer.save_dense(os.path.join(day, dense))
+        self._write_cursor(date, delta_idx=0, dense=dense)
         return os.path.join(day, "base")
 
     def save_delta(self, date: str, table: HostSparseTable, trainer=None) -> str:
         """Touched-keys snapshot (SaveDelta / xbox online-publish parity).
 
         Requires a base for ``date`` (deltas apply on top of it in order).
+        Each save writes its OWN dense file, named in the cursor only after
+        both sparse and dense are durable — a crash between the two can
+        never publish a sparse/dense skew (the cursor still points at the
+        previous consistent pair).
         """
         cur = self.cursor()
         if cur is None or cur["date"] != date:
@@ -83,9 +92,20 @@ class CheckpointManager:
         day = self._day(date)
         path = os.path.join(day, f"delta-{idx:04d}")
         table.save_delta(path)
+        dense = cur.get("dense")
         if trainer is not None:
-            trainer.save_dense(os.path.join(day, "dense"))
-        self._write_cursor(date, delta_idx=idx)
+            dense = f"dense-{idx:04d}.npz"
+            trainer.save_dense(os.path.join(day, dense))
+        self._write_cursor(date, delta_idx=idx, dense=dense)
+        # retire dense files older than the previous cursor (keep one back
+        # for safety against torn reads of cursor.json readers)
+        for i in range(idx - 1):
+            stale = os.path.join(day, f"dense-{i:04d}.npz")
+            if os.path.exists(stale):
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
         return path
 
     # ---- resume ----------------------------------------------------------
@@ -103,7 +123,9 @@ class CheckpointManager:
         table.load(os.path.join(day, "base"))
         for i in range(1, cur["delta_idx"] + 1):
             table.apply_delta(os.path.join(day, f"delta-{i:04d}"))
-        dense = os.path.join(day, "dense.npz")
+        # per-save dense file named in the cursor; "dense.npz" is the
+        # pre-versioning layout (older checkpoints)
+        dense = os.path.join(day, cur.get("dense") or "dense.npz")
         if trainer is not None and os.path.exists(dense):
             if trainer.params is None:
                 trainer.init_params()

@@ -17,7 +17,7 @@ working-set table is rebuilt per pass (pass-scoped HBM staging parity).
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,7 @@ from paddlebox_tpu.train.train_step import (
     jit_train_step,
     make_train_step,
 )
+from paddlebox_tpu.utils.dump import DumpWorkerPool, dump_fields, dump_param
 
 
 class CTRTrainer:
@@ -215,17 +216,23 @@ class CTRTrainer:
             k: jax.device_put(v, self.plan.batch_sharding) for k, v in db.as_dict().items()
         }
 
-    def _feed_aux(self, feed, batch=None, ins_weight=None, cmatch=None, rank=None):
+    def _feed_aux(
+        self, feed, batch=None, ins_weight=None, cmatch=None, rank=None, ins_ids=None
+    ):
         """(device feed, registry aux) tuple for the step loop."""
         aux = {}
         if batch is not None:
             cmatch, rank = batch.cmatch, batch.rank
+            if ins_ids is None:
+                ins_ids = batch.ins_ids
         if cmatch is not None:
             aux["cmatch"] = cmatch
         if rank is not None:
             aux["rank"] = rank
         if ins_weight is not None:
             aux["ins_weight"] = ins_weight
+        if ins_ids is not None:
+            aux["ins_ids"] = ins_ids
         return feed, aux
 
     def _pv_feed_iter(self, dataset, n_batches):
@@ -295,11 +302,13 @@ class CTRTrainer:
                 }
             return idx, feed
 
+        want_ids = has_meta and self.dump_pool is not None
         for idx, feed in prefetch(dataset.batch_indices(n_batches), prep):
             yield self._feed_aux(
                 feed,
                 cmatch=store.cmatch[idx] if has_meta else None,
                 rank=store.rank[idx] if has_meta else None,
+                ins_ids=[store.ins_id(int(j)) for j in idx] if want_ids else None,
             )
 
     def train_pass(
@@ -351,6 +360,8 @@ class CTRTrainer:
                 outputs = dict(m)
                 outputs.update(aux)
                 self.metric_registry.add_all(outputs, phase=dataset.current_phase)
+            if self.dump_pool is not None:
+                self._dump_batch(i, m, aux)
             if on_batch is not None:
                 on_batch(i, m)
             losses.append(m["loss"])
@@ -370,10 +381,45 @@ class CTRTrainer:
             self.params = state.params
             self.opt_state = state.opt_state
         self._state = state
+        if self.dump_pool is not None and self.dump_params_at_end:
+            # DumpParam parity (device_worker.cc:131-133): dense params once
+            # at pass end, one line per leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(self.params)[0]:
+                name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+                dump_param(self.dump_pool, name, np.asarray(leaf))
         out = auc_compute(state.auc)
         out["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
         out["batches"] = float(len(losses))
         return out
+
+    def _dump_batch(self, step_i: int, m: Dict, aux: Dict) -> None:
+        """Per-batch field dump (DeviceWorker::DumpField parity,
+        device_worker.cc:98-133; sampling modes device_worker.h:218-219)."""
+        if not self.dump_pool._started:
+            self.dump_pool.start()
+        fields = {}
+        n_ins = None
+        for name in self.dump_fields_list:
+            if name not in m:
+                continue
+            arr = np.asarray(m[name])
+            flat = arr.reshape(-1, *arr.shape[2:]) if arr.ndim > 1 else arr
+            fields[name] = flat
+            n_ins = len(flat) if n_ins is None else min(n_ins, len(flat))
+        if not fields or not n_ins:
+            return
+        ins_ids = aux.get("ins_ids")
+        if ins_ids is None or len(ins_ids) != n_ins:
+            # no ins-id metadata parsed: fall back to batch-ordinal ids
+            ins_ids = [f"b{step_i}:{j}" for j in range(n_ins)]
+        dump_fields(
+            self.dump_pool,
+            ins_ids,
+            {k: v[:n_ins] for k, v in fields.items()},
+            step=step_i,
+            dump_mode=self.dump_mode,
+            dump_interval=self.dump_interval,
+        )
 
     def trained_table(self) -> np.ndarray:
         if self._state is None:

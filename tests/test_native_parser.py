@@ -147,6 +147,25 @@ def test_native_edge_parity():
     assert_records_equal(got, [want])
     assert got[0].search_id == 0x1234 and got[0].cmatch == 0xABC
 
+    # NaN floats are KEPT (oracle's abs(v) < 1e-6 is False for NaN); the
+    # downstream NaN guardrails own rejection, not the parser
+    schema_nan = SlotSchema(
+        [SlotInfo("f0", type="float"), SlotInfo("s0")], label_slot=None
+    )
+    want = parse_line("2 nan 0.5 1 5", schema_nan)
+    got = native.parse_buffer(b"2 nan 0.5 1 5\n", schema_nan)
+    assert len(want.f_values) == 2 and np.isnan(want.f_values[0])
+    assert len(got[0].f_values) == 2 and np.isnan(got[0].f_values[0])
+    np.testing.assert_array_equal(got[0].f_offsets, want.f_offsets)
+
+    # non-hex chars in the logkey reject the parse (oracle: int(_,16) raises)
+    schema_lk1 = schema_of(True, n_sparse=1)
+    bad = "0" * 11 + "xyz" + "1f" + "1234"
+    with pytest.raises(ValueError, match="hex"):
+        native.parse_buffer(f"1 {bad} 1 0.5 1 9\n".encode(), schema_lk1)
+    with pytest.raises(ValueError):
+        parse_line(f"1 {bad} 1 0.5 1 9", schema_lk1)
+
     # ins_id + logkey: the logkey wins as ins_id (parser.py overwrite)
     slots = [SlotInfo("label", type="float", dense=True, dim=1), SlotInfo("s0")]
     schema_both = SlotSchema(slots, label_slot="label",
