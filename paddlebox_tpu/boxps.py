@@ -66,8 +66,10 @@ class BoxWrapper:
         return self.phase
 
     def set_test_mode(self, on: bool = True) -> None:
-        """SetTestMode parity (box_wrapper.cc:623): eval without pushes —
-        trainers should skip writeback when set."""
+        """SetTestMode parity (box_wrapper.cc:623): a CTRTrainer constructed
+        with ``box=this`` runs its next train_pass as forward+metrics only —
+        no sparse push, no dense update (infer_from_dataset parity,
+        executor.py:1520)."""
         self.test_mode = on
 
     # ---- dataset ---------------------------------------------------------

@@ -98,9 +98,14 @@ class CheckpointManager:
             trainer.save_dense(os.path.join(day, dense))
         self._write_cursor(date, delta_idx=idx, dense=dense)
         # retire dense files older than the previous cursor (keep one back
-        # for safety against torn reads of cursor.json readers)
+        # for safety against torn reads of cursor.json readers) — but never
+        # the file the new cursor itself references (deltas saved with
+        # trainer=None carry the older dense name forward)
         for i in range(idx - 1):
-            stale = os.path.join(day, f"dense-{i:04d}.npz")
+            name = f"dense-{i:04d}.npz"
+            if name == dense:
+                continue
+            stale = os.path.join(day, name)
             if os.path.exists(stale):
                 try:
                     os.remove(stale)

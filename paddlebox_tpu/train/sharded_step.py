@@ -100,12 +100,17 @@ def make_sharded_train_step(
     dense_opt: optax.GradientTransformation,
     cfg: TrainStepConfig,
     plan: MeshPlan,
+    eval_mode: bool = False,
 ) -> Callable:
     """Build jitted ``step(state, batch_dict) -> (state, metrics)`` on the mesh.
 
     ``cfg.batch_size`` is the PER-DEVICE batch; ``batch_dict`` fields come from
     ``pack_batch_sharded`` (req_ranks/inverse/segments/labels[/dense], all with
     a leading device axis) placed with ``plan.batch_sharding``.
+
+    ``eval_mode`` (SetTestMode parity, box_wrapper.cc:623): forward +
+    metrics only — the sharded pull/all_to_all still runs, but no push, no
+    dense update; table/params/opt_state return bit-identical.
     """
     if cfg.axis_name not in (None, plan.axis):
         raise ValueError(
@@ -184,8 +189,29 @@ def make_sharded_train_step(
         loss, preds, gparams, gflat = local_forward_backward(
             model_apply, cfg, params, flat, segments, labels, dense,
             ins_weight=ins_weight, rank_offset=rank_offset,
-            loss_denom=loss_denom,
+            loss_denom=loss_denom, eval_mode=eval_mode,
         )
+        if eval_mode:
+            loss = (
+                jax.lax.psum(loss, ax)
+                if ins_weight is not None
+                else jax.lax.pmean(loss, ax)
+            )
+            local_auc = AucState(pos=state.auc.pos[0], neg=state.auc.neg[0])
+            auc_mask = None if ins_weight is None else (ins_weight > 0)
+            new_auc = auc_update(local_auc, preds, labels, auc_mask)
+            return (
+                state._replace(
+                    auc=AucState(pos=new_auc.pos[None], neg=new_auc.neg[None]),
+                    step=state.step + 1,
+                ),
+                {
+                    "loss": loss,
+                    "step": state.step + 1,
+                    "preds": preds,
+                    "labels": labels,
+                },
+            )
         # grad_div rescales local-mean grads to GLOBAL-batch-mean so the
         # owner-side merge matches single-device semantics exactly and the
         # effective sparse LR is independent of mesh size

@@ -109,6 +109,7 @@ def local_forward_backward(
     ins_weight: Optional[jnp.ndarray] = None,  # [b] 0 masks ghost-padded ins
     rank_offset: Optional[jnp.ndarray] = None,  # [b, 2R+1] join-phase pv matrix
     loss_denom: Optional[jnp.ndarray] = None,  # weighted-loss denominator
+    eval_mode: bool = False,  # forward only: grads come back as None
 ):
     """Shared fwd/bwd body: seqpool+CVM -> model -> BCE, grads wrt (params, flat).
 
@@ -157,6 +158,9 @@ def local_forward_backward(
             loss = jnp.mean(loss_vec)
         return loss, jax.nn.sigmoid(logits)
 
+    if eval_mode:
+        loss, preds = loss_fn(params, flat)
+        return loss, preds, None, None
     (loss, preds), (gparams, gflat) = jax.value_and_grad(
         loss_fn, argnums=(0, 1), has_aux=True
     )(params, flat)
@@ -204,11 +208,17 @@ def make_train_step(
     model_apply: Callable,
     dense_opt: optax.GradientTransformation,
     cfg: TrainStepConfig,
+    eval_mode: bool = False,
 ) -> Callable:
     """Build ``step(state, batch_dict) -> (state, metrics)`` (pure, jittable).
 
     ``batch_dict`` fields: uniq_rows [U], inverse [L], segments [L],
     labels [B], optional dense [B, Dd]. See data/device_pack.py.
+
+    ``eval_mode`` is the SetTestMode path (box_wrapper.cc:623,
+    infer_from_dataset executor.py:1520): forward + metrics only — no
+    sparse push, no dense update; table/params/opt_state return
+    bit-identical.
     """
     lay, opt = cfg.layout, cfg.sparse_opt
     S, B = cfg.num_slots, cfg.batch_size
@@ -237,31 +247,41 @@ def make_train_step(
         loss, preds, gparams, gflat = local_forward_backward(
             model_apply, cfg, state.params, flat, segments, labels, dense,
             ins_weight=ins_weight, rank_offset=rank_offset,
+            eval_mode=eval_mode,
         )
-        # --- sparse push: per-slot lr scaling happens at flat resolution
-        # (a key deduped across slots gets each slot's scaled contribution),
-        # then grads merge per unique row — PushMergeCopy parity.
-        guniq, show_counts, clk_counts = scale_and_merge_grads(
-            cfg, gflat, segments, inverse, labels, num_segments=U,
-            ins_weight=ins_weight,
-        )
-
-        new_table = push_sparse_rows(
-            state.table, uniq_rows, guniq, show_counts, clk_counts, lay, opt
-        )
-
-        # --- dense sync: psum over the DP axis (K-step/NCCL allreduce parity)
-        if cfg.axis_name is not None:
-            gparams = jax.lax.pmean(gparams, cfg.axis_name)
-            loss = jax.lax.pmean(loss, cfg.axis_name)
-        if cfg.dense_sync_mode == "async":
-            # host AsyncDenseTable owns the dense optimizer: hand grads back
+        if eval_mode:
+            new_table = state.table
             new_params, new_opt_state = state.params, state.opt_state
+            if cfg.axis_name is not None:
+                loss = jax.lax.pmean(loss, cfg.axis_name)
         else:
-            updates, new_opt_state = dense_opt.update(
-                gparams, state.opt_state, state.params
+            # --- sparse push: per-slot lr scaling happens at flat
+            # resolution (a key deduped across slots gets each slot's
+            # scaled contribution), then grads merge per unique row —
+            # PushMergeCopy parity.
+            guniq, show_counts, clk_counts = scale_and_merge_grads(
+                cfg, gflat, segments, inverse, labels, num_segments=U,
+                ins_weight=ins_weight,
             )
-            new_params = optax.apply_updates(state.params, updates)
+
+            new_table = push_sparse_rows(
+                state.table, uniq_rows, guniq, show_counts, clk_counts, lay, opt
+            )
+
+            # --- dense sync: psum over the DP axis (K-step/NCCL allreduce
+            # parity)
+            if cfg.axis_name is not None:
+                gparams = jax.lax.pmean(gparams, cfg.axis_name)
+                loss = jax.lax.pmean(loss, cfg.axis_name)
+            if cfg.dense_sync_mode == "async":
+                # host AsyncDenseTable owns the dense optimizer: hand grads
+                # back
+                new_params, new_opt_state = state.params, state.opt_state
+            else:
+                updates, new_opt_state = dense_opt.update(
+                    gparams, state.opt_state, state.params
+                )
+                new_params = optax.apply_updates(state.params, updates)
 
         auc_mask = None if ins_weight is None else (ins_weight > 0)
         new_auc = auc_update(state.auc, preds, labels, auc_mask)
@@ -273,7 +293,7 @@ def make_train_step(
             "preds": preds,
             "labels": labels,
         }
-        if cfg.dense_sync_mode == "async":
+        if cfg.dense_sync_mode == "async" and not eval_mode:
             metrics["gparams"] = gparams
         return (
             TrainState(

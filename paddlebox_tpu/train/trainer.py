@@ -62,6 +62,7 @@ class CTRTrainer:
         dump_mode: int = 0,  # 0 all, 1 sample-by-ins-id-hash, 2 every Nth batch
         dump_interval: int = 1,
         dump_params_at_end: bool = False,
+        box: Optional[Any] = None,  # BoxWrapper whose test_mode gates eval
     ):
         self.model = model
         self.cfg = cfg
@@ -98,10 +99,42 @@ class CTRTrainer:
         self.params: Any = None
         self.opt_state: Any = None
         self._state: Optional[TrainState] = None
+        # eval/infer mode (SetTestMode box_wrapper.cc:623 +
+        # infer_from_dataset executor.py:1520): either set directly on the
+        # trainer or inherited from the owning BoxWrapper each pass
+        self.box = box
+        self.test_mode = False
+        self._eval_step_cache = None
         if plan is None:
             self._step = jit_train_step(make_train_step(model.apply, self.dense_opt, cfg))
         else:
             self._step = make_sharded_train_step(model.apply, self.dense_opt, cfg, plan)
+
+    # ---- eval mode -------------------------------------------------------
+
+    def set_test_mode(self, on: bool = True) -> None:
+        """SetTestMode parity: the next train_pass runs forward+metrics only
+        (no sparse push, no dense update) until cleared."""
+        self.test_mode = on
+
+    @property
+    def _eval_active(self) -> bool:
+        return self.test_mode or bool(self.box is not None and self.box.test_mode)
+
+    def _eval_step(self):
+        if self._eval_step_cache is None:
+            if self.plan is None:
+                self._eval_step_cache = jit_train_step(
+                    make_train_step(
+                        self.model.apply, self.dense_opt, self.cfg, eval_mode=True
+                    )
+                )
+            else:
+                self._eval_step_cache = make_sharded_train_step(
+                    self.model.apply, self.dense_opt, self.cfg, self.plan,
+                    eval_mode=True,
+                )
+        return self._eval_step_cache
 
     # ---- dense param lifecycle ------------------------------------------
 
@@ -288,6 +321,8 @@ class CTRTrainer:
         )
         has_meta = store.ins_id_off is not None
 
+        want_ids = has_meta and self.dump_pool is not None
+
         def prep(idx):
             if self.plan is None:
                 db = packer.pack(idx)
@@ -300,15 +335,17 @@ class CTRTrainer:
                     k: jax.device_put(v, self.plan.batch_sharding)
                     for k, v in db.as_dict().items()
                 }
-            return idx, feed
+            # ins_id string extraction belongs in the overlapped worker, not
+            # between device steps
+            ids = [store.ins_id(int(j)) for j in idx] if want_ids else None
+            return idx, feed, ids
 
-        want_ids = has_meta and self.dump_pool is not None
-        for idx, feed in prefetch(dataset.batch_indices(n_batches), prep):
+        for idx, feed, ids in prefetch(dataset.batch_indices(n_batches), prep):
             yield self._feed_aux(
                 feed,
                 cmatch=store.cmatch[idx] if has_meta else None,
                 rank=store.rank[idx] if has_meta else None,
-                ins_ids=[store.ins_id(int(j)) for j in idx] if want_ids else None,
+                ins_ids=ids,
             )
 
     def train_pass(
@@ -345,13 +382,21 @@ class CTRTrainer:
             iterator = self._fast_feed_iter(dataset, n_batches)
         else:
             iterator = self._slow_feed_iter(dataset, n_batches)
-        is_async = self.cfg.dense_sync_mode == "async"
+        eval_mode = self._eval_active
+        step_fn = self._eval_step() if eval_mode else self._step
+        is_async = self.cfg.dense_sync_mode == "async" and not eval_mode
+        # AUC buckets accumulate in device state across train_pass calls
+        # within one pass (warmup epochs, join/update phases, sequential
+        # slot-shuffle evals); snapshot them so THIS call's metrics are a
+        # bucket delta, not the running total
+        auc_pos0 = np.asarray(state.auc.pos).copy()
+        auc_neg0 = np.asarray(state.auc.neg).copy()
         for i, (feed, aux) in enumerate(iterator):
             if is_async:  # PullDense / PushDense worker loop (B6)
                 state = state._replace(
                     params=jax.device_put(self.async_dense.pull_dense())
                 )
-            state, m = self._step(state, feed)
+            state, m = step_fn(state, feed)
             if is_async:
                 self.async_dense.push_dense(jax.tree.map(np.asarray, m["gparams"]))
             if self.metric_registry is not None:
@@ -366,7 +411,17 @@ class CTRTrainer:
                 on_batch(i, m)
             losses.append(m["loss"])
         # persist dense side for the next pass; state.table stays for writeback
-        if is_async:
+        if eval_mode:
+            # values are bit-identical, but the OLD buffers were donated into
+            # the eval step — re-point at the returned state (skipping the
+            # kstep pass-end sync, whose pmean would perturb bits)
+            if self.plan is not None and self.cfg.dense_sync_mode == "kstep":
+                self.params = jax.tree.map(lambda x: x[0], state.params)
+                self.opt_state = jax.tree.map(lambda x: x[0], state.opt_state)
+            else:
+                self.params = state.params
+                self.opt_state = state.opt_state
+        elif is_async:
             # the host table owns the dense params; snapshot its latest view
             self.params = jax.device_put(self.async_dense.pull_dense())
             self.opt_state = state.opt_state  # untouched in async mode
@@ -387,7 +442,14 @@ class CTRTrainer:
             for path, leaf in jax.tree_util.tree_flatten_with_path(self.params)[0]:
                 name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
                 dump_param(self.dump_pool, name, np.asarray(leaf))
-        out = auc_compute(state.auc)
+        from paddlebox_tpu.metrics.auc import AucState
+
+        delta = AucState(
+            pos=np.asarray(state.auc.pos) - auc_pos0,
+            neg=np.asarray(state.auc.neg) - auc_neg0,
+        )
+        out = auc_compute(delta)
+        out["auc_cumulative"] = auc_compute(state.auc)["auc"]
         out["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
         out["batches"] = float(len(losses))
         return out
@@ -403,6 +465,8 @@ class CTRTrainer:
             if name not in m:
                 continue
             arr = np.asarray(m[name])
+            if arr.ndim == 0:
+                continue  # scalars (loss, step) have no per-instance rows
             flat = arr.reshape(-1, *arr.shape[2:]) if arr.ndim > 1 else arr
             fields[name] = flat
             n_ins = len(flat) if n_ins is None else min(n_ins, len(flat))

@@ -170,6 +170,58 @@ def test_save_delta_never_overwrites_live_dense(tmp_path):
     )["delta_idx"] == 1
 
 
+def test_dense_retire_spares_cursor_referenced_file(tmp_path):
+    """Deltas saved with trainer=None carry the older dense name forward in
+    the cursor; the retire loop must never delete that referenced file."""
+    import optax
+
+    from paddlebox_tpu.models import LogisticRegression
+    from paddlebox_tpu.train import CheckpointManager, CTRTrainer, TrainStepConfig
+
+    model = LogisticRegression(num_slots=4, feat_width=LAYOUT.pull_width)
+    cfg = TrainStepConfig(
+        num_slots=4, batch_size=8, layout=LAYOUT, sparse_opt=OPT, auc_buckets=100
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params()
+    table = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    keys = np.arange(1, 10, dtype=np.uint64)
+    table.pull_or_create(keys)
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_base("20260101", table, tr)
+    for _ in range(3):  # sparse-only deltas: no trainer
+        table.push(keys, table.pull_or_create(keys) + 1.0)
+        cm.save_delta("20260101", table)
+    cur = cm.cursor()
+    assert cur == {"date": "20260101", "delta_idx": 3, "dense": "dense-0000.npz"}
+    assert os.path.exists(os.path.join(str(tmp_path), "20260101", "dense-0000.npz"))
+    tr2 = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr2.init_params()
+    cm.resume(HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0), tr2)
+    import jax
+
+    for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(tr2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dump_scalar_field_skipped(tmp_path):
+    """A 0-d metric in dump_fields_list is skipped, not crashed on."""
+    from paddlebox_tpu.utils.dump import DumpWorkerPool
+
+    pool = DumpWorkerPool(str(tmp_path / "dump"), n_threads=1)
+    _tiny_training(
+        tmp_path, schema_meta=True, dump_pool=pool,
+        dump_fields_list=("loss", "preds"),
+    )
+    pool.finalize()
+    lines = [
+        l
+        for p in glob.glob(str(tmp_path / "dump" / "part-*"))
+        for l in open(p).read().strip().splitlines()
+    ]
+    assert len(lines) == 64 and all("preds:" in l and "loss" not in l for l in lines)
+
+
 # ---- transport duplicate frames (round-2 finding: inbox overwrite) ---------
 
 

@@ -1,0 +1,129 @@
+"""Eval/infer mode: SetTestMode gates a metrics-only pass.
+
+Parity: BoxWrapper::SetTestMode (box_wrapper.cc:623) + infer_from_dataset
+(executor.py:1520). An eval pass must leave the sparse table, dense params,
+and optimizer state BIT-identical while still producing AUC/loss metrics —
+this is what makes AucRunner slots-shuffle evaluation sound (the shuffled
+pass must not train on shuffled features).
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.boxps import BoxWrapper
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.table import SparseOptimizerConfig, ValueLayout
+from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+
+LAYOUT = ValueLayout(embedx_dim=4)
+OPT = SparseOptimizerConfig(embedx_threshold=0.0, initial_range=0.01)
+NS, B = 4, 16
+
+
+def _build(tmp_path, box, n_mesh_shards=1):
+    rng = np.random.default_rng(0)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    path = tmp_path / "data.txt"
+    with open(path, "w") as f:
+        for _ in range(96):
+            keys = rng.integers(1, 300, NS)
+            f.write(
+                f"1 {int(keys[0]) % 2}.0 "
+                + " ".join(f"1 {k}" for k in keys) + "\n"
+            )
+    ds = box.make_dataset(
+        schema, batch_size=B, seed=0, n_mesh_shards=n_mesh_shards
+    )
+    ds.set_filelist([str(path)])
+    return ds
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+@pytest.mark.parametrize("mesh", [False, True])
+def test_eval_pass_bit_identical_state(tmp_path, mesh):
+    box = BoxWrapper(embedx_dim=4, sparse_opt=OPT, n_host_shards=4)
+    ds = _build(tmp_path, box, n_mesh_shards=4 if mesh else 1)
+    model = DeepFM(num_slots=NS, feat_width=LAYOUT.pull_width,
+                   embedx_dim=4, hidden=(8,))
+    plan = None
+    bs = B
+    if mesh:
+        from paddlebox_tpu.parallel import make_mesh
+
+        plan = make_mesh(4)
+        bs = B // 4
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=bs, layout=LAYOUT, sparse_opt=OPT,
+        auc_buckets=500, axis_name=plan.axis if plan else None,
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan, box=box)
+    tr.init_params(jax.random.PRNGKey(0))
+
+    # pass 1: train normally
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64)
+    tr.train_pass(ds)
+    table_before = tr.trained_table().copy()
+    params_before = _leaves(tr.params)
+    opt_before = _leaves(tr.opt_state)
+
+    # pass continues in eval mode over the same working set
+    box.set_test_mode(True)
+    out = tr.train_pass(ds)
+    assert out["batches"] > 0 and np.isfinite(out["loss"])
+    assert 0.0 < out["auc"] <= 1.0  # metrics still flow
+
+    table_after = tr.trained_table()
+    np.testing.assert_array_equal(table_after, table_before)
+    for a, b in zip(_leaves(tr.params), params_before):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(_leaves(tr.opt_state), opt_before):
+        np.testing.assert_array_equal(a, b)
+
+    # end_pass writeback lands exactly the PRE-eval trained rows: the eval
+    # pass contributed nothing to what reaches the host table
+    keys = ds.ws.sorted_keys.copy()
+    rows = ds.ws.row_of_sorted.copy()
+    ds.end_pass(tr.trained_table(), shrink=False)
+    flat = table_before.reshape(-1, LAYOUT.width)
+    np.testing.assert_array_equal(box.table.pull_or_create(keys), flat[rows])
+
+    # clearing test_mode resumes real training
+    box.set_test_mode(False)
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64)
+    tr.train_pass(ds)
+    assert not np.array_equal(tr.trained_table(), table_before)
+
+
+def test_trainer_local_test_mode_flag(tmp_path):
+    """trainer.set_test_mode works without a BoxWrapper binding."""
+    box = BoxWrapper(embedx_dim=4, sparse_opt=OPT, n_host_shards=4)
+    ds = _build(tmp_path, box)
+    model = DeepFM(num_slots=NS, feat_width=LAYOUT.pull_width,
+                   embedx_dim=4, hidden=(8,))
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=B, layout=LAYOUT, sparse_opt=OPT, auc_buckets=500
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64)
+    tr.train_pass(ds)
+    t0 = tr.trained_table().copy()
+    tr.set_test_mode(True)
+    tr.train_pass(ds)
+    np.testing.assert_array_equal(tr.trained_table(), t0)
+    tr.set_test_mode(False)
+    tr.train_pass(ds)
+    assert not np.array_equal(tr.trained_table(), t0)
