@@ -408,6 +408,8 @@ class BoxPSDataset:
         self._records = records if records is not None else []
         self.ws = ws
         self.stats = stats
+        # new data in memory: lockstep batch count must be renegotiated
+        self._load_gen = getattr(self, "_load_gen", 0) + 1
 
     def _normalize_and_shuffle(self, parts: list):
         """File-part chunks -> (store, order, records): columnar when every
@@ -613,14 +615,31 @@ class BoxPSDataset:
         return len(self._records)
 
     def num_batches(self, global_count: Optional[int] = None) -> int:
-        """Minibatch count this pass. With ``global_count`` (the allreduced
-        max across nodes — compute_thread_batch_nccl parity) the tail is
-        re-split so every node runs the same count."""
+        """Minibatch count this pass. Lockstep across nodes: with a
+        transport attached the local count is allreduce-max'd automatically
+        (compute_thread_batch_nccl parity, data_set.cc:2069-2135) so every
+        node runs the same count and mesh collectives never desync;
+        ``global_count`` overrides with an externally agreed count."""
+        if global_count is not None:
+            return global_count
         n = self.memory_data_size()
         local = n // self.batch_size
         if not self.drop_remainder and n % self.batch_size:
             local += 1
-        return global_count if global_count is not None else local
+        if self.transport is not None and self.transport.n_ranks > 1:
+            # cache key must be identical on every rank (pass + load
+            # generation, both advanced in lockstep) — keying on the LOCAL
+            # count would let one rank skip the collective another enters
+            key = (self.pass_id, getattr(self, "_load_gen", 0))
+            cached = getattr(self, "_nb_lockstep", None)
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            agreed = self.transport.allreduce_max(
+                local, f"nb:{key[0]}:{key[1]}"
+            )
+            self._nb_lockstep = (key, agreed)
+            return agreed
+        return local
 
     def batch_indices(self, n_batches: Optional[int] = None) -> Iterator[np.ndarray]:
         """Store-record indices of each minibatch (the fast-path analog of

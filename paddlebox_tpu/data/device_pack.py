@@ -122,10 +122,19 @@ def _route_sharded(
     k_floor: int = 0,
     l_floor: int = 0,
 ) -> ShardedDeviceBatch:
-    """Shared mesh routing: flat (rows, segments) -> per-device buckets."""
+    """Shared mesh routing: flat (rows, segments) -> per-device buckets.
+
+    ``n_devices`` is the number of devices THIS pack serves (all of them
+    single-host; this host's local devices multi-host), while routing
+    targets all ``ws.n_mesh_shards`` global shards — a host packs its own
+    records into [n_local, n_shards, K] request buckets and the mesh
+    all_to_all delivers them."""
     ns = ws.n_mesh_shards
-    if n_devices != ns:
-        raise ValueError(f"n_devices {n_devices} != working-set mesh shards {ns}")
+    if ns % n_devices:
+        raise ValueError(
+            f"{ns} working-set mesh shards not divisible by {n_devices} "
+            "packed devices"
+        )
     if B % n_devices:
         raise ValueError(f"batch {B} not divisible by {n_devices} devices")
     b = B // n_devices
@@ -211,8 +220,10 @@ def pack_batch_sharded(
     performs internally: every unique row is assigned to its owner shard's
     request bucket here, so the device side is pure all_to_all + gather.
 
-    ``n_devices`` must equal the working set's mesh shard count (table shard
-    axis == dp axis), and the batch size must divide evenly.
+    ``n_devices`` is the number of devices this batch feeds: all mesh
+    devices single-host (== the working set's shard count; table shard axis
+    == dp axis), or this host's LOCAL device block multi-host (the global
+    shard count just has to divide by it). Batch size must divide evenly.
     """
     bucket = bucket or config.get_flag("batch_bucket_rounding")
     rows = ws.lookup(batch.keys)  # int32 [L] global rows (shard*cap + rank)
@@ -347,22 +358,57 @@ class BatchPacker:
         # executor threads to die and __del__ to fire
         self._all_native: list = []
 
-    def freeze_shapes(self, batch_indices, n_devices: int = 0) -> None:
+    def freeze_shapes(self, batch_indices, n_devices: int = 0, transport=None) -> None:
         """Fix L_pad for a whole pass upfront so every batch compiles to ONE
         device program: L is exactly computable per batch from the record
         key counts (per device when ``n_devices`` > 0 — the sharded feed's
         L dimension is per-device). Call with the pass's batch partition
-        before the first pack."""
+        before the first pack.
+
+        With a ``transport`` both pads are allreduce-max'd across hosts and
+        K (the per-shard request bucket) is frozen from an exact scan of
+        every batch's per-(device, shard) unique-row counts, so every host
+        compiles the SAME mesh program — collectives can never see
+        mismatched shapes (lockstep parity, compute_thread_batch_nccl
+        data_set.cc:2069-2135) — without inflating the all_to_all payload
+        beyond what the pass actually needs."""
+        lockstep = transport is not None and transport.n_ranks > 1
+        batches = [np.asarray(idx) for idx in batch_indices]
         max_L = 1
-        for idx in batch_indices:
-            counts = self._key_counts[np.asarray(idx)]
+        max_bucket = 0
+        for idx in batches:
+            counts = self._key_counts[idx]
             if n_devices:
                 per_dev = counts.reshape(n_devices, -1).sum(axis=1)
                 max_L = max(max_L, int(per_dev.max()))
             else:
                 max_L = max(max_L, int(counts.sum()))
+            if lockstep and n_devices:
+                # exact per-(device, shard) request-bucket need of this batch
+                from paddlebox_tpu.data.record_store import _ragged_indices
+
+                cap = self.ws.capacity
+                ns = self.ws.n_mesh_shards
+                base = self.store.u64_base[idx]
+                for d in range(n_devices):
+                    sl = slice(d * (len(idx) // n_devices), (d + 1) * (len(idx) // n_devices))
+                    rows = self._rows[_ragged_indices(base[sl], counts[sl])]
+                    if len(rows):
+                        uniq = np.unique(rows)
+                        max_bucket = max(
+                            max_bucket,
+                            int(np.bincount(uniq // cap, minlength=ns).max()),
+                        )
+        if lockstep:
+            max_L = transport.allreduce_max(max_L, "freeze-L")
         with self._shape_lock:
             self._L_pad = max(self._L_pad, _round_bucket(max_L, self.bucket))
+            if lockstep and n_devices:
+                # +1 reserves the pad slot; identical on every host after
+                # the allreduce, and K <= L so _route_sharded's local
+                # rounding can never exceed this floor
+                k = transport.allreduce_max(max_bucket + 1, "freeze-K")
+                self._K_pad = max(self._K_pad, _round_bucket(k, self.bucket))
 
     def _native(self):
         from paddlebox_tpu.utils import native

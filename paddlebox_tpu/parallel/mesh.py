@@ -76,10 +76,59 @@ def make_mesh(
 
 
 def put_sharded(plan: MeshPlan, x: Any) -> jax.Array:
-    """Host array [n_dev, ...] -> device array sharded on axis 0."""
-    return jax.device_put(x, plan.batch_sharding)
+    """Host array -> device array sharded on axis 0 over the mesh.
+
+    Multi-host aware: when the mesh spans processes, ``x`` may be either
+    the GLOBAL array (each process contributes its own row block, assuming
+    the 1-D mesh orders devices by process — jax.devices() order) or just
+    this process's LOCAL block ``[n_local_dev, ...]`` (the shape a
+    DistributedWorkingSet finalize returns); both assemble into one global
+    jax.Array without any cross-host transfer of remote rows.
+    """
+    sh = plan.batch_sharding
+    if jax.process_count() == 1:
+        return jax.device_put(x, sh)
+    n = plan.n_devices
+    per = n // jax.process_count()
+
+    def place(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # already a global array (e.g. opt state carried across passes):
+            # re-placing to the same sharding is a no-op, and np.asarray
+            # would crash on its non-addressable shards
+            return jax.device_put(leaf, sh)
+        leaf = np.asarray(leaf)
+        if leaf.shape[0] == n:
+            local = leaf[jax.process_index() * per : (jax.process_index() + 1) * per]
+        elif leaf.shape[0] == per:
+            local = leaf
+        else:
+            raise ValueError(
+                f"put_sharded: leading dim {leaf.shape[0]} is neither the "
+                f"global device count {n} nor this host's local count {per}"
+            )
+        return jax.make_array_from_process_local_data(
+            sh, np.ascontiguousarray(local), (n,) + leaf.shape[1:]
+        )
+
+    return jax.tree.map(place, x)
 
 
 def put_replicated(plan: MeshPlan, tree: Any) -> Any:
-    """Replicate a pytree (dense params, opt state) on every device."""
+    """Replicate a pytree (dense params, opt state) on every device.
+
+    Multi-host: every process must pass the same values (they are placed
+    as fully-replicated global arrays)."""
     return jax.device_put(tree, plan.replicated)
+
+
+def local_slice(plan: MeshPlan, x: jax.Array) -> np.ndarray:
+    """This process's addressable row block of an axis-0-sharded array —
+    the inverse of ``put_sharded``'s local form. Single-process: the whole
+    array."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    shards = sorted(
+        x.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)

@@ -66,7 +66,7 @@ def init_sharded_train_state(
         return TrainState(
             table=put_sharded(plan, table),
             params=put_replicated(plan, params),
-            opt_state=jax.device_put(opt_state, plan.batch_sharding),
+            opt_state=put_sharded(plan, opt_state),
             auc=put_sharded(plan, auc),
             step=put_replicated(plan, jnp.zeros((), jnp.int32)),
         )
@@ -81,8 +81,8 @@ def init_sharded_train_state(
             ),
             tree,
         )
-        params_p = jax.device_put(stack(params), plan.batch_sharding)
-        opt_p = jax.device_put(stack(opt_state), plan.batch_sharding)
+        params_p = put_sharded(plan, stack(params))
+        opt_p = put_sharded(plan, stack(opt_state))
     else:
         params_p = put_replicated(plan, params)
         opt_p = put_replicated(plan, opt_state)

@@ -30,7 +30,7 @@ from paddlebox_tpu.data.device_pack import BatchPacker, pack_batch, pack_batch_s
 from paddlebox_tpu.data.pipeline import prefetch
 from paddlebox_tpu.metrics.auc import auc_compute, auc_init
 from paddlebox_tpu.metrics.registry import MetricRegistry
-from paddlebox_tpu.parallel.mesh import MeshPlan
+from paddlebox_tpu.parallel.mesh import MeshPlan, local_slice, put_sharded
 from paddlebox_tpu.train.sharded_step import (
     init_sharded_train_state,
     kstep_sync_params,
@@ -225,6 +225,21 @@ class CTRTrainer:
             local_dense=self.cfg.dense_sync_mode == "kstep",
         )
 
+    @property
+    def _n_pack_devices(self) -> int:
+        """Devices THIS process packs batches for: all of them single-host,
+        the local block of the global mesh multi-host."""
+        return self.plan.n_devices // jax.process_count()
+
+    def _host_np(self, x) -> np.ndarray:
+        """Device array -> host numpy, gathering non-addressable shards
+        across processes when the mesh spans hosts."""
+        if getattr(x, "is_fully_addressable", True):
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
     def _pack_and_put(self, batch, ws):
         if self.plan is None:
             db = pack_batch(
@@ -240,14 +255,12 @@ class CTRTrainer:
             batch,
             ws,
             self._schema,
-            self.plan.n_devices,
+            self._n_pack_devices,
             dense_slot=self.dense_slot,
             dense_dim=self.dense_dim,
             bucket=self.pack_bucket,
         )
-        return {
-            k: jax.device_put(v, self.plan.batch_sharding) for k, v in db.as_dict().items()
-        }
+        return {k: put_sharded(self.plan, v) for k, v in db.as_dict().items()}
 
     def _feed_aux(
         self, feed, batch=None, ins_weight=None, cmatch=None, rank=None, ins_ids=None
@@ -317,7 +330,8 @@ class CTRTrainer:
         # full batch partition (U_pad/K self-stabilize with headroom)
         packer.freeze_shapes(
             dataset.batch_indices(n_batches),
-            n_devices=self.plan.n_devices if self.plan is not None else 0,
+            n_devices=self._n_pack_devices if self.plan is not None else 0,
+            transport=dataset.transport,
         )
         has_meta = store.ins_id_off is not None
 
@@ -330,10 +344,9 @@ class CTRTrainer:
                     k: jax.device_put(v) for k, v in db.as_dict().items()
                 }
             else:
-                db = packer.pack_sharded(idx, self.plan.n_devices)
+                db = packer.pack_sharded(idx, self._n_pack_devices)
                 feed = {
-                    k: jax.device_put(v, self.plan.batch_sharding)
-                    for k, v in db.as_dict().items()
+                    k: put_sharded(self.plan, v) for k, v in db.as_dict().items()
                 }
             # ins_id string extraction belongs in the overlapped worker, not
             # between device steps
@@ -389,8 +402,26 @@ class CTRTrainer:
         # within one pass (warmup epochs, join/update phases, sequential
         # slot-shuffle evals); snapshot them so THIS call's metrics are a
         # bucket delta, not the running total
-        auc_pos0 = np.asarray(state.auc.pos).copy()
-        auc_neg0 = np.asarray(state.auc.neg).copy()
+        auc_pos0 = self._host_np(state.auc.pos).copy()
+        auc_neg0 = self._host_np(state.auc.neg).copy()
+        if self.plan is not None and jax.process_count() > 1:
+            if dataset.store is None:
+                raise RuntimeError(
+                    "multi-host mesh training needs the columnar-store fast "
+                    "path (its pad shapes are transport-locksteped); enable "
+                    "the native parser so dataset.store is built"
+                )
+            tp = dataset.transport
+            if tp is not None and tp.rank != jax.process_index():
+                # row placement puts process i's block at shard i while the
+                # working set assigns ownership by transport rank — if the
+                # two disagree, every pull silently reads the wrong host's
+                # slice
+                raise RuntimeError(
+                    f"transport rank {tp.rank} != jax process index "
+                    f"{jax.process_index()} — order the transport endpoint "
+                    "list by jax process id"
+                )
         for i, (feed, aux) in enumerate(iterator):
             if is_async:  # PullDense / PushDense worker loop (B6)
                 state = state._replace(
@@ -444,12 +475,12 @@ class CTRTrainer:
                 dump_param(self.dump_pool, name, np.asarray(leaf))
         from paddlebox_tpu.metrics.auc import AucState
 
-        delta = AucState(
-            pos=np.asarray(state.auc.pos) - auc_pos0,
-            neg=np.asarray(state.auc.neg) - auc_neg0,
+        cum = AucState(
+            pos=self._host_np(state.auc.pos), neg=self._host_np(state.auc.neg)
         )
+        delta = AucState(pos=cum.pos - auc_pos0, neg=cum.neg - auc_neg0)
         out = auc_compute(delta)
-        out["auc_cumulative"] = auc_compute(state.auc)["auc"]
+        out["auc_cumulative"] = auc_compute(cum)["auc"]
         out["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
         out["batches"] = float(len(losses))
         return out
@@ -486,6 +517,12 @@ class CTRTrainer:
         )
 
     def trained_table(self) -> np.ndarray:
+        """The pass's trained table for writeback: the full array
+        single-host, THIS host's shard block on a multi-process mesh
+        (exactly what DistributedWorkingSet.writeback consumes — trained
+        rows never cross hosts, EndPass parity box_wrapper.cc:627)."""
         if self._state is None:
             raise RuntimeError("no trained pass")
+        if self.plan is not None and jax.process_count() > 1:
+            return local_slice(self.plan, self._state.table)
         return np.asarray(self._state.table)

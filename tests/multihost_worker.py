@@ -1,0 +1,133 @@
+"""Worker process for the 2-host localhost tests (test_multihost.py).
+
+One real process per host, the reference's own test pattern
+(test_dist_fleet_base.py:158-260): host plane over TcpTransport
+(TcpShuffleRouter global shuffle, DistributedWorkingSet key exchange,
+lockstep batch counts), device plane over a REAL cross-process jax mesh
+(jax.distributed + gloo CPU collectives) running the sharded train step.
+
+Modes:
+  train  — striped files, no shuffle, 1 trained pass on the global mesh;
+           dumps layout/table/metrics for equality vs the 1-process run.
+  shuffle — unequal record counts + ins_id global shuffle + lockstep
+           wraparound pass on the global mesh; dumps shuffle accounting.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    mode, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    rank = int(rank_s)
+    with open(os.path.join(workdir, "conf.json")) as f:
+        conf = json.load(f)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{conf['coord_port']}",
+        num_processes=2,
+        process_id=rank,
+    )
+    import numpy as np
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.parallel.transport import TcpTransport, TcpShuffleRouter
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+
+    NS = conf["num_slots"]
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+        parse_ins_id=conf["parse_ins_id"],
+    )
+    layout = ValueLayout(embedx_dim=conf["embedx_dim"])
+    opt_cfg = SparseOptimizerConfig(
+        embed_lr=0.2, embedx_lr=0.2, embedx_threshold=0.0, initial_range=0.01
+    )
+    table = HostSparseTable(layout, opt_cfg, n_shards=4, seed=0)
+
+    eps = [f"127.0.0.1:{p}" for p in conf["tp_ports"]]
+    transport = TcpTransport(rank, eps, timeout=60.0)
+    router = TcpShuffleRouter(transport)
+
+    n_global_dev = 4  # 2 hosts x 2 local CPU devices
+    plan = make_mesh(n_global_dev)
+    assert len(jax.local_devices()) == 2 and jax.process_count() == 2
+
+    shuffle_mode = "ins_id" if mode == "shuffle" else "none"
+    ds = BoxPSDataset(
+        schema,
+        table,
+        batch_size=conf["local_batch"],
+        n_mesh_shards=n_global_dev,
+        rank=rank,
+        nranks=2,
+        shuffle_mode=shuffle_mode,
+        router=router,
+        transport=transport,
+        seed=0,
+    )
+    ds.set_filelist(conf["files"])  # striped rank::2 internally
+    ds.set_date("20260101")
+
+    model = DeepFM(
+        num_slots=NS, feat_width=layout.pull_width,
+        embedx_dim=conf["embedx_dim"], hidden=(16,),
+    )
+    cfg = TrainStepConfig(
+        num_slots=NS,
+        batch_size=conf["local_batch"] // 2,  # per device
+        layout=layout,
+        sparse_opt=opt_cfg,
+        auc_buckets=1000,
+        axis_name=plan.axis,
+    )
+    trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan)
+    trainer.init_params(jax.random.PRNGKey(0))
+
+    ds.load_into_memory()
+    n_local_records = ds.memory_data_size()
+    nb = ds.num_batches()
+    ds.begin_pass(round_to=conf["round_to"])
+    out = trainer.train_pass(ds)
+    local_table = trainer.trained_table()  # this host's shard block
+    dws = ds.ws
+    layout_dump = dict(
+        sorted_keys=dws.sorted_keys,
+        rows=dws.row_of_sorted,
+        capacity=np.array([dws.capacity]),
+        local_table=local_table,
+        n_records=np.array([n_local_records]),
+        num_batches=np.array([nb]),
+        batches_run=np.array([out["batches"]]),
+        auc=np.array([out["auc"]]),
+        loss=np.array([out["loss"]]),
+    )
+    if conf["parse_ins_id"]:
+        ins = sorted(r.ins_id for r in ds.records)
+        layout_dump["ins_ids"] = np.array(ins)
+    ds.end_pass(local_table, shrink=False)
+
+    # host table after writeback: this host's owned keys only
+    keys = np.sort(table.keys())
+    layout_dump["host_keys"] = keys
+    layout_dump["host_vals"] = table.pull_or_create(keys)
+    np.savez(os.path.join(workdir, f"rank{rank}.npz"), **layout_dump)
+    print(f"rank {rank}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()
