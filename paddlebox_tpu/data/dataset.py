@@ -282,12 +282,14 @@ class BoxPSDataset:
             self.pvs = []
             self._pv_merged = False
 
-    def pv_batches(self, n_batches: Optional[int] = None):
+    def pv_batches(self, n_batches: Optional[int] = None, n_devices: int = 1):
         """Join-phase batches: (SlotBatch with rank_offset, ins_weight).
 
         Whole pvs pack into ``batch_size`` instance slots, ghost-padded
         (see data/pv_instance.py). SlotBatch.rank_offset is set; ins_weight
-        masks ghosts out of loss/metrics/show-clk.
+        masks ghosts out of loss/metrics/show-clk. With ``n_devices > 1``
+        the batch is device-blocked (no pv crosses a device, rank_offset
+        rows device-local) for the mesh join step.
         """
         if not getattr(self, "_pv_merged", False):
             raise RuntimeError("preprocess_instance first")
@@ -296,6 +298,7 @@ class BoxPSDataset:
             self.batch_size,
             max_rank=self._pv_max_rank,
             valid_cmatch=self._pv_valid_cmatch,
+            n_devices=n_devices,
         )
         if n_batches is not None:
             packed = itertools.islice(packed, n_batches)

@@ -211,6 +211,8 @@ def pack_batch_sharded(
     dense_dim: int = 0,
     label_slot: Optional[str] = None,
     bucket: Optional[int] = None,
+    k_floor: int = 0,
+    l_floor: int = 0,
 ) -> ShardedDeviceBatch:
     """Split a global batch across the mesh and bucket keys by owner shard.
 
@@ -240,6 +242,8 @@ def pack_batch_sharded(
         labels,
         dense,
         dense_dim,
+        k_floor=k_floor,
+        l_floor=l_floor,
     )
 
 
@@ -373,10 +377,10 @@ class BatchPacker:
         data_set.cc:2069-2135) — without inflating the all_to_all payload
         beyond what the pass actually needs."""
         lockstep = transport is not None and transport.n_ranks > 1
-        batches = [np.asarray(idx) for idx in batch_indices]
         max_L = 1
         max_bucket = 0
-        for idx in batches:
+        for idx in batch_indices:
+            idx = np.asarray(idx)
             counts = self._key_counts[idx]
             if n_devices:
                 per_dev = counts.reshape(n_devices, -1).sum(axis=1)

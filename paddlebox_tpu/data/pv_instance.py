@@ -109,36 +109,66 @@ def pack_pv_batches(
     max_rank: int = 3,
     valid_cmatch: Sequence[int] = DEFAULT_VALID_CMATCH,
     drop_remainder: bool = False,
+    n_devices: int = 1,
 ) -> Iterator[Tuple[List[SlotRecord], np.ndarray, np.ndarray]]:
     """Yield (records, rank_offset, ins_weight) join-phase batches.
 
     Whole pvs pack greedily into ``batch_size`` instance slots; the tail pads
     with weight-0 ghost copies of the last real ad so every batch has the
-    same static shape. A pv with more ads than ``batch_size`` is rejected.
+    same static shape. A pv with more ads than a block is rejected.
+
+    With ``n_devices > 1`` the batch is packed as ``n_devices`` blocks of
+    ``batch_size / n_devices`` slots, NO pv crossing a block boundary, and
+    rank_offset peer rows are DEVICE-LOCAL (0..b-1 within each block) — the
+    shape the mesh join step's per-device rank_attention gathers over. The
+    records stream out device-major, matching the sharded packer's
+    ins -> device mapping (ins // b).
     """
-    cur: List[PvInstance] = []
-    cur_ins = 0
+    if batch_size % n_devices:
+        raise ValueError(f"batch {batch_size} not divisible by {n_devices} devices")
+    b = batch_size // n_devices
 
-    def emit(group: List[PvInstance]):
-        records = flatten_pv_instances(group)
-        n_real = len(records)
+    def emit(blocks: List[List[PvInstance]]):
+        while len(blocks) < n_devices:  # tail: some devices all-ghost
+            blocks.append([])
+        records: List[SlotRecord] = []
         weight = np.zeros(batch_size, dtype=np.float32)
-        weight[:n_real] = 1.0
-        while len(records) < batch_size:  # ghost-pad
-            records.append(records[-1])
-        ro = build_rank_offset(group, batch_size, max_rank, valid_cmatch)
-        return records, ro, weight
+        ros = []
+        for d, group in enumerate(blocks):
+            recs = flatten_pv_instances(group)
+            n_real = len(recs)
+            weight[d * b : d * b + n_real] = 1.0
+            ghost = recs[-1] if recs else _GHOST_FALLBACK(blocks)
+            while len(recs) < b:  # ghost-pad the block
+                recs.append(ghost)
+            records.extend(recs)
+            ros.append(build_rank_offset(group, b, max_rank, valid_cmatch))
+        return records, np.concatenate(ros, axis=0), weight
 
+    def _GHOST_FALLBACK(blocks):
+        for g in blocks:
+            for pv in g:
+                if pv.ads:
+                    return pv.ads[0]
+        raise ValueError("cannot ghost-pad an entirely empty pv batch")
+
+    blocks: List[List[PvInstance]] = [[]]
+    cur_ins = 0
     for pv in pvs:
         n = len(pv.ads)
-        if n > batch_size:
+        if n > b:
             raise ValueError(
-                f"pv with {n} ads exceeds join batch size {batch_size}"
+                f"pv with {n} ads exceeds join block size {b} "
+                f"({batch_size} instances / {n_devices} devices)"
             )
-        if cur_ins + n > batch_size:
-            yield emit(cur)
-            cur, cur_ins = [], 0
-        cur.append(pv)
+        if cur_ins + n > b:
+            if len(blocks) == n_devices:
+                yield emit(blocks)
+                blocks = [[]]
+            else:
+                blocks.append([])
+            cur_ins = 0
+        blocks[-1].append(pv)
         cur_ins += n
-    if cur and not drop_remainder:
-        yield emit(cur)
+    if any(g for g in blocks) and not drop_remainder:
+        yield emit(blocks)

@@ -251,6 +251,12 @@ class CTRTrainer:
                 bucket=self.pack_bucket,
             )
             return {k: jnp.asarray(v) for k, v in db.as_dict().items()}
+        # sticky pad floors per working set: K/L only ever grow, so the
+        # sharded step keeps ONE compiled program across a pass's batches
+        # (the slow/pv analog of BatchPacker's frozen shapes)
+        if getattr(self, "_pads_ws", None) is not ws:
+            self._pads_ws = ws
+            self._pads = [-1, 0]  # [k_floor (-1 = headroom), l_floor]
         db = pack_batch_sharded(
             batch,
             ws,
@@ -259,7 +265,10 @@ class CTRTrainer:
             dense_slot=self.dense_slot,
             dense_dim=self.dense_dim,
             bucket=self.pack_bucket,
+            k_floor=self._pads[0],
+            l_floor=self._pads[1],
         )
+        self._pads = [db.req_ranks.shape[2], db.inverse.shape[1]]
         return {k: put_sharded(self.plan, v) for k, v in db.as_dict().items()}
 
     def _feed_aux(
@@ -282,12 +291,25 @@ class CTRTrainer:
         return feed, aux
 
     def _pv_feed_iter(self, dataset, n_batches):
-        for batch, ins_weight in dataset.pv_batches(n_batches):
+        n_dev = 1 if self.plan is None else self._n_pack_devices
+        for batch, ins_weight in dataset.pv_batches(n_batches, n_devices=n_dev):
             feed = self._pack_and_put(batch, dataset.ws)
-            if ins_weight is not None:
-                feed["ins_weight"] = jnp.asarray(ins_weight)
-            if batch.rank_offset is not None:
-                feed["rank_offset"] = jnp.asarray(batch.rank_offset)
+            if self.plan is None:
+                if ins_weight is not None:
+                    feed["ins_weight"] = jnp.asarray(ins_weight)
+                if batch.rank_offset is not None:
+                    feed["rank_offset"] = jnp.asarray(batch.rank_offset)
+            else:
+                # device-blocked pv batch: per-device leading axis, rank
+                # offsets already device-local (pv_instance.pack_pv_batches)
+                b = batch.batch_size // n_dev
+                feed["ins_weight"] = put_sharded(
+                    self.plan, ins_weight.reshape(n_dev, b)
+                )
+                ro = batch.rank_offset
+                feed["rank_offset"] = put_sharded(
+                    self.plan, ro.reshape(n_dev, b, ro.shape[-1])
+                )
             yield self._feed_aux(feed, batch=batch, ins_weight=ins_weight)
 
     def _slow_feed_iter(self, dataset, n_batches):
@@ -385,10 +407,11 @@ class CTRTrainer:
         # data_feed.cc:2165-2198)
         use_pv = dataset.pv_merged and dataset.current_phase == 1
         if use_pv:
-            if self.plan is not None:
+            if self.plan is not None and jax.process_count() > 1:
                 raise NotImplementedError(
-                    "join-phase pv batches are single-device for now; shard "
-                    "the update phase or run join on one chip"
+                    "join-phase pv batches are not transport-locksteped "
+                    "across hosts yet (local pv counts/pads would desync "
+                    "the mesh); run the join phase on a single-host mesh"
                 )
             iterator = self._pv_feed_iter(dataset, n_batches)
         elif dataset.store is not None:

@@ -219,3 +219,189 @@ def test_rank_attention_changes_join_logits(tmp_path):
     without = model.apply(params, feats, None, None)
     assert abs(float(with_ro[0] - without[0])) > 1e-3
     assert abs(float(with_ro[3] - without[3])) < 1e-6  # rankless row unchanged
+
+
+def test_pack_pv_batches_device_blocked():
+    """n_devices > 1: whole pvs stay inside one device block, rank_offset
+    peer rows are device-local, tail batches pad every block."""
+    recs = []
+    for q in range(1, 8):
+        for r in range(1, (q % 3) + 2):
+            recs.append(_rec(q, 222, r, [q * 10 + r], 0))
+    pvs = merge_pv_instances(recs)
+    batches = list(pack_pv_batches(pvs, batch_size=8, n_devices=2))
+    b = 4
+    for recs_out, ro, w in batches:
+        assert len(recs_out) == 8 and ro.shape == (8, 7) and w.shape == (8,)
+        for d in range(2):
+            block = recs_out[d * b : (d + 1) * b]
+            blk_w = w[d * b : (d + 1) * b]
+            # no pv split across blocks: every real record's search_id
+            # appears only within this block
+            sids = {r.search_id for r, wt in zip(block, blk_w) if wt > 0}
+            for other in range(2):
+                if other == d:
+                    continue
+                oblock = recs_out[other * b : (other + 1) * b]
+                ow = w[other * b : (other + 1) * b]
+                assert not sids & {
+                    r.search_id for r, wt in zip(oblock, ow) if wt > 0
+                }
+            # rank_offset peer rows are LOCAL to the block
+            peers = ro[d * b : (d + 1) * b, 2::2]
+            assert peers.max() < b
+
+
+def test_mesh_join_matches_single_device(tmp_path):
+    """The sharded join step over device-blocked pv batches computes the
+    same training as the single-device step fed identical batches (with
+    rank_offset globalized for the flat layout)."""
+    from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
+    from paddlebox_tpu.data.slot_record import build_batch
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.table import PassWorkingSet
+    from paddlebox_tpu.train.train_step import (
+        init_train_state,
+        jit_train_step,
+        make_train_step,
+    )
+    from paddlebox_tpu.train.sharded_step import (
+        init_sharded_train_state,
+        make_sharded_train_step,
+    )
+
+    rng = np.random.default_rng(1)
+    n_slots, N_DEV, B = 3, 4, 16
+    b = B // N_DEV
+    layout = ValueLayout(embedx_dim=4)
+    opt = SparseOptimizerConfig(embedx_threshold=0.0, initial_range=0.01)
+    recs = []
+    for q in range(1, 40):
+        for r in range(1, int(rng.integers(1, 4)) + 1):
+            keys = rng.integers(1, 150, n_slots)
+            recs.append(_rec(q, 222, r, keys, float(keys[0] % 2)))
+    pvs = merge_pv_instances(recs)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(n_slots)],
+        label_slot="label",
+    )
+    model = RankDeepFM(n_slots, layout.pull_width, layout.embedx_dim)
+
+    def run(mesh):
+        table = HostSparseTable(layout, opt, n_shards=4, seed=0)
+        ws = PassWorkingSet(n_mesh_shards=N_DEV if mesh else 1)
+        for r in recs:
+            ws.add_keys(r.u64_values)
+        dev_table = ws.finalize(table, round_to=32)
+        cfg = TrainStepConfig(
+            num_slots=n_slots, batch_size=b if mesh else B, layout=layout,
+            sparse_opt=opt, auc_buckets=500, model_takes_rank_offset=True,
+            axis_name="dp" if mesh else None,
+        )
+        import jax.numpy as jnp
+
+        if mesh:
+            plan = make_mesh(N_DEV)
+            step = make_sharded_train_step(model.apply, optax.adam(1e-2), cfg, plan)
+            state = init_sharded_train_state(
+                plan, dev_table, model.init(jax.random.PRNGKey(0)),
+                optax.adam(1e-2), 500,
+            )
+        else:
+            step = jit_train_step(make_train_step(model.apply, optax.adam(1e-2), cfg))
+            state = init_train_state(
+                jnp.asarray(dev_table.reshape(-1, layout.width)),
+                model.init(jax.random.PRNGKey(0)), optax.adam(1e-2), 500,
+            )
+        losses = []
+        # BOTH runs use the device-blocked packing so batches are identical
+        for recs_b, ro, w in pack_pv_batches(pvs, B, n_devices=N_DEV):
+            batch = build_batch(recs_b, schema)
+            if mesh:
+                db = pack_batch_sharded(batch, ws, schema, N_DEV, bucket=32)
+                feed = {
+                    k: jax.device_put(v, plan.batch_sharding)
+                    for k, v in db.as_dict().items()
+                }
+                feed["ins_weight"] = jax.device_put(
+                    w.reshape(N_DEV, b), plan.batch_sharding
+                )
+                feed["rank_offset"] = jax.device_put(
+                    np.ascontiguousarray(ro.reshape(N_DEV, b, -1)),
+                    plan.batch_sharding,
+                )
+            else:
+                # globalize the device-local peer rows for the flat layout
+                ro_g = ro.copy()
+                for d in range(N_DEV):
+                    blk = ro_g[d * b : (d + 1) * b, 2::2]
+                    blk[blk >= 0] += d * b
+                db = pack_batch(batch, ws, schema, bucket=128)
+                feed = {k: jnp.asarray(v) for k, v in db.as_dict().items()}
+                feed["ins_weight"] = jnp.asarray(w)
+                feed["rank_offset"] = jnp.asarray(ro_g)
+            state, m = step(state, feed)
+            losses.append(float(m["loss"]))
+        tbl = np.asarray(state.table).reshape(-1, layout.width)
+        keys = ws.sorted_keys
+        return losses, tbl[ws.lookup(keys)], keys
+
+    losses1, t1, k1 = run(mesh=False)
+    lossesN, tN, kN = run(mesh=True)
+    np.testing.assert_allclose(losses1[0], lossesN[0], rtol=1e-5)
+    np.testing.assert_allclose(losses1, lossesN, rtol=6e-3)
+    # same keys, same trained values (row layouts differ 1- vs 4-shard)
+    np.testing.assert_array_equal(k1, kN)
+    np.testing.assert_allclose(t1, tN, rtol=2e-3, atol=1e-3)
+
+
+def test_two_phase_join_update_on_mesh(tmp_path):
+    """The full join(pv) -> update sequence through CTRTrainer on a
+    4-device mesh (the config trainer.py:329-333 used to reject)."""
+    from paddlebox_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(0)
+    n_slots, N_DEV = 3, 4
+    path = str(tmp_path / "pv.txt")
+    _write_pv_file(path, rng, n_queries=60, n_slots=n_slots)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(n_slots)],
+        label_slot="label",
+        parse_logkey=True,
+    )
+    layout = ValueLayout(embedx_dim=4)
+    opt = SparseOptimizerConfig(embedx_threshold=0.0)
+    table = HostSparseTable(layout, opt)
+    ds = BoxPSDataset(schema, table, batch_size=16, n_mesh_shards=N_DEV)
+    ds.set_date("20260729")
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+
+    plan = make_mesh(N_DEV)
+    model = RankDeepFM(n_slots, layout.pull_width, layout.embedx_dim)
+    cfg = TrainStepConfig(
+        num_slots=n_slots, batch_size=4, layout=layout, sparse_opt=opt,
+        auc_buckets=1000, model_takes_rank_offset=True, axis_name=plan.axis,
+    )
+    trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan)
+
+    ds.set_current_phase(1)
+    assert ds.preprocess_instance() == 60
+    m_join = trainer.train_pass(ds)
+    assert np.isfinite(m_join["loss"]) and m_join["batches"] > 0
+    assert m_join["ins_num"] == ds.memory_data_size()  # ghosts masked
+
+    ds.set_current_phase(0)
+    ds.postprocess_instance()
+    m_upd = trainer.train_pass(ds)
+    assert np.isfinite(m_upd["loss"])
+    out = ds.end_pass(trainer.trained_table())
+    assert out["dropped"] >= 0
+    # join-phase training actually landed in the host table
+    got = table.pull_or_create(np.unique(np.concatenate(
+        [r.u64_values for r in ds.records] if ds.records else [np.zeros(0, np.uint64)]
+    )))
+    assert np.all(got[:, layout.SHOW] > 0)
