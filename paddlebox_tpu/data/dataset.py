@@ -366,16 +366,7 @@ class BoxPSDataset:
         self._stats_lock = threading.Lock()
         stats = PassStats(files=len(self._filelist))
         self._loading_stats = stats
-        if self.transport is not None and self.transport.n_ranks > 1:
-            # multi-host: host-sharded table ownership + key exchange;
-            # n_mesh_shards is the GLOBAL mesh shard count
-            from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
-
-            ws = DistributedWorkingSet(
-                self.transport, self.n_mesh_shards, pass_id=self.pass_id
-            )
-        else:
-            ws = PassWorkingSet(n_mesh_shards=self.n_mesh_shards)
+        ws = self._new_working_set()
         parts: list = []
         if self._filelist:
             with ThreadPoolExecutor(max_workers=self.read_threads) as pool:
@@ -403,6 +394,21 @@ class BoxPSDataset:
             # memory_data_size()/stats match reference post-load semantics
             # (begin_pass still consumes the staged tuple)
             self._publish(self._staged)
+
+    def _new_working_set(self):
+        """Fresh (un-finalized) working set for this pass: multi-host
+        key-exchange flavor when a transport spans ranks, else local.
+        Shared by the load path and revert_pass so their retrains can never
+        diverge."""
+        if self.transport is not None and self.transport.n_ranks > 1:
+            # multi-host: host-sharded table ownership + key exchange;
+            # n_mesh_shards is the GLOBAL mesh shard count
+            from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+
+            return DistributedWorkingSet(
+                self.transport, self.n_mesh_shards, pass_id=self.pass_id
+            )
+        return PassWorkingSet(n_mesh_shards=self.n_mesh_shards)
 
     def _publish(self, staged) -> None:
         store, order, records, ws, stats = staged
@@ -563,10 +569,21 @@ class BoxPSDataset:
 
     # ---- pass lifecycle --------------------------------------------------
 
-    def begin_pass(self, round_to: int = 512) -> np.ndarray:
+    def begin_pass(
+        self,
+        round_to: int = 512,
+        enable_revert: bool = False,
+        trainer=None,
+    ) -> np.ndarray:
         """Consume the staged load, finalize the working set, build the device
         table (BeginFeedPass+EndFeedPass+BeginPass collapse: on TPU the HBM
-        staging IS the finalize, box_wrapper.cc:580-626)."""
+        staging IS the finalize, box_wrapper.cc:580-626).
+
+        ``enable_revert=True`` arms a PassGuard (Confirm/Revert parity,
+        fleet_wrapper.h:319-321): the pass keys' pre-train rows (and, with
+        ``trainer``, the dense params/opt state) are snapshotted so
+        ``revert_pass()`` can reject everything this pass publishes;
+        ``end_pass`` confirms."""
         if self._staged is not None:
             if self._in_pass:
                 raise RuntimeError("end_pass the previous pass before begin_pass")
@@ -578,7 +595,39 @@ class BoxPSDataset:
             self.device_table = self.ws.finalize(self.table, round_to=round_to)
         self.stats.keys = self.ws.n_keys
         self._in_pass = True
+        self._guard = None
+        if enable_revert:
+            from paddlebox_tpu.train.rollback import PassGuard
+
+            self._guard = PassGuard(self.table, trainer)
+            self._guard.begin(self.ws.sorted_keys)
         return self.device_table
+
+    def revert_pass(self) -> None:
+        """Reject the current pass (Revert parity, fleet_wrapper.h:319-321,
+        pslib __init__.py:673-690): every pass key's host row returns to its
+        pre-pass value (undoing any partial/complete writeback), the dense
+        side restores, and the in-memory data re-arms so ``begin_pass`` can
+        retrain it from scratch."""
+        guard = getattr(self, "_guard", None)
+        if guard is None or not guard.armed:
+            raise RuntimeError(
+                "no armed rollback — begin_pass(enable_revert=True) first"
+            )
+        guard.revert()
+        self._guard = None
+        # fresh working set over the same in-memory records for the retrain
+        ws = self._new_working_set()
+        if self.store is not None:
+            ws.add_keys(self.store.u64_values)
+            self.store.invalidate_rows()
+        else:
+            for r in self._records:
+                ws.add_keys(r.u64_values)
+        self.ws = ws
+        self.device_table = None
+        self._in_pass = False
+        self._auc_runner = None
 
     def end_pass(
         self,
@@ -603,6 +652,11 @@ class BoxPSDataset:
         # (LoadSSD2Mem inverse; next pass's finalize promotes what it needs)
         if getattr(self.table, "mem_cap_rows", None) is not None:
             self.table.maybe_spill()
+        # the pass is published: drop the rollback snapshot (Confirm parity)
+        guard = getattr(self, "_guard", None)
+        if guard is not None and guard.armed:
+            guard.confirm()
+        self._guard = None
         self.records = []
         self.ws = None
         self.device_table = None

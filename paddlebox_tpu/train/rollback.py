@@ -1,0 +1,76 @@
+"""Confirm/revert pass rollback (FleetWrapper::Confirm/Revert parity).
+
+The reference exposes pass-grained rollback on its PS tables
+(fleet_wrapper.h:319-321; pslib __init__.py:673-690: "confirm the updated
+params" / "revert ... to the previous saved state"): a pass whose output is
+rejected (bad data, poisoned gradients, failed validation) is rolled back
+so the table re-enters the state it had when the pass began.
+
+TPU shape of the same contract: a pass mutates exactly
+- the working set's keys in the host table (end_pass writeback; keys
+  created by finalize get deterministic per-key init values, so restoring
+  their pre-train rows makes retraining bit-reproducible), and
+- the trainer's dense params/optimizer state.
+
+``PassGuard.begin`` snapshots both right after ``begin_pass`` builds the
+working set; ``revert`` pushes the snapshot back (undoing any partial or
+complete writeback) and restores the dense side; ``confirm`` drops the
+snapshot. end_pass's decay/shrink runs AFTER writeback, so the
+begin->revert window covers everything a rejected pass could have
+published; crash-recovery across decay itself is the CheckpointManager's
+(day-level) job, not revert's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+class PassGuard:
+    """Snapshot-at-begin / revert-or-confirm for one training pass."""
+
+    def __init__(self, table, trainer: Optional[Any] = None):
+        self.table = table
+        self.trainer = trainer
+        self._keys: Optional[np.ndarray] = None
+        self._vals: Optional[np.ndarray] = None
+        self._dense: Optional[tuple] = None
+
+    @property
+    def armed(self) -> bool:
+        return self._keys is not None
+
+    def begin(self, pass_keys: np.ndarray) -> None:
+        """Snapshot the pre-train rows of this pass's keys (call right
+        after the working set is finalized) + the trainer's dense state."""
+        self._keys = np.asarray(pass_keys, dtype=np.uint64).copy()
+        self._vals = self.table.pull_or_create(self._keys).copy()
+        if self.trainer is not None and self.trainer.params is not None:
+            leaves, treedef = jax.tree.flatten(
+                (self.trainer.params, self.trainer.opt_state)
+            )
+            self._dense = ([np.asarray(x).copy() for x in leaves], treedef)
+
+    def confirm(self) -> None:
+        """Accept the pass: drop the snapshot (Confirm parity)."""
+        self._keys = self._vals = self._dense = None
+
+    def revert(self) -> None:
+        """Restore every pass key's pre-pass row and the dense state
+        (Revert parity). Safe after zero, partial, or full writeback."""
+        if self._keys is None:
+            raise RuntimeError("no armed snapshot — begin() a pass first")
+        if len(self._keys):
+            self.table.push(self._keys, self._vals)
+        if self._dense is not None and self.trainer is not None:
+            leaves, treedef = self._dense
+            self.trainer.params, self.trainer.opt_state = jax.tree.unflatten(
+                treedef, [np.asarray(x) for x in leaves]
+            )
+            # the device-side state cache is stale now
+            self.trainer._state = None
+            self.trainer._state_ws = None
+        self.confirm()

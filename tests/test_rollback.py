@@ -1,0 +1,136 @@
+"""Confirm/revert pass rollback (FleetWrapper::Confirm/Revert parity,
+fleet_wrapper.h:319-321, pslib __init__.py:673-690).
+
+The done-criterion scenario: a pass dies mid-way (possibly after a partial
+or even full writeback), is reverted, and retraining the same data then
+produces EXACTLY the state a never-interrupted run produces."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+from paddlebox_tpu.train.rollback import PassGuard
+
+LAYOUT = ValueLayout(embedx_dim=4)
+OPT = SparseOptimizerConfig(embedx_threshold=0.0, initial_range=0.01)
+NS, B = 4, 16
+
+
+def _write(tmp_path, n=96):
+    rng = np.random.default_rng(5)
+    path = tmp_path / "d.txt"
+    with open(path, "w") as f:
+        for _ in range(n):
+            keys = rng.integers(1, 400, NS)
+            f.write(
+                f"1 {int(keys[0]) % 2}.0 "
+                + " ".join(f"1 {k}" for k in keys) + "\n"
+            )
+    return str(path)
+
+
+def _build(path):
+    table = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    ds = BoxPSDataset(schema, table, batch_size=B, seed=0)
+    ds.set_filelist([path])
+    model = DeepFM(num_slots=NS, feat_width=LAYOUT.pull_width,
+                   embedx_dim=4, hidden=(8,))
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=B, layout=LAYOUT, sparse_opt=OPT, auc_buckets=500
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    return table, ds, tr
+
+
+def _full_pass(ds, tr):
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64)
+    tr.train_pass(ds)
+    ds.end_pass(tr.trained_table(), shrink=False)
+
+
+def test_kill_mid_pass_revert_retrain_equals_never_started(tmp_path):
+    path = _write(tmp_path)
+
+    # reference run: one clean uninterrupted pass
+    table_ref, ds_ref, tr_ref = _build(path)
+    _full_pass(ds_ref, tr_ref)
+    keys_ref = np.sort(table_ref.keys())
+    vals_ref = table_ref.pull_or_create(keys_ref)
+
+    # interrupted run: train half the pass, partially write back (the worst
+    # crash window), revert, then retrain from scratch
+    table, ds, tr = _build(path)
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64, enable_revert=True, trainer=tr)
+    pre_keys = ds.ws.sorted_keys.copy()
+    pre_vals = table.pull_or_create(pre_keys).copy()
+    tr.train_pass(ds, n_batches=3)
+    ds.ws.writeback(tr.trained_table())  # partial pass PUBLISHED, then dies
+
+    assert not np.allclose(table.pull_or_create(pre_keys), pre_vals)
+    ds.revert_pass()
+    np.testing.assert_array_equal(table.pull_or_create(pre_keys), pre_vals)
+
+    # trainer dense side restored to init: retrain == never-started
+    tr._packer_cache = None
+    ds.begin_pass(round_to=64)
+    tr.train_pass(ds)
+    ds.end_pass(tr.trained_table(), shrink=False)
+    keys = np.sort(table.keys())
+    np.testing.assert_array_equal(keys, keys_ref)
+    np.testing.assert_allclose(
+        table.pull_or_create(keys), vals_ref, rtol=1e-6, atol=1e-7
+    )
+    for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(tr_ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_end_pass_confirms_and_revert_requires_arming(tmp_path):
+    path = _write(tmp_path, n=32)
+    table, ds, tr = _build(path)
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64, enable_revert=True, trainer=tr)
+    tr.train_pass(ds)
+    ds.end_pass(tr.trained_table(), shrink=False)
+    # confirmed at end_pass: nothing left to revert
+    with pytest.raises(RuntimeError, match="revert"):
+        ds.revert_pass()
+    # and without arming, revert is rejected up front
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64)
+    with pytest.raises(RuntimeError, match="enable_revert"):
+        ds.revert_pass()
+
+
+def test_pass_guard_standalone_surface():
+    """Confirm/Revert as a bare table-level API (no dataset)."""
+    table = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    keys = np.arange(1, 50, dtype=np.uint64)
+    base = table.pull_or_create(keys).copy()
+    guard = PassGuard(table)
+    guard.begin(keys)
+    table.push(keys, base + 7.0)
+    guard.revert()
+    np.testing.assert_array_equal(table.pull_or_create(keys), base)
+    guard.begin(keys)
+    table.push(keys, base + 3.0)
+    guard.confirm()
+    with pytest.raises(RuntimeError):
+        guard.revert()
+    np.testing.assert_allclose(table.pull_or_create(keys), base + 3.0)
