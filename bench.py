@@ -112,6 +112,7 @@ def fail_fast(reason: str) -> None:
 
 
 def main():
+    profile = "--profile" in sys.argv
     timeout_s = float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "180"))
     info, err = probe_backend(timeout_s)
     tpu_error = None
@@ -186,7 +187,7 @@ def main():
         trainer.train_pass(ds, n_batches=4)
 
         t0 = time.perf_counter()
-        out = trainer.train_pass(ds, n_batches=TRAIN_BATCHES)
+        out = trainer.train_pass(ds, n_batches=TRAIN_BATCHES, profile=profile)
         train_s = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -195,6 +196,16 @@ def main():
 
     sps = TRAIN_BATCHES * BATCH / train_s
     extra = {} if tpu_error is None else {"tpu_error": tpu_error}
+    if profile:
+        # per-stage attribution (TrainFilesWithProfiler parity) — table to
+        # stderr so stdout stays one JSON line for the driver
+        prof = out.get("profile", {})
+        extra["profile"] = prof
+        print("stage breakdown (s):", file=sys.stderr)
+        for k, v in prof.items():
+            print(f"  {k:18s} {v:8.3f}", file=sys.stderr)
+        for k, v in (("load", load_s), ("finalize", finalize_s), ("train", train_s)):
+            print(f"  {k + '_total':18s} {v:8.3f}", file=sys.stderr)
     print(
         json.dumps(
             {

@@ -29,6 +29,9 @@ class AucState(NamedTuple):
     neg: jnp.ndarray  # int32 [n_buckets] non-click counts
 
 
+AUC_BUCKET_CAP = np.int32(1 << 30)  # saturation ceiling (overflow guard)
+
+
 def auc_init(n_buckets: int = 1_000_000) -> AucState:
     return AucState(
         pos=jnp.zeros((n_buckets,), jnp.int32),
@@ -50,9 +53,12 @@ def auc_update(
         imask = mask.astype(jnp.int32)
     bucket = jnp.clip((preds * n_buckets).astype(jnp.int32), 0, n_buckets - 1)
     ilab = (labels > 0.5).astype(jnp.int32)
+    # saturate at 2^30: a bucket that hot stops counting instead of
+    # wrapping int32 and corrupting every derived metric; auc_compute
+    # reports `saturated` so the condition is visible
     return AucState(
-        pos=state.pos.at[bucket].add(ilab * imask),
-        neg=state.neg.at[bucket].add((1 - ilab) * imask),
+        pos=jnp.minimum(state.pos.at[bucket].add(ilab * imask), AUC_BUCKET_CAP),
+        neg=jnp.minimum(state.neg.at[bucket].add((1 - ilab) * imask), AUC_BUCKET_CAP),
     )
 
 
@@ -65,6 +71,11 @@ def auc_compute(state: AucState) -> Dict[str, float]:
     """Host-side f64 integration (BasicAucCalculator::compute parity)."""
     pos = np.asarray(state.pos, dtype=np.float64)
     neg = np.asarray(state.neg, dtype=np.float64)
+    # saturation check runs BEFORE the device-axis sum: clipping happens
+    # per device slice, so a sum of N healthy slices must not false-alarm
+    saturated = float(
+        np.any(pos >= float(AUC_BUCKET_CAP)) or np.any(neg >= float(AUC_BUCKET_CAP))
+    )
     if pos.ndim > 1:  # device-sharded bucket tables [n_dev, buckets]
         pos = pos.reshape(-1, pos.shape[-1]).sum(axis=0)
         neg = neg.reshape(-1, neg.shape[-1]).sum(axis=0)
@@ -104,4 +115,7 @@ def auc_compute(state: AucState) -> Dict[str, float]:
         "predicted_ctr": pred_sum / safe,
         "copc": float(p) / max(pred_sum, 1e-12),
         "ins_num": count,
+        # any bucket at the saturation cap under-counted: metrics are
+        # approximate from here on (overflow guard, not silent wraparound)
+        "saturated": saturated,
     }

@@ -212,6 +212,18 @@ def make_sharded_train_step(
                     "labels": labels,
                 },
             )
+        finite = None
+        if cfg.check_nan:
+            gsum = loss + jnp.sum(gflat)
+            for leaf in jax.tree.leaves(gparams):
+                gsum = gsum + jnp.sum(leaf)
+            # the table is shared via all_to_all: one poisoned device skips
+            # the batch on EVERY device (check_nan_var_names parity)
+            finite = jax.lax.psum(
+                (~jnp.isfinite(gsum)).astype(jnp.int32), ax
+            ) == 0
+            gflat = jnp.where(finite, gflat, 0.0)  # where: NaN * 0 is NaN
+
         # grad_div rescales local-mean grads to GLOBAL-batch-mean so the
         # owner-side merge matches single-device semantics exactly and the
         # effective sparse LR is independent of mesh size
@@ -225,6 +237,10 @@ def make_sharded_train_step(
             grad_div=grad_div,
             ins_weight=ins_weight,
         )
+        if finite is not None:
+            # where, not multiply: a NaN label rides into clk via segment_sum
+            show_bucket = jnp.where(finite, show_bucket, 0.0)
+            clk_bucket = jnp.where(finite, clk_bucket, 0.0)
 
         new_table = sharded_push(
             table, req_ranks, gbucket, show_bucket, clk_bucket, lay, opt, ax
@@ -270,8 +286,23 @@ def make_sharded_train_step(
             new_params = jax.tree.map(lambda x: x[None], new_params)
             new_opt_state = jax.tree.map(lambda x: x[None], new_opt_state)
 
+        if finite is not None:
+            # skipped batch: dense side stays put (grads were NaN -> the
+            # computed update is garbage; select the pre-step values)
+            new_params = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_params, state.params,
+            )
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_opt_state, state.opt_state,
+            )
+
         local_auc = AucState(pos=state.auc.pos[0], neg=state.auc.neg[0])
         auc_mask = None if ins_weight is None else (ins_weight > 0)
+        if finite is not None:
+            fin_mask = jnp.broadcast_to(finite, labels.shape)
+            auc_mask = fin_mask if auc_mask is None else (auc_mask & fin_mask)
         new_auc = auc_update(local_auc, preds, labels, auc_mask)
         new_auc = AucState(pos=new_auc.pos[None], neg=new_auc.neg[None])
 
@@ -281,6 +312,8 @@ def make_sharded_train_step(
             "preds": preds,
             "labels": labels,
         }
+        if finite is not None:
+            metrics["nan_skipped"] = (~finite).astype(jnp.int32)
         new_state = TrainState(
             table=new_table[None],
             params=new_params,
@@ -304,15 +337,16 @@ def make_sharded_train_step(
     def batch_specs(batch):
         return {k: dp for k in batch}
 
+    metric_specs = {"loss": rep, "step": rep, "preds": dp, "labels": dp}
+    if cfg.check_nan and not eval_mode:
+        metric_specs["nan_skipped"] = rep  # psum'd -> uniform
+
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
         mapped = jax.shard_map(
             local_step,
             mesh=plan.mesh,
             in_specs=(state_specs, batch_specs(batch)),
-            out_specs=(
-                state_specs,
-                {"loss": rep, "step": rep, "preds": dp, "labels": dp},
-            ),
+            out_specs=(state_specs, metric_specs),
             check_vma=False,
         )
         return mapped(state, batch)

@@ -72,6 +72,11 @@ class TrainStepConfig:
     #            metrics for a host AsyncDenseTable (B6) pull/push loop
     dense_sync_mode: str = "step"
     param_sync_step: int = 16  # K for "kstep"
+    # NaN/Inf containment (check_nan_var_names parity,
+    # trainer_desc.proto:43): a batch with a non-finite loss or gradient is
+    # SKIPPED in its entirety — no sparse push, no dense update, no AUC —
+    # instead of silently poisoning the table; metrics report nan_skipped.
+    check_nan: bool = False
 
     def __post_init__(self):
         if self.dense_sync_mode not in ("step", "kstep", "async"):
@@ -249,6 +254,19 @@ def make_train_step(
             ins_weight=ins_weight, rank_offset=rank_offset,
             eval_mode=eval_mode,
         )
+        finite = None
+        if cfg.check_nan and not eval_mode:
+            gsum = loss + jnp.sum(gflat)
+            for leaf in jax.tree.leaves(gparams):
+                gsum = gsum + jnp.sum(leaf)
+            finite = jnp.isfinite(gsum)
+            if cfg.axis_name is not None:
+                # all devices share the table: one bad device skips everywhere
+                finite = (
+                    jax.lax.psum((~finite).astype(jnp.int32), cfg.axis_name) == 0
+                )
+            # where, not multiply: NaN * 0 is still NaN
+            gflat = jnp.where(finite, gflat, 0.0)
         if eval_mode:
             new_table = state.table
             new_params, new_opt_state = state.params, state.opt_state
@@ -263,6 +281,13 @@ def make_train_step(
                 cfg, gflat, segments, inverse, labels, num_segments=U,
                 ins_weight=ins_weight,
             )
+            if finite is not None:
+                # a zeroed push is an exact identity on the table (adagrad
+                # g2 += 0, step 0, show/clk += 0) — the skipped batch never
+                # happened as far as the sparse model is concerned. where,
+                # not multiply: a NaN label rides into clk via segment_sum
+                show_counts = jnp.where(finite, show_counts, 0.0)
+                clk_counts = jnp.where(finite, clk_counts, 0.0)
 
             new_table = push_sparse_rows(
                 state.table, uniq_rows, guniq, show_counts, clk_counts, lay, opt
@@ -282,8 +307,21 @@ def make_train_step(
                     gparams, state.opt_state, state.params
                 )
                 new_params = optax.apply_updates(state.params, updates)
+            if finite is not None:
+                # skipped batch: dense params + optimizer moments stay put
+                new_params = jax.tree.map(
+                    lambda new, old: jnp.where(finite, new, old),
+                    new_params, state.params,
+                )
+                new_opt_state = jax.tree.map(
+                    lambda new, old: jnp.where(finite, new, old),
+                    new_opt_state, state.opt_state,
+                )
 
         auc_mask = None if ins_weight is None else (ins_weight > 0)
+        if finite is not None:
+            fin_mask = jnp.broadcast_to(finite, labels.shape)
+            auc_mask = fin_mask if auc_mask is None else (auc_mask & fin_mask)
         new_auc = auc_update(state.auc, preds, labels, auc_mask)
         # preds/labels ride along for the host-side metric registry
         # (AddAucMonitor parity) — small [B] arrays, no sync forced
@@ -293,6 +331,8 @@ def make_train_step(
             "preds": preds,
             "labels": labels,
         }
+        if finite is not None:
+            metrics["nan_skipped"] = (~finite).astype(jnp.int32)
         if cfg.dense_sync_mode == "async" and not eval_mode:
             metrics["gparams"] = gparams
         return (

@@ -388,12 +388,20 @@ class CTRTrainer:
         dataset: BoxPSDataset,
         n_batches: Optional[int] = None,
         on_batch: Optional[Callable[[int, Dict], None]] = None,
+        profile: bool = False,
     ) -> Dict[str, float]:
         """Train every minibatch of the current pass; returns pass metrics.
 
         Call between dataset.begin_pass() and dataset.end_pass(...). Dense
         params/opt state carry over to the next pass; the trained sparse
         table is available via trained_table() for end_pass writeback.
+
+        ``profile=True`` (TrainFilesWithProfiler parity, boxps_worker.cc:
+        525-620) adds a per-stage wall-clock breakdown under
+        ``out["profile"]``: feed_wait (pack+upload not hidden by overlap),
+        step_dispatch (host->XLA handoff), device_step (synchronous device
+        execution — profiling blocks per batch, so overlap is sacrificed
+        for attribution), host_metrics (registry/dump/callbacks).
         """
         if dataset.device_table is None:
             raise RuntimeError("dataset.begin_pass() first")
@@ -445,25 +453,60 @@ class CTRTrainer:
                     f"{jax.process_index()} — order the transport endpoint "
                     "list by jax process id"
                 )
-        for i, (feed, aux) in enumerate(iterator):
+        from paddlebox_tpu.utils.timer import Timer
+
+        t_feed, t_disp, t_dev, t_host = Timer(), Timer(), Timer(), Timer()
+        skip_flags: list = []
+
+        def timed(it):
+            while True:
+                t_feed.start()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                finally:
+                    t_feed.pause()
+                yield item
+
+        for i, (feed, aux) in enumerate(timed(iter(iterator))):
             if is_async:  # PullDense / PushDense worker loop (B6)
                 state = state._replace(
                     params=jax.device_put(self.async_dense.pull_dense())
                 )
+            t_disp.start()
             state, m = step_fn(state, feed)
-            if is_async:
+            t_disp.pause()
+            if profile:
+                t_dev.start()
+                jax.block_until_ready(m["loss"])
+                t_dev.pause()
+            t_host.start()
+            if "nan_skipped" in m:  # lazy device array: no per-batch sync
+                skip_flags.append(m["nan_skipped"])
+            # containment must extend to every host-side consumer: a skipped
+            # batch's NaN preds/grads reach neither the async dense table
+            # nor the registry/dumps. The int() sync only happens when such
+            # a consumer exists (those paths already sync per batch).
+            skipped_now = 0
+            if "nan_skipped" in m and (
+                is_async or self.metric_registry is not None or self.dump_pool is not None
+            ):
+                skipped_now = int(m["nan_skipped"])
+            if is_async and not skipped_now:
                 self.async_dense.push_dense(jax.tree.map(np.asarray, m["gparams"]))
-            if self.metric_registry is not None:
+            if self.metric_registry is not None and not skipped_now:
                 # per-batch registry feed with phase + logkey-derived vars
                 # (AddAucMonitor parity, boxps_worker.cc:408-418)
                 outputs = dict(m)
                 outputs.update(aux)
                 self.metric_registry.add_all(outputs, phase=dataset.current_phase)
-            if self.dump_pool is not None:
+            if self.dump_pool is not None and not skipped_now:
                 self._dump_batch(i, m, aux)
             if on_batch is not None:
                 on_batch(i, m)
             losses.append(m["loss"])
+            t_host.pause()
         # persist dense side for the next pass; state.table stays for writeback
         if eval_mode:
             # values are bit-identical, but the OLD buffers were donated into
@@ -503,9 +546,28 @@ class CTRTrainer:
         )
         delta = AucState(pos=cum.pos - auc_pos0, neg=cum.neg - auc_neg0)
         out = auc_compute(delta)
-        out["auc_cumulative"] = auc_compute(cum)["auc"]
-        out["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+        cum_out = auc_compute(cum)
+        out["auc_cumulative"] = cum_out["auc"]
+        # saturation is a property of the CUMULATIVE buckets — the delta is
+        # small by construction and would always read unsaturated
+        out["saturated"] = cum_out["saturated"]
+        if losses and skip_flags:
+            lv = jnp.stack(losses)
+            bad = jnp.stack(skip_flags) > 0
+            kept = jnp.maximum(jnp.sum(~bad), 1)
+            out["loss"] = float(jnp.sum(jnp.where(bad, 0.0, lv)) / kept)
+            out["nan_batches"] = float(jnp.sum(bad))
+        else:
+            out["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+            out["nan_batches"] = 0.0
         out["batches"] = float(len(losses))
+        if profile:
+            out["profile"] = {
+                "feed_wait_s": round(t_feed.elapsed_sec(), 4),
+                "step_dispatch_s": round(t_disp.elapsed_sec(), 4),
+                "device_step_s": round(t_dev.elapsed_sec(), 4),
+                "host_metrics_s": round(t_host.elapsed_sec(), 4),
+            }
         return out
 
     def _dump_batch(self, step_i: int, m: Dict, aux: Dict) -> None:

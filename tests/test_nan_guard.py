@@ -1,0 +1,265 @@
+"""NaN/overflow guardrails (check_nan_var_names parity, trainer_desc.proto:43).
+
+A batch whose loss or gradients go non-finite must be contained: no sparse
+push, no dense update, no AUC contribution — the table state after the
+poisoned batch equals the state before it, and training continues.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
+from paddlebox_tpu.data.slot_record import SlotRecord, build_batch
+from paddlebox_tpu.data.slot_schema import SlotInfo, SlotSchema
+from paddlebox_tpu.metrics.auc import AUC_BUCKET_CAP, auc_compute, auc_init, auc_update
+from paddlebox_tpu.models import LogisticRegression
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    PassWorkingSet,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train import TrainStepConfig
+from paddlebox_tpu.train.train_step import (
+    init_train_state,
+    jit_train_step,
+    make_train_step,
+)
+
+NS, B = 3, 8
+LAYOUT = ValueLayout(embedx_dim=4)
+OPT = SparseOptimizerConfig(embedx_threshold=0.0, initial_range=0.01)
+
+
+def _records(rng, n, poison_labels=None):
+    recs = []
+    for i in range(n):
+        keys = rng.integers(1, 100, NS).astype(np.uint64)
+        label = float(keys[0] % 2)
+        if poison_labels is not None and i in poison_labels:
+            label = float("nan")
+        recs.append(
+            SlotRecord(
+                u64_values=keys,
+                u64_offsets=np.arange(NS + 1, dtype=np.uint32),
+                f_values=np.array([label], np.float32),
+                f_offsets=np.array([0, 1], np.uint32),
+            )
+        )
+    return recs
+
+
+def _setup(check_nan, recs):
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    table = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    ws = PassWorkingSet()
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev = ws.finalize(table, round_to=32)
+    model = LogisticRegression(num_slots=NS, feat_width=LAYOUT.pull_width)
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=B, layout=LAYOUT, sparse_opt=OPT,
+        auc_buckets=100, check_nan=check_nan,
+    )
+    step = jit_train_step(make_train_step(model.apply, optax.adam(1e-2), cfg))
+    state = init_train_state(
+        jnp.asarray(dev.reshape(-1, LAYOUT.width)),
+        model.init(jax.random.PRNGKey(0)), optax.adam(1e-2), 100,
+    )
+    return schema, ws, step, state
+
+
+def test_poisoned_batch_contained():
+    rng = np.random.default_rng(0)
+    recs = _records(rng, 3 * B, poison_labels={B + 2})  # batch 1 poisoned
+    schema, ws, step, state = _setup(True, recs)
+
+    for bi in range(3):
+        batch = build_batch(recs[bi * B : (bi + 1) * B], schema)
+        db = pack_batch(batch, ws, schema, bucket=32)
+        before_table = np.asarray(state.table)
+        before_params = [np.asarray(x) for x in jax.tree.leaves(state.params)]
+        before_auc = np.asarray(state.auc.pos).sum() + np.asarray(state.auc.neg).sum()
+        state, m = step(state, {k: jnp.asarray(v) for k, v in db.as_dict().items()})
+        if bi == 1:
+            assert int(m["nan_skipped"]) == 1
+            # full containment: table, dense, AUC all untouched
+            np.testing.assert_array_equal(np.asarray(state.table), before_table)
+            for a, b in zip(jax.tree.leaves(state.params), before_params):
+                np.testing.assert_array_equal(np.asarray(a), b)
+            assert (
+                np.asarray(state.auc.pos).sum() + np.asarray(state.auc.neg).sum()
+                == before_auc
+            )
+        else:
+            assert int(m["nan_skipped"]) == 0
+            assert np.isfinite(float(m["loss"]))
+            assert not np.array_equal(np.asarray(state.table), before_table)
+
+
+def test_without_guard_poison_spreads():
+    """The default (reference-default) path really is unguarded — pins that
+    check_nan=True is what does the containment."""
+    rng = np.random.default_rng(0)
+    recs = _records(rng, 2 * B, poison_labels={2})
+    schema, ws, step, state = _setup(False, recs)
+    batch = build_batch(recs[:B], schema)
+    db = pack_batch(batch, ws, schema, bucket=32)
+    state, m = step(state, {k: jnp.asarray(v) for k, v in db.as_dict().items()})
+    assert "nan_skipped" not in m
+    assert not np.isfinite(np.asarray(state.table)).all()
+
+
+def test_mesh_poisoned_batch_contained():
+    """One poisoned device skips the batch on EVERY device (shared table)."""
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train.sharded_step import (
+        init_sharded_train_state,
+        make_sharded_train_step,
+    )
+
+    N_DEV = 4
+    rng = np.random.default_rng(1)
+    recs = _records(rng, 2 * N_DEV * B, poison_labels={N_DEV * B + 3})
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    table = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    ws = PassWorkingSet(n_mesh_shards=N_DEV)
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev = ws.finalize(table, round_to=32)
+    plan = make_mesh(N_DEV)
+    model = LogisticRegression(num_slots=NS, feat_width=LAYOUT.pull_width)
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=B, layout=LAYOUT, sparse_opt=OPT,
+        auc_buckets=100, check_nan=True, axis_name=plan.axis,
+    )
+    step = make_sharded_train_step(model.apply, optax.adam(1e-2), cfg, plan)
+    state = init_sharded_train_state(
+        plan, dev, model.init(jax.random.PRNGKey(0)), optax.adam(1e-2), 100
+    )
+    GB = N_DEV * B
+    for bi in range(2):
+        batch = build_batch(recs[bi * GB : (bi + 1) * GB], schema)
+        db = pack_batch_sharded(batch, ws, schema, N_DEV, bucket=32)
+        feed = {
+            k: jax.device_put(v, plan.batch_sharding)
+            for k, v in db.as_dict().items()
+        }
+        before = np.asarray(state.table)
+        state, m = step(state, feed)
+        if bi == 1:
+            assert int(m["nan_skipped"]) == 1
+            np.testing.assert_array_equal(np.asarray(state.table), before)
+        else:
+            assert int(m["nan_skipped"]) == 0
+            assert not np.array_equal(np.asarray(state.table), before)
+
+
+def test_trainer_reports_and_continues(tmp_path):
+    """End-to-end: poisoned batch mid-pass -> out['nan_batches']==1, pass
+    loss finite, training still learns."""
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset
+    from paddlebox_tpu.train import CTRTrainer
+
+    rng = np.random.default_rng(0)
+    path = tmp_path / "d.txt"
+    with open(path, "w") as f:
+        for i in range(96):
+            keys = rng.integers(1, 200, NS)
+            label = "nan" if i == 20 else f"{int(keys[0]) % 2}.0"
+            f.write(f"1 {label} " + " ".join(f"1 {k}" for k in keys) + "\n")
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    table = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    ds = BoxPSDataset(schema, table, batch_size=16, seed=0)
+    ds.set_filelist([str(path)])
+    model = LogisticRegression(num_slots=NS, feat_width=LAYOUT.pull_width)
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=16, layout=LAYOUT,
+        sparse_opt=SparseOptimizerConfig(
+            embed_lr=0.3, embedx_threshold=0.0, initial_range=0.01
+        ),
+        auc_buckets=500, check_nan=True,
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    for _ in range(3):
+        ds.load_into_memory()
+        ds.begin_pass(round_to=32)
+        out = tr.train_pass(ds)
+        ds.end_pass(tr.trained_table(), shrink=False)
+    assert out["nan_batches"] == 1.0
+    assert np.isfinite(out["loss"])
+    assert out["auc"] > 0.8  # the other batches still learned
+    assert np.isfinite(table.pull_or_create(np.sort(table.keys()))).all()
+
+
+def test_auc_bucket_saturation_guard():
+    st = auc_init(4)
+    st = st._replace(pos=jnp.full((4,), AUC_BUCKET_CAP - 1, jnp.int32))
+    preds = jnp.full((16,), 0.6, jnp.float32)
+    st = auc_update(st, preds, jnp.ones(16))
+    # saturates at the cap instead of wrapping negative
+    assert int(st.pos[2]) == int(AUC_BUCKET_CAP)
+    out = auc_compute(st)
+    assert out["saturated"] == 1.0
+    st2 = auc_update(auc_init(4), preds, jnp.ones(16))
+    assert auc_compute(st2)["saturated"] == 0.0
+
+
+def test_train_pass_profile_stage_table(tmp_path):
+    """TrainFilesWithProfiler parity: profile=True returns per-stage wall
+    clock through utils/timer."""
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset
+    from paddlebox_tpu.train import CTRTrainer
+
+    rng = np.random.default_rng(0)
+    path = tmp_path / "d.txt"
+    with open(path, "w") as f:
+        for _ in range(64):
+            keys = rng.integers(1, 100, NS)
+            f.write(f"1 {int(keys[0]) % 2}.0 " + " ".join(f"1 {k}" for k in keys) + "\n")
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    table = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    ds = BoxPSDataset(schema, table, batch_size=16, seed=0)
+    ds.set_filelist([str(path)])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+    model = LogisticRegression(num_slots=NS, feat_width=LAYOUT.pull_width)
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=16, layout=LAYOUT, sparse_opt=OPT, auc_buckets=100
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    out = tr.train_pass(ds, profile=True)
+    prof = out["profile"]
+    assert set(prof) == {
+        "feed_wait_s", "step_dispatch_s", "device_step_s", "host_metrics_s"
+    }
+    assert all(v >= 0 for v in prof.values())
+    assert prof["device_step_s"] > 0  # profiling blocks per batch
+    # unprofiled pass carries no table
+    out2 = tr.train_pass(ds)
+    assert "profile" not in out2
