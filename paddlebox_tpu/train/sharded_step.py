@@ -306,9 +306,14 @@ def make_sharded_train_step(
         new_auc = auc_update(local_auc, preds, labels, auc_mask)
         new_auc = AucState(pos=new_auc.pos[None], neg=new_auc.neg[None])
 
+        # a skipped batch never happened: the step counter (which paces
+        # the kstep param-sync cadence) must not advance either
+        step_inc = (
+            jnp.ones((), jnp.int32) if finite is None else finite.astype(jnp.int32)
+        )
         metrics = {
             "loss": loss,
-            "step": state.step + 1,
+            "step": state.step + step_inc,
             "preds": preds,
             "labels": labels,
         }
@@ -319,7 +324,7 @@ def make_sharded_train_step(
             params=new_params,
             opt_state=new_opt_state,
             auc=new_auc,
-            step=state.step + 1,
+            step=state.step + step_inc,
         )
         return new_state, metrics
 

@@ -323,11 +323,16 @@ def make_train_step(
             fin_mask = jnp.broadcast_to(finite, labels.shape)
             auc_mask = fin_mask if auc_mask is None else (auc_mask & fin_mask)
         new_auc = auc_update(state.auc, preds, labels, auc_mask)
+        # a skipped batch never happened: the step counter (which paces
+        # kstep param syncs and dump sampling) must not advance either
+        step_inc = (
+            jnp.ones((), jnp.int32) if finite is None else finite.astype(jnp.int32)
+        )
         # preds/labels ride along for the host-side metric registry
         # (AddAucMonitor parity) — small [B] arrays, no sync forced
         metrics = {
             "loss": loss,
-            "step": state.step + 1,
+            "step": state.step + step_inc,
             "preds": preds,
             "labels": labels,
         }
@@ -341,7 +346,7 @@ def make_train_step(
                 params=new_params,
                 opt_state=new_opt_state,
                 auc=new_auc,
-                step=state.step + 1,
+                step=state.step + step_inc,
             ),
             metrics,
         )
