@@ -93,7 +93,8 @@ class LocalShuffleRouter:
     """In-process stand-in for the closed ``boxps::PaddleShuffler`` RPC tier:
     exchanges record chunks between n logical nodes living in one process. A
     multi-host deployment plugs a host-RPC implementation with the same
-    exchange()/collect() contract (parallel/shuffle_net.py). A chunk is
+    exchange()/collect() contract (parallel/transport.py TcpShuffleRouter,
+    exercised by tests/test_multihost.py). A chunk is
     either a ``List[SlotRecord]`` or a ``ColumnarRecords``; the dataset
     normalizes on collect."""
 
