@@ -339,10 +339,13 @@ int pbx_table_pull_or_create(void* h, const uint64_t* keys, int64_t n,
   return for_shards(t, keys, n, [&](int si, const int64_t* idx, int64_t m) {
     Shard* s = &t->shards[si];
     std::lock_guard<std::mutex> g(s->mtx);
+    // reserve for the worst case (every key new) upfront: one rehash
+    // instead of ~log2(m) incremental doublings on first-pass creates
+    while ((s->mask + 1) * 7 < (uint64_t)(s->n_used + m + 1) * 10)
+      shard_grow_hash(s);
     for (int64_t q = 0; q < m; ++q) {
       int64_t i = idx[q];
       uint64_t key = keys[i];
-      shard_maybe_grow(s);
       bool found;
       uint64_t j = shard_find(s, key, &found);
       int64_t row;
@@ -384,10 +387,11 @@ int pbx_table_push(void* h, const uint64_t* keys, const float* rows,
   return for_shards(t, keys, n, [&](int si, const int64_t* idx, int64_t m) {
     Shard* s = &t->shards[si];
     std::lock_guard<std::mutex> g(s->mtx);
+    while ((s->mask + 1) * 7 < (uint64_t)(s->n_used + m + 1) * 10)
+      shard_grow_hash(s);
     for (int64_t q = 0; q < m; ++q) {
       int64_t i = idx[q];
       uint64_t key = keys[i];
-      shard_maybe_grow(s);
       bool found;
       uint64_t j = shard_find(s, key, &found);
       int64_t row;

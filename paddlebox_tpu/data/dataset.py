@@ -326,8 +326,12 @@ class BoxPSDataset:
             from paddlebox_tpu.utils import native
 
             if native.available():
+                from paddlebox_tpu.utils.fs import fs_read_bytes_retry
+
                 nstats: dict = {}
-                chunk = native.parse_file_columnar(path, self.schema, nstats)
+                chunk = native.parse_buffer_columnar(
+                    fs_read_bytes_retry(path), self.schema, nstats
+                )
                 with self._stats_lock:
                     self._loading_stats.lines += len(chunk) + nstats.get("skipped", 0)
                 return chunk

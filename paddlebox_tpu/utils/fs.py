@@ -22,12 +22,16 @@ import gzip
 import os
 import shutil
 import subprocess
+import time
 from typing import IO, Iterator, List, Optional
 
 from paddlebox_tpu import config
 
 config.define_flag("hadoop_bin", "hadoop", "hadoop client binary for hdfs:/afs: paths")
 config.define_flag("hdfs_retry", 3, "retry count for remote fs commands")
+config.define_flag(
+    "fs_open_retries", 3, "retry-until-open attempts for data files"
+)
 
 _REMOTE_PREFIXES = ("hdfs:", "afs:")
 
@@ -82,6 +86,56 @@ class _PipeStream:
         else:  # error path: don't mask the original exception
             self.proc.kill()
             self.proc.wait()
+
+
+def _retry_open(fn, retries: Optional[int], backoff_s: float):
+    """Shared retry-until-open policy: OSError -> linear backoff -> raise
+    the last error after ``fs_open_retries`` attempts."""
+    n = max(1, retries if retries is not None else config.get_flag("fs_open_retries"))
+    last: Optional[BaseException] = None
+    for attempt in range(n):
+        try:
+            return fn()
+        except OSError as e:
+            last = e
+            if attempt + 1 < n:
+                time.sleep(backoff_s * (attempt + 1))
+    raise last
+
+
+def fs_open_read_retry(
+    path: str,
+    converter: Optional[str] = None,
+    retries: Optional[int] = None,
+    backoff_s: float = 1.0,
+):
+    """Retry-until-open (data_feed.cc:2738-2740 parity): a transiently
+    unavailable file — AFS flake, NFS lag, a part file still being
+    published — is reopened with linear backoff instead of failing the
+    whole pass. Remote paths probe existence first (a hadoop pipe opens
+    lazily, so the flake would otherwise only surface mid-stream, where a
+    retry could duplicate data; a mid-stream remote failure still fails
+    the read)."""
+
+    def attempt():
+        if is_remote(path) and not fs_exists(path):
+            raise OSError(f"remote path not available yet: {path}")
+        return fs_open_read(path, converter)
+
+    return _retry_open(attempt, retries, backoff_s)
+
+
+def fs_read_bytes_retry(
+    path: str, retries: Optional[int] = None, backoff_s: float = 1.0
+) -> bytes:
+    """Whole-file bytes with retry-until-open (the native parser's fast
+    path reads files in one shot)."""
+
+    def attempt():
+        with open(path, "rb") as f:
+            return f.read()
+
+    return _retry_open(attempt, retries, backoff_s)
 
 
 def fs_open_read(path: str, converter: Optional[str] = None):

@@ -13,7 +13,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from paddlebox_tpu import config
-from paddlebox_tpu.utils.fs import fs_open_read
+from paddlebox_tpu.utils.fs import fs_open_read_retry
 
 
 class LineFileReader:
@@ -25,7 +25,7 @@ class LineFileReader:
         self.lines_read = 0
 
     def __iter__(self) -> Iterator[str]:
-        stream = fs_open_read(self.path, self.converter)
+        stream = fs_open_read_retry(self.path, self.converter)
         try:
             for line in stream:
                 self.lines_read += 1

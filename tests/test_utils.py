@@ -162,3 +162,31 @@ def test_profiler_chrome_trace(tmp_path):
     names = {e["name"] for e in data["traceEvents"]}
     assert names == {"pack_batch", "train_step"}
     assert all(e["ph"] == "X" for e in data["traceEvents"])
+
+
+def test_fs_open_retry_until_available(tmp_path):
+    """Retry-until-open parity (data_feed.cc:2738-2740): a file that appears
+    after the first attempt is read, not fatal."""
+    import threading
+    import time as _time
+
+    from paddlebox_tpu.utils.fs import fs_open_read_retry, fs_read_bytes_retry
+
+    late = tmp_path / "late.txt"
+
+    def publish():
+        _time.sleep(0.4)
+        late.write_text("hello\n")
+
+    t = threading.Thread(target=publish)
+    t.start()
+    stream = fs_open_read_retry(str(late), retries=5, backoff_s=0.3)
+    assert stream.read() == "hello\n"
+    stream.close()
+    t.join()
+    assert fs_read_bytes_retry(str(late)) == b"hello\n"
+
+    import pytest
+
+    with pytest.raises(OSError):
+        fs_open_read_retry(str(tmp_path / "never.txt"), retries=2, backoff_s=0.05)
