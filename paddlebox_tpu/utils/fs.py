@@ -118,8 +118,21 @@ def fs_open_read_retry(
     the read)."""
 
     def attempt():
-        if is_remote(path) and not fs_exists(path):
-            raise OSError(f"remote path not available yet: {path}")
+        if is_remote(path):
+            try:
+                _run_remote(f"-test -e '{path}' && echo yes")
+            except RuntimeError as e:
+                rc = getattr(e.__cause__, "returncode", None)
+                if rc == 1:  # hadoop -test: path genuinely absent -> retry
+                    raise OSError(
+                        f"remote path not available yet: {path}"
+                    ) from e
+                # 127 missing binary / 255 cluster unreachable etc.: NOT a
+                # publishing delay — surface it instead of burning retries
+                raise RuntimeError(
+                    f"remote fs probe failed for {path!r} (hadoop client "
+                    "error, not a missing file)"
+                ) from e
         return fs_open_read(path, converter)
 
     return _retry_open(attempt, retries, backoff_s)
@@ -128,8 +141,14 @@ def fs_open_read_retry(
 def fs_read_bytes_retry(
     path: str, retries: Optional[int] = None, backoff_s: float = 1.0
 ) -> bytes:
-    """Whole-file bytes with retry-until-open (the native parser's fast
-    path reads files in one shot)."""
+    """Whole-file bytes with retry-until-open — LOCAL plain files only (the
+    native parser's one-shot fast path; its caller routes remote/gz paths
+    through the line-reader tier instead)."""
+    if is_remote(path) or path.endswith(".gz"):
+        raise ValueError(
+            f"fs_read_bytes_retry is local-plain-file only, got {path!r} "
+            "(use fs_open_read_retry for remote/gz)"
+        )
 
     def attempt():
         with open(path, "rb") as f:

@@ -11,6 +11,9 @@ Modes:
            dumps layout/table/metrics for equality vs the 1-process run.
   shuffle — unequal record counts + ins_id global shuffle + lockstep
            wraparound pass on the global mesh; dumps shuffle accounting.
+  zero   — ZeRO-1 optimizer-state sharding across the 2-process mesh, TWO
+           passes (cross-pass chunked-state carry over non-addressable
+           global arrays is the regression surface).
 """
 
 import json
@@ -95,7 +98,15 @@ def main():
         auc_buckets=1000,
         axis_name=plan.axis,
     )
-    trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan)
+    if mode == "zero":
+        from paddlebox_tpu.fleet import Zero1Optimizer
+
+        dense_opt = Zero1Optimizer(
+            optax.adam(1e-2), axis_name=plan.axis, n_dev=n_global_dev
+        )
+    else:
+        dense_opt = optax.adam(1e-2)
+    trainer = CTRTrainer(model, cfg, dense_opt=dense_opt, plan=plan)
     trainer.init_params(jax.random.PRNGKey(0))
 
     ds.load_into_memory()
@@ -103,6 +114,14 @@ def main():
     nb = ds.num_batches()
     ds.begin_pass(round_to=conf["round_to"])
     out = trainer.train_pass(ds)
+    if mode == "zero":
+        # second pass: chunked opt_state carries across passes as a
+        # dp-sharded global array (put_sharded passthrough path)
+        ds.end_pass(trainer.trained_table(), shrink=False)
+        ds.set_date("20260102")
+        ds.load_into_memory()
+        ds.begin_pass(round_to=conf["round_to"])
+        out = trainer.train_pass(ds)
     local_table = trainer.trained_table()  # this host's shard block
     dws = ds.ws
     layout_dump = dict(
