@@ -43,6 +43,7 @@ from paddlebox_tpu.train.train_step import (
     make_train_step,
 )
 from paddlebox_tpu.utils.dump import DumpWorkerPool, dump_fields, dump_param
+from paddlebox_tpu.utils.trace import PROFILER
 
 
 class CTRTrainer:
@@ -375,7 +376,20 @@ class CTRTrainer:
             ids = [store.ins_id(int(j)) for j in idx] if want_ids else None
             return idx, feed, ids
 
-        for idx, feed, ids in prefetch(dataset.batch_indices(n_batches), prep):
+        def prep_traced(idx):
+            # worker-thread span: the chrome trace shows pack/upload
+            # overlapping the device step (RecordEvent parity). device_put
+            # returns before the H2D transfer lands, so when tracing we
+            # block on the feed INSIDE the worker span — the wait stays off
+            # the main thread, which is exactly the prefetch worker's job
+            if not PROFILER.enabled:
+                return prep(idx)
+            with PROFILER.record_event("pack+upload", "pack"):
+                out = prep(idx)
+                jax.block_until_ready(out[1])
+                return out
+
+        for idx, feed, ids in prefetch(dataset.batch_indices(n_batches), prep_traced):
             yield self._feed_aux(
                 feed,
                 cmatch=store.cmatch[idx] if has_meta else None,
@@ -462,7 +476,8 @@ class CTRTrainer:
             while True:
                 t_feed.start()
                 try:
-                    item = next(it)
+                    with PROFILER.record_event("feed_wait", "pass"):
+                        item = next(it)
                 except StopIteration:
                     return
                 finally:
@@ -475,11 +490,13 @@ class CTRTrainer:
                     params=jax.device_put(self.async_dense.pull_dense())
                 )
             t_disp.start()
-            state, m = step_fn(state, feed)
+            with PROFILER.record_event("train_step_dispatch", "pass"):
+                state, m = step_fn(state, feed)
             t_disp.pause()
             if profile:
                 t_dev.start()
-                jax.block_until_ready(m["loss"])
+                with PROFILER.record_event("device_step", "device"):
+                    jax.block_until_ready(m["loss"])
                 t_dev.pause()
             t_host.start()
             if "nan_skipped" in m:  # lazy device array: no per-batch sync

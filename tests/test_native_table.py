@@ -188,3 +188,41 @@ def test_pull_or_create_throughput():
         f"pull {rate/1e6:.1f}M/s, push {n/push_s/1e6:.1f}M/s"
     )
     assert rate > 4e6, f"native pull rate {rate/1e6:.1f}M/s below floor"
+
+
+def test_distributed_ws_over_spilled_table(tmp_path):
+    """DistributedWorkingSet.finalize promotes this host's owned keys from
+    the disk tier exactly like the local working set does."""
+    from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+
+    class _OneRankTransport:
+        rank, n_ranks = 0, 1
+
+        def alltoall(self, payloads, tag):
+            return list(payloads)
+
+        def allgather(self, payload, tag):
+            return [payload]
+
+        def allreduce_max(self, value, tag):
+            return int(value)
+
+    t = HostSparseTable(
+        LAYOUT, OPT, n_shards=4, seed=0, spill_dir=str(tmp_path / "spill")
+    )
+    keys = np.arange(1, 501, dtype=np.uint64)
+    base = t.pull_or_create(keys)
+    t.push(keys, base + 1.0)
+    t.save_base(str(tmp_path / "b"))  # clear touched so everything spills
+    t.spill_cold(0)
+    assert t.disk_rows == 500
+
+    dws = DistributedWorkingSet(_OneRankTransport(), n_mesh_shards=2)
+    dws.add_keys(keys[:200])
+    dev = dws.finalize(t, round_to=32)
+    flat = dev.reshape(-1, LAYOUT.width)
+    np.testing.assert_array_equal(
+        flat[dws.lookup(keys[:200])], base[:200] + 1.0
+    )
+    # untouched keys stayed on disk; the pass promoted only what it needed
+    assert t.disk_rows == 300

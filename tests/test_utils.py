@@ -190,3 +190,66 @@ def test_fs_open_retry_until_available(tmp_path):
 
     with pytest.raises(OSError):
         fs_open_read_retry(str(tmp_path / "never.txt"), retries=2, backoff_s=0.05)
+
+
+def test_train_pass_chrome_trace(tmp_path):
+    """RecordEvent-parity spans from a real pass: feed/step on the main
+    thread, pack+upload in worker threads (the overlap is visible)."""
+    import json as _json
+
+    import pytest
+
+    from paddlebox_tpu.utils import native as _native
+
+    if not _native.available():
+        pytest.skip("pack+upload spans need the columnar fast path")
+
+    import jax
+    import numpy as np
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+    from paddlebox_tpu.models import LogisticRegression
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+
+    rng = np.random.default_rng(0)
+    path = tmp_path / "d.txt"
+    with open(path, "w") as f:
+        for _ in range(64):
+            keys = rng.integers(1, 100, 3)
+            f.write(f"1 {int(keys[0]) % 2}.0 " + " ".join(f"1 {k}" for k in keys) + "\n")
+    layout = ValueLayout(embedx_dim=4)
+    opt = SparseOptimizerConfig(embedx_threshold=0.0)
+    table = HostSparseTable(layout, opt, n_shards=2, seed=0)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(3)],
+        label_slot="label",
+    )
+    ds = BoxPSDataset(schema, table, batch_size=16, seed=0)
+    ds.set_filelist([str(path)])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+    model = LogisticRegression(num_slots=3, feat_width=layout.pull_width)
+    cfg = TrainStepConfig(num_slots=3, batch_size=16, layout=layout,
+                          sparse_opt=opt, auc_buckets=100)
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+
+    PROFILER.reset()
+    PROFILER.enable()
+    try:
+        tr.train_pass(ds)
+    finally:
+        PROFILER.disable()
+    out = str(tmp_path / "trace.json")
+    n = PROFILER.export_chrome_trace(out)
+    assert n > 0
+    names = {e["name"] for e in _json.load(open(out))["traceEvents"]}
+    assert {"feed_wait", "train_step_dispatch", "pack+upload"} <= names
+    PROFILER.reset()
