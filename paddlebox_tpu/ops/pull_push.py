@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from paddlebox_tpu import config
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
-from paddlebox_tpu.table.value_layout import ValueLayout
+from paddlebox_tpu.table.value_layout import FeatureType, ValueLayout
 
 
 def _use_pallas(table: jnp.ndarray, n_idx: int) -> bool:
@@ -43,6 +43,27 @@ def _gather_rows(table: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
     return jnp.take(table, rows, axis=0)
 
 
+def embedx_active_mask(
+    layout: ValueLayout, show: jnp.ndarray, embedx_threshold: float
+) -> jnp.ndarray:
+    """Activation mask for the embedx block, from the key's show count.
+
+    Row-level threshold gate (the closed lib's ``embedding_size > 0``
+    signal, box_wrapper.cu:54-63) — or, for FeatureType.VARIABLE, the
+    graded per-column unlock (column j needs show >= threshold *
+    2^quarter(j)): cold keys expose a short vector, hot keys the full one
+    (B3 VARIABLE; dim policy re-derived openly, see
+    value_layout.FeatureType). Shared by pull AND push so locked dims can
+    neither be seen nor trained.
+    """
+    if layout.feature_type is FeatureType.VARIABLE:
+        D = layout.embedx_dim
+        quarter = jnp.arange(D, dtype=jnp.int32) * 4 // max(D, 1)
+        need = embedx_threshold * jnp.exp2(quarter.astype(jnp.float32))
+        return show[:, None] >= need[None, :]
+    return (show >= embedx_threshold)[:, None]
+
+
 def pull_sparse_rows(
     table: jnp.ndarray,  # [rows, width]
     rows: jnp.ndarray,  # int32 [U] (deduped, padded with the padding row)
@@ -59,7 +80,7 @@ def pull_sparse_rows(
     picked = _gather_rows(table, rows)  # [U, width]
     cvm_block = picked[:, : layout.cvm_offset]
     embedx = picked[:, layout.embedx_col : layout.embedx_col + layout.embedx_dim]
-    active = (picked[:, layout.SHOW] >= embedx_threshold)[:, None]
+    active = embedx_active_mask(layout, picked[:, layout.SHOW], embedx_threshold)
     embedx = jnp.where(active, embedx * scale, 0.0)
     return jnp.concatenate([cvm_block, embedx], axis=1)
 
@@ -81,11 +102,16 @@ def pull_sparse_rows_extended(
         raise ValueError("layout has no expand block (expand_embed_dim == 0)")
     picked = _gather_rows(table, rows)
     cvm_block = picked[:, : layout.cvm_offset]
-    active = (picked[:, layout.SHOW] >= embedx_threshold)[:, None]
+    show = picked[:, layout.SHOW]
+    # embedx follows the layout's gating (incl. VARIABLE graded dims);
+    # the expand block stays row-level gated — its dims are an independent
+    # second embedding, not a prefix-extensible vector
+    active = embedx_active_mask(layout, show, embedx_threshold)
+    row_active = (show >= embedx_threshold)[:, None]
     embedx = picked[:, layout.embedx_col : layout.embedx_col + layout.embedx_dim]
     embedx = jnp.where(active, embedx * scale, 0.0)
     expand = picked[:, layout.expand_col : layout.expand_col + layout.expand_dim]
-    expand = jnp.where(active, expand * scale, 0.0)
+    expand = jnp.where(row_active, expand * scale, 0.0)
     return jnp.concatenate([cvm_block, embedx], axis=1), expand
 
 
@@ -159,10 +185,15 @@ def sparse_update_rows(
     new_w = old[:, 2:co] - step_e
     new_w = jnp.clip(new_w, -opt.weight_bounds, opt.weight_bounds)
 
-    # --- embedx vector adagrad with one shared g2 scalar (mean energy)
+    # --- embedx vector adagrad with one shared g2 scalar (mean energy).
+    # The activation mask MUST match the pull's (incl. VARIABLE graded
+    # dims): grads are taken w.r.t. the pulled record, so a locked dim's
+    # gradient is nonzero even though the model saw a zero — without the
+    # mask it would train on phantom inputs and inflate g2.
     x_grad = grads[:, co : co + D]
+    x_active = embedx_active_mask(layout, old[:, layout.SHOW], opt.embedx_threshold)
     active = (old[:, layout.SHOW] >= opt.embedx_threshold)[:, None]
-    x_grad = jnp.where(active, x_grad, 0.0)
+    x_grad = jnp.where(x_active, x_grad, 0.0)
     g2_x = old[:, layout.embedx_g2_col] + jnp.mean(x_grad * x_grad, axis=1)
     scale_x = jnp.sqrt(opt.initial_g2sum / (opt.initial_g2sum + g2_x))
     new_x = old[:, co : co + D] - (opt.embedx_lr * lr_scale * scale_x)[:, None] * x_grad

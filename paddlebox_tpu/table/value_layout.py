@@ -35,6 +35,14 @@ class FeatureType(enum.Enum):
     CONV = "conv"
     PCOC = "pcoc"
     SHARE_EMBEDDING = "share_embedding"
+    # var-dim embeddings (box_wrapper.cc:419-437 selects a VARIABLE layout;
+    # the per-key dim policy lives in the closed lib). Open re-expression:
+    # a key's effective embedx dim unlocks in quarters as its show count
+    # crosses doubling thresholds — embedx_threshold*1/2/4/8 for
+    # 1/4, 1/2, 3/4, full dim — so cold keys spend HBM bandwidth on short
+    # vectors and hot keys get the full embedding. Same row width; the
+    # masking happens in the pull (ops/pull_push.py).
+    VARIABLE = "variable"
 
 
 _CVM_OFFSET = {
@@ -43,6 +51,7 @@ _CVM_OFFSET = {
     FeatureType.SHOW_CLK: 3,
     FeatureType.CONV: 4,
     FeatureType.PCOC: 8,
+    FeatureType.VARIABLE: 3,
 }
 
 # embedx dims the reference compiles kernels for (box_wrapper.cc:444-457);

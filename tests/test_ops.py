@@ -118,3 +118,129 @@ def test_adagrad_step_decays_with_g2():
     t2 = push_sparse_rows(t1, rows, g, jnp.ones(1), jnp.zeros(1), LAY, opt)
     d2 = float(t1[0, LAY.embed_w_col]) - float(t2[0, LAY.embed_w_col])
     assert 0 < d2 < d1  # adagrad: later identical grads take smaller steps
+
+
+def test_variable_feature_type_graded_dims():
+    """B3 VARIABLE: effective embedx dim unlocks in quarters as show crosses
+    doubling thresholds (cvm_offset stays 3, same row width)."""
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.ops.pull_push import pull_sparse_rows
+    from paddlebox_tpu.table.value_layout import FeatureType, ValueLayout
+
+    lay = ValueLayout(embedx_dim=8, feature_type=FeatureType.VARIABLE)
+    assert lay.cvm_offset == 3
+    assert lay.width == ValueLayout(embedx_dim=8).width
+
+    T = 10.0
+    table = np.ones((5, lay.width), np.float32)
+    # shows: cold, >=T, >=2T, >=4T, >=8T
+    table[:, lay.SHOW] = [1.0, 10.0, 20.0, 40.0, 80.0]
+    rows = jnp.arange(5, dtype=jnp.int32)
+    out = np.asarray(pull_sparse_rows(jnp.asarray(table), rows, lay, T, 1.0))
+    emb = out[:, lay.cvm_offset :]
+    active_dims = (emb != 0).sum(axis=1)
+    assert list(active_dims) == [0, 2, 4, 6, 8]
+    # threshold 0 == full dims everywhere (plain behavior)
+    out0 = np.asarray(pull_sparse_rows(jnp.asarray(table), rows, lay, 0.0, 1.0))
+    assert ((out0[:, lay.cvm_offset :] != 0).sum(axis=1) == 8).all()
+
+
+def test_variable_feature_type_trains():
+    """Masked dims receive no gradient; training stays finite and learns."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddlebox_tpu.models import LogisticRegression
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        PassWorkingSet,
+        SparseOptimizerConfig,
+    )
+    from paddlebox_tpu.table.value_layout import FeatureType, ValueLayout
+    from paddlebox_tpu.data.slot_record import SlotRecord, build_batch
+    from paddlebox_tpu.data.slot_schema import SlotInfo, SlotSchema
+    from paddlebox_tpu.data.device_pack import pack_batch
+    from paddlebox_tpu.train import TrainStepConfig
+    from paddlebox_tpu.train.train_step import (
+        init_train_state,
+        jit_train_step,
+        make_train_step,
+    )
+
+    lay = ValueLayout(embedx_dim=8, feature_type=FeatureType.VARIABLE)
+    opt = SparseOptimizerConfig(embed_lr=0.3, embedx_threshold=4.0, initial_range=0.01)
+    rng = np.random.default_rng(0)
+    NS, B = 3, 16
+    recs = []
+    for _ in range(4 * B):
+        keys = rng.integers(1, 40, NS).astype(np.uint64)  # hot: shows accumulate
+        recs.append(SlotRecord(
+            u64_values=keys,
+            u64_offsets=np.arange(NS + 1, dtype=np.uint32),
+            f_values=np.array([float(keys[0] % 2)], np.float32),
+            f_offsets=np.array([0, 1], np.uint32),
+        ))
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    table = HostSparseTable(lay, opt, n_shards=2, seed=0)
+    ws = PassWorkingSet()
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev = ws.finalize(table, round_to=32)
+    model = LogisticRegression(num_slots=NS, feat_width=lay.pull_width)
+    cfg = TrainStepConfig(num_slots=NS, batch_size=B, layout=lay,
+                          sparse_opt=opt, auc_buckets=500)
+    step = jit_train_step(make_train_step(model.apply, optax.adam(1e-2), cfg))
+    state = init_train_state(
+        jnp.asarray(dev.reshape(-1, lay.width)),
+        model.init(jax.random.PRNGKey(0)), optax.adam(1e-2), 500,
+    )
+    losses = []
+    for ep in range(6):
+        for bi in range(4):
+            batch = build_batch(recs[bi * B : (bi + 1) * B], schema)
+            db = pack_batch(batch, ws, schema, bucket=64)
+            state, m = step(state, {k: jnp.asarray(v) for k, v in db.as_dict().items()})
+            losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    tbl = np.asarray(state.table)
+    assert np.isfinite(tbl).all()
+
+
+def test_variable_locked_dims_never_trained():
+    """Push applies the same graded mask as pull: a locked quarter-dim
+    receives no update and no g2 energy even when the model's gradient
+    w.r.t. the (zeroed) pulled value is nonzero."""
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.ops.pull_push import sparse_update_rows
+    from paddlebox_tpu.table import SparseOptimizerConfig
+    from paddlebox_tpu.table.value_layout import FeatureType, ValueLayout
+
+    lay = ValueLayout(embedx_dim=8, feature_type=FeatureType.VARIABLE)
+    opt = SparseOptimizerConfig(embedx_threshold=10.0, embedx_lr=0.5)
+    old = np.ones((2, lay.width), np.float32)
+    old[0, lay.SHOW] = 20.0  # half the dims unlocked (>=T, >=2T)
+    old[1, lay.SHOW] = 160.0  # all unlocked
+    grads = np.full((2, lay.pull_width), 1.0, np.float32)  # phantom grads too
+    new = np.asarray(
+        sparse_update_rows(
+            jnp.asarray(old), jnp.asarray(grads),
+            jnp.zeros(2), jnp.zeros(2), lay, opt,
+        )
+    )
+    co = lay.cvm_offset
+    emb_old, emb_new = old[:, co : co + 8], new[:, co : co + 8]
+    # row 0: first 4 dims trained, locked upper 4 bit-identical
+    assert (emb_new[0, :4] != emb_old[0, :4]).all()
+    np.testing.assert_array_equal(emb_new[0, 4:], emb_old[0, 4:])
+    # row 1: everything trained
+    assert (emb_new[1] != emb_old[1]).all()
+    # g2 energy reflects only unlocked dims: row 0 accumulated half of row 1
+    g2 = new[:, lay.embedx_g2_col] - old[:, lay.embedx_g2_col]
+    assert abs(g2[0] - 0.5 * g2[1]) < 1e-6
