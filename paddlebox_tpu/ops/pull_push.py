@@ -73,9 +73,11 @@ def pull_sparse_rows(
 ) -> jnp.ndarray:
     """Gather pull records [U, pull_width] = [show, clk, .., embed_w, embedx].
 
-    embedx columns are zeroed for keys whose show count has not reached the
-    activation threshold — the open analog of the closed lib's
-    ``embedding_size > 0`` signal consumed by PullCopy (box_wrapper.cu:54-63).
+    embedx columns are zeroed per ``embedx_active_mask``: for keys whose
+    show count has not reached the activation threshold — the open analog
+    of the closed lib's ``embedding_size > 0`` signal consumed by PullCopy
+    (box_wrapper.cu:54-63) — or, on VARIABLE layouts, per-column as the
+    graded dims unlock.
     """
     picked = _gather_rows(table, rows)  # [U, width]
     cvm_block = picked[:, : layout.cvm_offset]
@@ -192,7 +194,6 @@ def sparse_update_rows(
     # mask it would train on phantom inputs and inflate g2.
     x_grad = grads[:, co : co + D]
     x_active = embedx_active_mask(layout, old[:, layout.SHOW], opt.embedx_threshold)
-    active = (old[:, layout.SHOW] >= opt.embedx_threshold)[:, None]
     x_grad = jnp.where(x_active, x_grad, 0.0)
     g2_x = old[:, layout.embedx_g2_col] + jnp.mean(x_grad * x_grad, axis=1)
     scale_x = jnp.sqrt(opt.initial_g2sum / (opt.initial_g2sum + g2_x))
@@ -204,8 +205,11 @@ def sparse_update_rows(
         E = layout.expand_dim
         ec = layout.expand_col
         if with_expand:
+            # expand is row-level gated (an independent second embedding,
+            # not a prefix-extensible vector) — mirrors the extended pull
+            row_active = (old[:, layout.SHOW] >= opt.embedx_threshold)[:, None]
             e_grad = grads[:, co + D : co + D + E]
-            e_grad = jnp.where(active, e_grad, 0.0)
+            e_grad = jnp.where(row_active, e_grad, 0.0)
         else:  # plain push on an expand-capable layout: expand untouched
             e_grad = jnp.zeros((old.shape[0], E), old.dtype)
         g2_p = old[:, layout.expand_g2_col] + jnp.mean(e_grad * e_grad, axis=1)

@@ -263,3 +263,72 @@ def test_train_pass_profile_stage_table(tmp_path):
     # unprofiled pass carries no table
     out2 = tr.train_pass(ds)
     assert "profile" not in out2
+
+
+def test_kstep_sync_cadence_survives_skipped_boundary_batch():
+    """A NaN-skipped batch doesn't advance the step counter, so a skipped
+    param-sync boundary is retried on the next real batch instead of
+    drifting for another K steps."""
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train.sharded_step import (
+        init_sharded_train_state,
+        make_sharded_train_step,
+    )
+
+    N_DEV, K = 4, 2
+    rng = np.random.default_rng(3)
+    # batch 1 poisoned: with K=2 it would have been the first sync boundary
+    recs = _records(rng, 4 * N_DEV * B, poison_labels={N_DEV * B + 1})
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    table = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    ws = PassWorkingSet(n_mesh_shards=N_DEV)
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev = ws.finalize(table, round_to=32)
+    plan = make_mesh(N_DEV)
+    model = LogisticRegression(num_slots=NS, feat_width=LAYOUT.pull_width)
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=B, layout=LAYOUT, sparse_opt=OPT,
+        auc_buckets=100, check_nan=True, axis_name=plan.axis,
+        dense_sync_mode="kstep", param_sync_step=K,
+    )
+    step = make_sharded_train_step(model.apply, optax.sgd(0.1), cfg, plan)
+    state = init_sharded_train_state(
+        plan, dev, model.init(jax.random.PRNGKey(0)), optax.sgd(0.1), 100,
+        local_dense=True,
+    )
+    GB = N_DEV * B
+
+    def replicas_equal(st):
+        leaves = [np.asarray(x) for x in jax.tree.leaves(st.params)]
+        return all(
+            all(np.array_equal(leaf[0], leaf[d]) for d in range(1, N_DEV))
+            for leaf in leaves
+        )
+
+    skipped = []
+    for bi in range(4):
+        batch = build_batch(recs[bi * GB : (bi + 1) * GB], schema)
+        db = pack_batch_sharded(batch, ws, schema, N_DEV, bucket=32)
+        feed = {
+            k: jax.device_put(v, plan.batch_sharding)
+            for k, v in db.as_dict().items()
+        }
+        state, m = step(state, feed)
+        skipped.append(int(m["nan_skipped"]))
+    assert skipped == [0, 1, 0, 0]
+    # batches counted: 0, skip, 1, 2 -> step == 3; sync fired at step 2
+    # (the retried boundary), so after the local step 3 replicas have
+    # diverged again by exactly one local update from a COMMON sync point
+    assert int(np.asarray(state.step)) == 3
+    # rerun the boundary check: one more real batch lands step 4 == 2K -> sync
+    batch = build_batch(recs[:GB], schema)
+    db = pack_batch_sharded(batch, ws, schema, N_DEV, bucket=32)
+    feed = {k: jax.device_put(v, plan.batch_sharding) for k, v in db.as_dict().items()}
+    state, m = step(state, feed)
+    assert int(np.asarray(state.step)) == 4
+    assert replicas_equal(state), "2nd boundary sync must fire despite the skip"
