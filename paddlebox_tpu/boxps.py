@@ -109,3 +109,22 @@ class BoxWrapper:
         """Day-level resume (InitializeGPUAndLoadModel + LoadSSD2Mem parity):
         newest base + its deltas into the table, dense into the trainer."""
         return self.checkpoint_manager(root).resume(self.table, trainer)
+
+    def save_cache_model(self, root: str, date: str, cache_rate: float = 0.1) -> int:
+        """Hot-key serving cache (save_cache_model parity, pslib
+        __init__.py:386-425): derive the show threshold admitting
+        ``cache_rate`` of keys, write them under <date>/cache/, return the
+        feasign count."""
+        import os
+
+        thr = self.table.cache_threshold(cache_rate)
+        return self.table.save_cache(os.path.join(root, date, "cache"), thr)
+
+    def save_model_with_whitelist(self, root: str, date: str, whitelist) -> int:
+        """Whitelisted-keys snapshot (save_model_with_whitelist parity,
+        pslib __init__.py:351-384) under <date>/whitelist/."""
+        import os
+
+        return self.table.save_with_whitelist(
+            os.path.join(root, date, "whitelist"), whitelist
+        )

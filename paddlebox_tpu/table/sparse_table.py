@@ -170,16 +170,10 @@ class HostSparseTable:
 
     def keys(self) -> np.ndarray:
         """All keys currently stored (mem + disk tiers), unsorted."""
-        if self._native is not None:
-            parts = [
-                self._native.snapshot_shard(s, only_touched=False, clear_touched=False)[0]
-                for s in range(self.n_shards)
-            ]
-        else:
-            parts = [
-                np.fromiter(sh.index.keys(), dtype=np.uint64, count=len(sh.index))
-                for sh in self._shards
-            ]
+        parts = [
+            self._snapshot_shard(s, only_touched=False, clear_touched=False)[0]
+            for s in range(self.n_shards)
+        ]
         return np.concatenate(parts) if parts else np.zeros(0, np.uint64)
 
     def _init_rows(self, n: int) -> np.ndarray:
@@ -312,15 +306,17 @@ class HostSparseTable:
     # --- persistence: base + delta model publishing (SaveBase/SaveDelta parity,
     # box_wrapper.cc:1288-1331) ---
 
-    def _snapshot_shard(self, s: int, only_touched: bool):
+    def _snapshot_shard(self, s: int, only_touched: bool, clear_touched: bool = True):
         """Atomically snapshot (keys, values) of a shard and clear touched.
 
         The snapshot+clear happens under the shard lock so a concurrent
         push() either lands in this snapshot or stays marked touched for the
         next delta — no update can fall between and be lost.
+        ``clear_touched=False`` gives a read-only peek (cache/whitelist/
+        keys() exports).
         """
         if self._native is not None:
-            return self._native.snapshot_shard(s, only_touched, clear_touched=True)
+            return self._native.snapshot_shard(s, only_touched, clear_touched)
         shard = self._shards[s]
         with shard.lock:
             if only_touched:
@@ -333,7 +329,8 @@ class HostSparseTable:
                 if items
                 else np.zeros((0, self.layout.width), dtype=np.float32)
             )
-            shard.touched.clear()
+            if clear_touched:
+                shard.touched.clear()
         return keys, vals
 
     def save_base(self, path: str) -> None:
@@ -361,6 +358,65 @@ class HostSparseTable:
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump({"n_shards": self.n_shards, "kind": "delta"}, f)
         return total
+
+    def cache_threshold(self, cache_rate: float = 0.1) -> float:
+        """Show-count threshold whose admitted fraction is CLOSEST to
+        ``cache_rate`` (get_cache_threshold parity, pslib __init__.py:411).
+
+        Computed over the exact show distribution, so heavy ties (many
+        cold keys sharing tiny counts) can't silently blow the cache up to
+        the whole table — the closest achievable fraction wins. One
+        show-column copy per shard is held, never the value matrices."""
+        if not 0.0 < cache_rate <= 1.0:
+            raise ValueError(f"cache_rate must be in (0, 1], got {cache_rate}")
+        shows = []
+        for s in range(self.n_shards):
+            _, vals = self._snapshot_shard(s, only_touched=False, clear_touched=False)
+            if len(vals):
+                shows.append(vals[:, self.layout.SHOW].copy())
+        if not shows:
+            return 0.0
+        allshow = np.concatenate(shows)
+        uniq, counts = np.unique(allshow, return_counts=True)  # ascending
+        admitted = np.cumsum(counts[::-1])[::-1] / len(allshow)  # frac >= uniq[i]
+        return float(uniq[int(np.argmin(np.abs(admitted - cache_rate)))])
+
+    def _filtered_save(self, path: str, mask_fn, meta: dict) -> int:
+        """Shared filtered snapshot-to-dir writer (cache/whitelist saves).
+        One snapshot per shard, streamed — nothing table-sized is held."""
+        os.makedirs(path, exist_ok=True)
+        total = 0
+        for s in range(self.n_shards):
+            keys, vals = self._snapshot_shard(s, only_touched=False, clear_touched=False)
+            keep = mask_fn(keys, vals)
+            keys, vals = keys[keep], vals[keep]
+            total += len(keys)
+            np.savez_compressed(
+                os.path.join(path, f"shard-{s:05d}.npz"), keys=keys, values=vals
+            )
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"n_shards": self.n_shards, **meta}, f)
+        return total
+
+    def save_cache(self, path: str, threshold: float) -> int:
+        """Write the hot subset (show >= threshold) for serving
+        (cache_shuffle/save_cache_model parity, pslib __init__.py:416).
+        Like the reference (which brackets threshold+shuffle in worker
+        barriers), quiesce pushes across threshold+save for an exact cut.
+        Same dir format as base/delta; returns the feasign count."""
+        return self._filtered_save(
+            path,
+            lambda keys, vals: vals[:, self.layout.SHOW] >= threshold,
+            {"kind": "cache", "threshold": threshold},
+        )
+
+    def save_with_whitelist(self, path: str, whitelist: np.ndarray) -> int:
+        """Write only the whitelisted keys that exist in the table
+        (save_model_with_whitelist parity, pslib __init__.py:351-384)."""
+        wl = np.unique(np.asarray(whitelist, dtype=np.uint64))
+        return self._filtered_save(
+            path, lambda keys, vals: np.isin(keys, wl), {"kind": "whitelist"}
+        )
 
     def load(self, path: str) -> None:
         """Load a base dir, then optionally apply deltas via ``apply_delta``."""

@@ -116,3 +116,70 @@ def test_lookup_missing_key_raises():
     ws.finalize(t, round_to=8)
     with pytest.raises(KeyError):
         ws.lookup(np.array([999], dtype=np.uint64))
+
+
+def test_save_cache_model_hot_keys(tmp_path):
+    """save_cache_model parity: threshold admits ~cache_rate of keys, the
+    cache dir round-trips as a loadable table subset."""
+    t = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    keys = np.arange(1, 101, dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    rows[:, LAYOUT.SHOW] = np.arange(100, dtype=np.float32)  # show = rank
+    t.push(keys, rows)
+
+    thr = t.cache_threshold(cache_rate=0.2)
+    assert 75.0 <= thr <= 85.0  # ~top 20%
+    n = t.save_cache(str(tmp_path / "cache"), thr)
+    assert 15 <= n <= 25
+    t2 = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    t2.load(str(tmp_path / "cache"))
+    assert len(t2) == n
+    hot = np.sort(t2.keys())
+    got = t2.pull_or_create(hot)
+    np.testing.assert_array_equal(got, rows[np.isin(keys, hot)])
+    assert (got[:, LAYOUT.SHOW] >= thr).all()
+
+
+def test_save_with_whitelist(tmp_path):
+    t = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    keys = np.arange(1, 51, dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    wl = np.array([3, 7, 999], dtype=np.uint64)  # 999 not in the table
+    n = t.save_with_whitelist(str(tmp_path / "wl"), wl)
+    assert n == 2
+    t2 = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    t2.load(str(tmp_path / "wl"))
+    np.testing.assert_array_equal(np.sort(t2.keys()), [3, 7])
+    np.testing.assert_array_equal(
+        t2.pull_or_create(np.array([3, 7], np.uint64)),
+        rows[np.isin(keys, [3, 7])],
+    )
+
+
+def test_boxwrapper_cache_and_whitelist_surface(tmp_path):
+    from paddlebox_tpu.boxps import BoxWrapper
+
+    box = BoxWrapper(embedx_dim=4, sparse_opt=OPT, n_host_shards=4)
+    keys = np.arange(1, 41, dtype=np.uint64)
+    rows = box.table.pull_or_create(keys)
+    rows[:, LAYOUT.SHOW] = np.arange(40, dtype=np.float32)
+    box.table.push(keys, rows)
+    n = box.save_cache_model(str(tmp_path), "20260101", cache_rate=0.25)
+    assert 5 <= n <= 15
+    assert (tmp_path / "20260101" / "cache" / "meta.json").exists()
+    nw = box.save_model_with_whitelist(str(tmp_path), "20260101", keys[:5])
+    assert nw == 5
+
+
+def test_cache_threshold_tie_resistant():
+    """Heavy show ties (cold keys at 0) must not blow the cache up to the
+    whole table: the closest achievable fraction wins."""
+    t = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    keys = np.arange(1, 1001, dtype=np.uint64)
+    rows = t.pull_or_create(keys)
+    rows[:, LAYOUT.SHOW] = 0.0  # 90% stone cold, all tied
+    rows[:100, LAYOUT.SHOW] = 50.0  # 10% hot, tied among themselves
+    t.push(keys, rows)
+    thr = t.cache_threshold(cache_rate=0.1)
+    assert thr == 50.0  # NOT 0.0 (which would admit everything)
+    assert t.save_cache("/tmp/ignore-cache-test", thr) == 100
