@@ -43,15 +43,6 @@ inline uint64_t splitmix64(uint64_t x) {
   return x ^ (x >> 31);
 }
 
-// uniform in [-range, range), deterministic in (seed, key, col)
-inline float init_uniform(uint64_t seed, uint64_t key, uint32_t col,
-                          float range) {
-  uint64_t r = splitmix64(seed ^ splitmix64(key ^ ((uint64_t)col << 32)));
-  // top 24 bits -> [0, 1)
-  float u = (float)(r >> 40) * (1.0f / 16777216.0f);
-  return (2.0f * u - 1.0f) * range;
-}
-
 // Hash-slot states. kDisk entries hold a byte offset into the shard's
 // spill file instead of a mem row id.
 enum : uint8_t { kEmpty = 0, kMem = 1, kDisk = 2 };
@@ -170,8 +161,16 @@ int64_t shard_new_row(const Table* t, Shard* s, uint64_t key) {
 
 void init_row(const Table* t, uint64_t key, float* dst) {
   std::memset(dst, 0, sizeof(float) * t->width);
-  for (int32_t c : t->init_cols)
-    dst[c] = init_uniform(t->seed, key, (uint32_t)c, t->init_range);
+  // one full mix per key, then a cheap counter advance per column — the
+  // sequence is a pure function of (seed, key, column order), so init stays
+  // deterministic and shard/host-count independent
+  uint64_t st = splitmix64(t->seed ^ splitmix64(key));
+  for (int32_t c : t->init_cols) {
+    st += 0x9E3779B97F4A7C15ull;
+    uint64_t r = splitmix64(st);  // full finalizer: real avalanche per column
+    float u = (float)(r >> 40) * (1.0f / 16777216.0f);
+    dst[c] = (2.0f * u - 1.0f) * t->init_range;
+  }
 }
 
 bool shard_open_spill(Table* t, int si) {
@@ -571,6 +570,39 @@ int64_t pbx_table_spill_cold(void* h, int64_t max_mem_rows) {
     spilled_total += victims.size();
   }
   return spilled_total;
+}
+
+// Export only the SHOW column of one shard (cache-threshold scans): out
+// must hold snapshot_count(shard, 0) floats. Disk rows get catch-up decay.
+// Returns count, or negative on IO error.
+int64_t pbx_table_shard_shows(void* h, int shard, float* out) {
+  Table* t = (Table*)h;
+  Shard* s = &t->shards[shard];
+  std::lock_guard<std::mutex> g(s->mtx);
+  int64_t n = 0;
+  for (int64_t r = 0; r < s->n_rows; ++r)
+    out[n++] = s->values[r * t->width + t->show_col];
+  if (s->n_disk > 0 && s->spill) {
+    for (uint64_t j = 0; j <= s->mask && s->mask; ++j) {
+      if (s->hstate[j] != kDisk) continue;
+      SpillRec rec;
+      float show;
+      fseeko(s->spill, s->hval[j], SEEK_SET);
+      if (fread(&rec, sizeof(rec), 1, s->spill) != 1 ||
+          fseeko(s->spill, t->show_col * (off_t)sizeof(float), SEEK_CUR) != 0 ||
+          fread(&show, sizeof(float), 1, s->spill) != 1)
+        return -2;
+      int64_t missed = t->epoch - rec.epoch;
+      if (missed > 0 && t->last_decay < 1.0f) {
+        float d = 1.0f;
+        for (int64_t i = 0; i < missed; ++i) d *= t->last_decay;
+        show *= d;
+      }
+      out[n++] = show;
+    }
+    fseeko(s->spill, 0, SEEK_END);
+  }
+  return n;
 }
 
 // Drop all touched flags (after a load, which arrives via push).

@@ -19,6 +19,7 @@ Everything here delegates; no behavior lives in the façade.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional, Sequence
 
 from paddlebox_tpu.metrics.registry import MetricRegistry
@@ -114,17 +115,17 @@ class BoxWrapper:
         """Hot-key serving cache (save_cache_model parity, pslib
         __init__.py:386-425): derive the show threshold admitting
         ``cache_rate`` of keys, write them under <date>/cache/, return the
-        feasign count."""
-        import os
+        feasign count.
 
+        Call between passes (the reference brackets the same two-phase
+        protocol in worker barriers): a push landing between the threshold
+        scan and the save shifts the cut."""
         thr = self.table.cache_threshold(cache_rate)
         return self.table.save_cache(os.path.join(root, date, "cache"), thr)
 
     def save_model_with_whitelist(self, root: str, date: str, whitelist) -> int:
         """Whitelisted-keys snapshot (save_model_with_whitelist parity,
         pslib __init__.py:351-384) under <date>/whitelist/."""
-        import os
-
         return self.table.save_with_whitelist(
             os.path.join(root, date, "whitelist"), whitelist
         )

@@ -170,10 +170,16 @@ class HostSparseTable:
 
     def keys(self) -> np.ndarray:
         """All keys currently stored (mem + disk tiers), unsorted."""
-        parts = [
-            self._snapshot_shard(s, only_touched=False, clear_touched=False)[0]
-            for s in range(self.n_shards)
-        ]
+        if self._native is not None:
+            parts = [
+                self._native.snapshot_shard(s, only_touched=False, clear_touched=False)[0]
+                for s in range(self.n_shards)
+            ]
+        else:  # keys-only fast path: no value-matrix copies
+            parts = [
+                np.fromiter(sh.index.keys(), dtype=np.uint64, count=len(sh.index))
+                for sh in self._shards
+            ]
         return np.concatenate(parts) if parts else np.zeros(0, np.uint64)
 
     def _init_rows(self, n: int) -> np.ndarray:
@@ -365,15 +371,21 @@ class HostSparseTable:
 
         Computed over the exact show distribution, so heavy ties (many
         cold keys sharing tiny counts) can't silently blow the cache up to
-        the whole table — the closest achievable fraction wins. One
-        show-column copy per shard is held, never the value matrices."""
+        the whole table — the closest achievable fraction wins. The native
+        store exports only the show column per shard; the Python fallback
+        reads one column from its shard arrays."""
         if not 0.0 < cache_rate <= 1.0:
             raise ValueError(f"cache_rate must be in (0, 1], got {cache_rate}")
         shows = []
         for s in range(self.n_shards):
-            _, vals = self._snapshot_shard(s, only_touched=False, clear_touched=False)
-            if len(vals):
-                shows.append(vals[:, self.layout.SHOW].copy())
+            if self._native is not None:
+                col = self._native.shard_shows(s)
+            else:
+                shard = self._shards[s]
+                with shard.lock:
+                    col = shard.values[: len(shard.index), self.layout.SHOW].copy()
+            if len(col):
+                shows.append(col)
         if not shows:
             return 0.0
         allshow = np.concatenate(shows)

@@ -146,6 +146,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.pbx_table_spill_cold.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.pbx_table_clear_touched.restype = None
         lib.pbx_table_clear_touched.argtypes = [ctypes.c_void_p]
+        lib.pbx_table_shard_shows.restype = ctypes.c_int64
+        lib.pbx_table_shard_shows.argtypes = [ctypes.c_void_p, ctypes.c_int, _f32p]
         lib.pbx_table_snapshot_count.restype = ctypes.c_int64
         lib.pbx_table_snapshot_count.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
@@ -323,6 +325,21 @@ class NativeHostStore:
 
     def clear_touched(self) -> None:
         self._lib.pbx_table_clear_touched(self._h)
+
+    def shard_shows(self, shard: int) -> np.ndarray:
+        """SHOW column of one shard (mem + disk, catch-up decay applied) —
+        a column-only export so threshold scans never materialize value
+        matrices."""
+        n = int(self._lib.pbx_table_snapshot_count(self._h, shard, 0))
+        out = np.empty(n, np.float32)
+        if n:
+            got = int(self._lib.pbx_table_shard_shows(
+                self._h, shard, _as_ptr(out, ctypes.c_float)
+            ))
+            if got < 0:
+                raise IOError(f"native shard_shows failed rc={got}")
+            out = out[:got]
+        return out
 
     def snapshot_shard(self, shard: int, only_touched: bool, clear_touched: bool):
         n = int(self._lib.pbx_table_snapshot_count(self._h, shard, int(only_touched)))

@@ -171,7 +171,7 @@ def test_boxwrapper_cache_and_whitelist_surface(tmp_path):
     assert nw == 5
 
 
-def test_cache_threshold_tie_resistant():
+def test_cache_threshold_tie_resistant(tmp_path):
     """Heavy show ties (cold keys at 0) must not blow the cache up to the
     whole table: the closest achievable fraction wins."""
     t = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
@@ -182,4 +182,4 @@ def test_cache_threshold_tie_resistant():
     t.push(keys, rows)
     thr = t.cache_threshold(cache_rate=0.1)
     assert thr == 50.0  # NOT 0.0 (which would admit everything)
-    assert t.save_cache("/tmp/ignore-cache-test", thr) == 100
+    assert t.save_cache(str(tmp_path / "cache"), thr) == 100
