@@ -572,18 +572,20 @@ int64_t pbx_table_spill_cold(void* h, int64_t max_mem_rows) {
   return spilled_total;
 }
 
-// Export only the SHOW column of one shard (cache-threshold scans): out
-// must hold snapshot_count(shard, 0) floats. Disk rows get catch-up decay.
-// Returns count, or negative on IO error.
-int64_t pbx_table_shard_shows(void* h, int shard, float* out) {
+// Export only the SHOW column of one shard (cache-threshold scans): at
+// most `cap` floats are written (the caller sized the buffer from
+// snapshot_count; a concurrent push between the two calls must clamp, not
+// overrun). Disk rows get catch-up decay. Returns floats written, or
+// negative on IO error.
+int64_t pbx_table_shard_shows(void* h, int shard, float* out, int64_t cap) {
   Table* t = (Table*)h;
   Shard* s = &t->shards[shard];
   std::lock_guard<std::mutex> g(s->mtx);
   int64_t n = 0;
-  for (int64_t r = 0; r < s->n_rows; ++r)
+  for (int64_t r = 0; r < s->n_rows && n < cap; ++r)
     out[n++] = s->values[r * t->width + t->show_col];
   if (s->n_disk > 0 && s->spill) {
-    for (uint64_t j = 0; j <= s->mask && s->mask; ++j) {
+    for (uint64_t j = 0; j <= s->mask && s->mask && n < cap; ++j) {
       if (s->hstate[j] != kDisk) continue;
       SpillRec rec;
       float show;
@@ -602,6 +604,18 @@ int64_t pbx_table_shard_shows(void* h, int shard, float* out) {
     }
     fseeko(s->spill, 0, SEEK_END);
   }
+  return n;
+}
+
+// Export one shard's keys (mem + disk — all live in the hash, no file
+// reads). At most `cap` keys written; returns the count.
+int64_t pbx_table_shard_keys(void* h, int shard, uint64_t* out, int64_t cap) {
+  Table* t = (Table*)h;
+  Shard* s = &t->shards[shard];
+  std::lock_guard<std::mutex> g(s->mtx);
+  int64_t n = 0;
+  for (uint64_t j = 0; j <= s->mask && s->mask && n < cap; ++j)
+    if (s->hstate[j] != kEmpty) out[n++] = s->hkeys[j];
   return n;
 }
 

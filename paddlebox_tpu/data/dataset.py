@@ -599,6 +599,12 @@ class BoxPSDataset:
         if not self.ws._finalized:
             self.device_table = self.ws.finalize(self.table, round_to=round_to)
         self.stats.keys = self.ws.n_keys
+        # monitor parity: the reference bumps STAT_total_feasign_num_in_mem
+        # as passes stage into memory (box_wrapper.cc:1282)
+        from paddlebox_tpu.utils.monitor import STAT_SET
+
+        STAT_SET("total_feasign_num_in_mem", self.stats.keys)
+        STAT_SET("total_records_in_mem", self.memory_data_size())
         self._in_pass = True
         self._guard = None
         if enable_revert:

@@ -169,17 +169,20 @@ class HostSparseTable:
         return self._size
 
     def keys(self) -> np.ndarray:
-        """All keys currently stored (mem + disk tiers), unsorted."""
+        """All keys currently stored (mem + disk tiers), unsorted.
+        Keys-only exports on both backends: no value-matrix copies, no
+        disk reads."""
         if self._native is not None:
-            parts = [
-                self._native.snapshot_shard(s, only_touched=False, clear_touched=False)[0]
-                for s in range(self.n_shards)
-            ]
-        else:  # keys-only fast path: no value-matrix copies
-            parts = [
-                np.fromiter(sh.index.keys(), dtype=np.uint64, count=len(sh.index))
-                for sh in self._shards
-            ]
+            parts = [self._native.shard_keys(s) for s in range(self.n_shards)]
+        else:
+            parts = []
+            for sh in self._shards:
+                with sh.lock:
+                    parts.append(
+                        np.fromiter(
+                            sh.index.keys(), dtype=np.uint64, count=len(sh.index)
+                        )
+                    )
         return np.concatenate(parts) if parts else np.zeros(0, np.uint64)
 
     def _init_rows(self, n: int) -> np.ndarray:

@@ -578,6 +578,17 @@ class CTRTrainer:
             out["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
             out["nan_batches"] = 0.0
         out["batches"] = float(len(losses))
+        if not eval_mode:
+            # monitor parity: training-lifecycle counters (an eval pass
+            # trains nothing, so it bumps nothing). ins_num counts REAL
+            # instances (AUC-masked: no ghosts, no skipped batches);
+            # samples_processed is device throughput incl. wraparound pads.
+            from paddlebox_tpu.utils.monitor import STAT_ADD
+
+            STAT_ADD("train_batches", len(losses))
+            STAT_ADD("train_samples_processed", len(losses) * self.cfg.batch_size)
+            STAT_ADD("train_ins_num", out.get("ins_num", 0))
+            STAT_ADD("nan_skipped_batches", out["nan_batches"])
         if profile:
             out["profile"] = {
                 "feed_wait_s": round(t_feed.elapsed_sec(), 4),

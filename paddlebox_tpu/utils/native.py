@@ -147,7 +147,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.pbx_table_clear_touched.restype = None
         lib.pbx_table_clear_touched.argtypes = [ctypes.c_void_p]
         lib.pbx_table_shard_shows.restype = ctypes.c_int64
-        lib.pbx_table_shard_shows.argtypes = [ctypes.c_void_p, ctypes.c_int, _f32p]
+        lib.pbx_table_shard_shows.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, _f32p, ctypes.c_int64,
+        ]
+        lib.pbx_table_shard_keys.restype = ctypes.c_int64
+        lib.pbx_table_shard_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, _u64p, ctypes.c_int64,
+        ]
         lib.pbx_table_snapshot_count.restype = ctypes.c_int64
         lib.pbx_table_snapshot_count.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
@@ -329,15 +335,28 @@ class NativeHostStore:
     def shard_shows(self, shard: int) -> np.ndarray:
         """SHOW column of one shard (mem + disk, catch-up decay applied) —
         a column-only export so threshold scans never materialize value
-        matrices."""
+        matrices. The C side clamps to the buffer size, so a concurrent
+        push between sizing and export cannot overrun."""
         n = int(self._lib.pbx_table_snapshot_count(self._h, shard, 0))
         out = np.empty(n, np.float32)
         if n:
             got = int(self._lib.pbx_table_shard_shows(
-                self._h, shard, _as_ptr(out, ctypes.c_float)
+                self._h, shard, _as_ptr(out, ctypes.c_float), n
             ))
             if got < 0:
                 raise IOError(f"native shard_shows failed rc={got}")
+            out = out[:got]
+        return out
+
+    def shard_keys(self, shard: int) -> np.ndarray:
+        """Keys of one shard straight from the hash (no value copies, no
+        disk reads); clamped to the sized buffer like shard_shows."""
+        n = int(self._lib.pbx_table_snapshot_count(self._h, shard, 0))
+        out = np.empty(n, np.uint64)
+        if n:
+            got = int(self._lib.pbx_table_shard_keys(
+                self._h, shard, _as_ptr(out, ctypes.c_uint64), n
+            ))
             out = out[:got]
         return out
 

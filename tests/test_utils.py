@@ -253,3 +253,53 @@ def test_train_pass_chrome_trace(tmp_path):
     names = {e["name"] for e in _json.load(open(out))["traceEvents"]}
     assert {"feed_wait", "train_step_dispatch", "pack+upload"} <= names
     PROFILER.reset()
+
+
+def test_stat_registry_wired_into_runtime(tmp_path):
+    """Monitor parity: passes bump the process STAT registry
+    (STAT_total_feasign_num_in_mem, box_wrapper.cc:1282)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+    from paddlebox_tpu.models import LogisticRegression
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+    from paddlebox_tpu.utils.monitor import STAT_GET, STAT_RESET
+
+    STAT_RESET()
+    rng = np.random.default_rng(0)
+    path = tmp_path / "d.txt"
+    with open(path, "w") as f:
+        for _ in range(64):
+            keys = rng.integers(1, 100, 3)
+            f.write(f"1 {int(keys[0]) % 2}.0 " + " ".join(f"1 {k}" for k in keys) + "\n")
+    layout = ValueLayout(embedx_dim=4)
+    opt = SparseOptimizerConfig(embedx_threshold=0.0)
+    table = HostSparseTable(layout, opt, n_shards=2, seed=0)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(3)],
+        label_slot="label",
+    )
+    ds = BoxPSDataset(schema, table, batch_size=16, seed=0)
+    ds.set_filelist([str(path)])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+    assert STAT_GET("total_records_in_mem") == 64
+    assert STAT_GET("total_feasign_num_in_mem") == ds.stats.keys > 0
+    model = LogisticRegression(num_slots=3, feat_width=layout.pull_width)
+    cfg = TrainStepConfig(num_slots=3, batch_size=16, layout=layout,
+                          sparse_opt=opt, auc_buckets=100)
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    tr.train_pass(ds)
+    assert STAT_GET("train_batches") == 4
+    assert STAT_GET("train_samples_processed") == 64
+    assert STAT_GET("train_ins_num") == 64
+    STAT_RESET()
