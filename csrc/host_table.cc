@@ -22,12 +22,14 @@
 // ABI: plain C, handle-based, ctypes-bound (utils/native.py); all calls are
 // thread-safe via per-shard mutexes.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <string>
 #include <thread>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -187,7 +189,9 @@ bool shard_open_spill(Table* t, int si) {
 // Promote a disk entry at hash slot j to a mem row, applying catch-up
 // decay for the passes it slept through. Returns the new row id, or -1 if
 // the decayed row falls below the shrink threshold (entry is dropped).
-int64_t promote(Table* t, Shard* s, uint64_t j) {
+// seek_end=false defers the append-position restore (batched promotes
+// seek once at the end so stdio read-ahead survives across reads).
+int64_t promote(Table* t, Shard* s, uint64_t j, bool seek_end = true) {
   int64_t off = s->hval[j];
   SpillRec rec;
   std::vector<float> buf(t->width);
@@ -195,7 +199,7 @@ int64_t promote(Table* t, Shard* s, uint64_t j) {
   if (fread(&rec, sizeof(rec), 1, s->spill) != 1 ||
       fread(buf.data(), sizeof(float), t->width, s->spill) != (size_t)t->width)
     return -2;  // IO error
-  fseeko(s->spill, 0, SEEK_END);
+  if (seek_end) fseeko(s->spill, 0, SEEK_END);
   int64_t missed = t->epoch - rec.epoch;
   if (missed > 0 && t->last_decay < 1.0f) {
     float d = 1.0f;
@@ -342,6 +346,30 @@ int pbx_table_pull_or_create(void* h, const uint64_t* keys, int64_t n,
     // instead of ~log2(m) incremental doublings on first-pass creates
     while ((s->mask + 1) * 7 < (uint64_t)(s->n_used + m + 1) * 10)
       shard_grow_hash(s);
+    // pass-finalize pattern: a pass's working set promotes MANY disk rows
+    // at once — read them in file-offset order (sequential-ish IO, no
+    // per-read seek-to-end) instead of key order. Skipped when the disk
+    // tier is tiny: the extra O(m) probe pass would cost more than the few
+    // inline promotes the main loop handles anyway.
+    if (s->n_disk >= 64) {
+      std::vector<std::pair<int64_t, uint64_t>> hits;  // (offset, key)
+      for (int64_t q = 0; q < m; ++q) {
+        bool found;
+        uint64_t j = shard_find(s, keys[idx[q]], &found);
+        if (found && s->hstate[j] == kDisk)
+          hits.emplace_back(s->hval[j], s->hkeys[j]);
+      }
+      std::sort(hits.begin(), hits.end());
+      for (auto& hit : hits) {
+        bool found;
+        uint64_t j = shard_find(s, hit.second, &found);
+        if (!found || s->hstate[j] != kDisk) continue;
+        int64_t r = promote(t, s, j, /*seek_end=*/false);
+        if (r == -2) return -2;  // IO error (-1 lazily shrunk: main loop
+                                 // recreates the key fresh below)
+      }
+      if (!hits.empty()) fseeko(s->spill, 0, SEEK_END);
+    }
     for (int64_t q = 0; q < m; ++q) {
       int64_t i = idx[q];
       uint64_t key = keys[i];
