@@ -33,6 +33,7 @@ from paddlebox_tpu.parallel.sharded_pullpush import sharded_pull, sharded_push
 from paddlebox_tpu.train.train_step import (
     TrainState,
     TrainStepConfig,
+    adjusted_loss_weight,
     local_forward_backward,
     scale_and_merge_grads,
 )
@@ -168,14 +169,20 @@ def make_sharded_train_step(
         # (This holds in kstep mode too — the sparse table is SHARED, so its
         # grads always need the global denominator; only the dense update
         # goes local, via a rescale below.)
-        if ins_weight is not None:
-            loss_denom = jnp.maximum(
-                jax.lax.psum(jnp.sum(ins_weight), ax), 1.0
+        adjust = cfg.adjust_ins_weight is not None and not eval_mode
+        if ins_weight is not None or adjust:
+            # weighted loss normalizes by the GLOBAL real-instance count
+            local_denom = (
+                jnp.asarray(float(b))
+                if ins_weight is None
+                else jnp.sum(ins_weight)
             )
+            loss_denom = jnp.maximum(jax.lax.psum(local_denom, ax), 1.0)
             grad_div = 1.0
         else:
             loss_denom = None
             grad_div = float(plan.n_devices)
+        weighted = ins_weight is not None or adjust
         # kstep keeps per-device dense replicas, zero keeps per-device
         # moment chunks: both strip their leading device axis here
         params = (
@@ -186,9 +193,12 @@ def make_sharded_train_step(
             if (kstep or is_zero)
             else state.opt_state
         )
+        loss_w = ins_weight
+        if adjust:
+            loss_w, _ = adjusted_loss_weight(cfg, flat, segments, ins_weight, b)
         loss, preds, gparams, gflat = local_forward_backward(
             model_apply, cfg, params, flat, segments, labels, dense,
-            ins_weight=ins_weight, rank_offset=rank_offset,
+            ins_weight=loss_w, rank_offset=rank_offset,
             loss_denom=loss_denom, eval_mode=eval_mode,
         )
         if eval_mode:
@@ -250,13 +260,17 @@ def make_sharded_train_step(
             # LocalSGD: dense update uses LOCAL grads. Weighted grads came
             # out against the global denominator (sparse correctness), so
             # rescale them to this device's local weighted mean.
-            if ins_weight is not None:
-                local_w = jnp.maximum(jnp.sum(ins_weight), 1.0)
+            if weighted:
+                local_w = (
+                    jnp.asarray(float(b))
+                    if ins_weight is None
+                    else jnp.maximum(jnp.sum(ins_weight), 1.0)
+                )
                 gparams = jax.tree.map(lambda g: g * (loss_denom / local_w), gparams)
                 loss = jax.lax.psum(loss, ax)
             else:
                 loss = jax.lax.pmean(loss, ax)
-        elif ins_weight is not None:
+        elif weighted:
             gparams = jax.lax.psum(gparams, ax)
             loss = jax.lax.psum(loss, ax)
         else:

@@ -77,8 +77,23 @@ class TrainStepConfig:
     # SKIPPED in its entirety — no sparse push, no dense update, no AUC —
     # instead of silently poisoning the table; metrics report nan_skipped.
     check_nan: bool = False
+    # AdjustInsWeight parity (downpour_worker.cc:271-340): up-weight the
+    # LOSS of instances whose nid slot's show count is under threshold —
+    # w = max(w, log(e + (T - nid_show)/T * ratio)) — so rarely-shown ads
+    # still learn. (nid_slot_index, threshold, ratio); the nid slot is
+    # assumed single-feasign like the reference. Only the loss weight
+    # changes: show/clk counters keep their unweighted (or pv-ghost 0/1)
+    # semantics, exactly as the reference's push records do.
+    adjust_ins_weight: Optional[tuple] = None
 
     def __post_init__(self):
+        if self.adjust_ins_weight is not None:
+            nid, thr, ratio = self.adjust_ins_weight
+            if not (0 <= nid < self.num_slots) or thr <= 0 or ratio < 0:
+                raise ValueError(
+                    f"adjust_ins_weight=(nid_slot, threshold>0, ratio>=0), "
+                    f"got {self.adjust_ins_weight!r} with {self.num_slots} slots"
+                )
         if self.dense_sync_mode not in ("step", "kstep", "async"):
             raise ValueError(
                 f"dense_sync_mode {self.dense_sync_mode!r} not in "
@@ -209,6 +224,44 @@ def scale_and_merge_grads(
     return merged, show, clk
 
 
+def adjusted_loss_weight(
+    cfg: TrainStepConfig,
+    flat: jnp.ndarray,  # [L, PW(+E)] pulled records (col 0 = show)
+    segments: jnp.ndarray,  # [L]
+    ins_weight: Optional[jnp.ndarray],  # [b] pv/ghost weights or None
+    b: int,
+):
+    """(loss_weight [b], loss_denom scalar-or-None) for AdjustInsWeight.
+
+    Shared by both step builders: nid_show per instance comes from the nid
+    slot's pulled show column (single-feasign slot, downpour_worker.cc:310
+    asserts the same); the denominator stays the REAL-instance count so
+    up-weighting doesn't silently renormalize away.
+    """
+    nid, thr, ratio = cfg.adjust_ins_weight
+    S = cfg.num_slots
+    slot_of_key = segments // b
+    ins_of_key = segments % b
+    is_nid = (slot_of_key == nid) & (segments < S * b)
+    nid_show = jax.ops.segment_max(
+        jnp.where(is_nid, flat[:, 0], -jnp.inf), ins_of_key, num_segments=b
+    )
+    base = ins_weight if ins_weight is not None else jnp.ones((b,), jnp.float32)
+    adj = jnp.log(jnp.e + (thr - nid_show) / thr * ratio)
+    loss_w = jnp.where(
+        (nid_show >= 0) & (nid_show < thr), jnp.maximum(base, adj), base
+    )
+    # weight-0 ghosts (pv padding carries a REAL ad's nid) must stay
+    # exactly zero — up-weighting may never resurrect them
+    loss_w = jnp.where(base > 0, loss_w, base)
+    denom = (
+        jnp.asarray(float(b))
+        if ins_weight is None
+        else jnp.maximum(jnp.sum(ins_weight), 1.0)
+    )
+    return loss_w, denom
+
+
 def make_train_step(
     model_apply: Callable,
     dense_opt: optax.GradientTransformation,
@@ -249,10 +302,15 @@ def make_train_step(
             )  # [U, PW]
         flat = jnp.take(pulled_u, inverse, axis=0)  # [L, PW(+E)]
 
+        loss_w, loss_denom = ins_weight, None
+        if cfg.adjust_ins_weight is not None and not eval_mode:
+            loss_w, loss_denom = adjusted_loss_weight(
+                cfg, flat, segments, ins_weight, B
+            )
         loss, preds, gparams, gflat = local_forward_backward(
             model_apply, cfg, state.params, flat, segments, labels, dense,
-            ins_weight=ins_weight, rank_offset=rank_offset,
-            eval_mode=eval_mode,
+            ins_weight=loss_w, rank_offset=rank_offset,
+            loss_denom=loss_denom, eval_mode=eval_mode,
         )
         finite = None
         if cfg.check_nan and not eval_mode:

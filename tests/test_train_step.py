@@ -132,3 +132,174 @@ def test_train_step_deterministic(schema):
     l1, m1, *_ = run_training(LogisticRegression, schema, steps=10)
     l2, m2, *_ = run_training(LogisticRegression, schema, steps=10)
     np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_adjust_ins_weight_formula_and_effect():
+    """AdjustInsWeight parity (downpour_worker.cc:271-340): instances whose
+    nid slot's show is under threshold get loss weight
+    log(e + (T-show)/T * ratio); counters stay unweighted."""
+    import math
+
+    from paddlebox_tpu.data.device_pack import pack_batch
+    from paddlebox_tpu.table import PassWorkingSet
+
+    rng = np.random.default_rng(0)
+    layout = ValueLayout(embedx_dim=4)
+    opt_cfg = SparseOptimizerConfig(embedx_threshold=0.0, initial_range=0.01)
+    NS, B_, T, RATIO = 2, 8, 10.0, 5.0
+    # nid slot = slot 0, single feasign per instance
+    recs = []
+    for i in range(B_):
+        keys = np.array([100 + i, 200 + i], dtype=np.uint64)
+        recs.append(SlotRecord(
+            u64_values=keys, u64_offsets=np.array([0, 1, 2], np.uint32),
+            f_values=np.array([float(i % 2)], np.float32),
+            f_offsets=np.array([0, 1], np.uint32),
+        ))
+    sch = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1),
+         SlotInfo("nid"), SlotInfo("s1")],
+        label_slot="label",
+    )
+    table = HostSparseTable(layout, opt_cfg, n_shards=2, seed=0)
+    ws = PassWorkingSet()
+    for r in recs:
+        ws.add_keys(r.u64_values)
+    dev = ws.finalize(table, round_to=32)
+    flat0 = dev.reshape(-1, layout.width)
+    # plant nid shows: half under threshold, half over
+    nid_keys = np.array([100 + i for i in range(B_)], np.uint64)
+    nid_rows = ws.lookup(nid_keys)
+    planted = np.array([0.0, 2.0, 5.0, 9.0, 10.0, 50.0, 100.0, 3.0], np.float32)
+    flat0[nid_rows, layout.SHOW] = planted
+
+    model = LogisticRegression(num_slots=NS, feat_width=layout.pull_width)
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=B_, layout=layout, sparse_opt=opt_cfg,
+        auc_buckets=100, adjust_ins_weight=(0, T, RATIO),
+    )
+    from paddlebox_tpu.train.train_step import adjusted_loss_weight
+
+    batch = build_batch(recs, sch)
+    db = pack_batch(batch, ws, sch, bucket=32)
+    # reproduce the step's internal pull to check the weight math
+    from paddlebox_tpu.ops.pull_push import pull_sparse_rows
+
+    pulled = pull_sparse_rows(
+        jnp.asarray(flat0), jnp.asarray(db.uniq_rows), layout, 0.0, 1.0
+    )
+    flat = jnp.take(pulled, jnp.asarray(db.inverse), axis=0)
+    w, denom = adjusted_loss_weight(cfg, flat, jnp.asarray(db.segments), None, B_)
+    want = np.array([
+        math.log(math.e + (T - s) / T * RATIO) if s < T else 1.0
+        for s in planted
+    ])
+    np.testing.assert_allclose(np.asarray(w), want, rtol=1e-6)
+    assert float(denom) == B_
+
+    # end to end: the step runs and under-shown instances move their nid
+    # embedding MORE than well-shown ones (per unit gradient)
+    step = jit_train_step(make_train_step(model.apply, optax.adam(1e-2), cfg))
+    state = init_train_state(
+        jnp.asarray(flat0), model.init(jax.random.PRNGKey(0)), optax.adam(1e-2), 100
+    )
+    state, m = step(state, {k: jnp.asarray(v) for k, v in db.as_dict().items()})
+    assert np.isfinite(float(m["loss"]))
+    newt = np.asarray(state.table)
+    # show counters incremented by exactly 1 (unweighted counts)
+    np.testing.assert_allclose(newt[nid_rows, layout.SHOW], planted + 1.0, rtol=1e-6)
+
+
+def test_adjust_ins_weight_mesh_matches_single_device():
+    from paddlebox_tpu.data.device_pack import pack_batch, pack_batch_sharded
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.table import PassWorkingSet
+    from paddlebox_tpu.train.sharded_step import (
+        init_sharded_train_state,
+        make_sharded_train_step,
+    )
+
+    rng = np.random.default_rng(1)
+    layout = ValueLayout(embedx_dim=4)
+    opt_cfg = SparseOptimizerConfig(embedx_threshold=0.0, initial_range=0.01)
+    NS, N_DEV, B_ = 2, 4, 16
+    recs = []
+    for i in range(B_):
+        keys = rng.integers(1, 60, NS).astype(np.uint64)
+        recs.append(SlotRecord(
+            u64_values=keys, u64_offsets=np.arange(NS + 1, dtype=np.uint32),
+            f_values=np.array([float(keys[0] % 2)], np.float32),
+            f_offsets=np.array([0, 1], np.uint32),
+        ))
+    sch = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1),
+         SlotInfo("nid"), SlotInfo("s1")],
+        label_slot="label",
+    )
+    model = LogisticRegression(num_slots=NS, feat_width=layout.pull_width)
+
+    def run(mesh):
+        table = HostSparseTable(layout, opt_cfg, n_shards=2, seed=0)
+        ws = PassWorkingSet(n_mesh_shards=N_DEV if mesh else 1)
+        for r in recs:
+            ws.add_keys(r.u64_values)
+        dev = ws.finalize(table, round_to=32)
+        cfg = TrainStepConfig(
+            num_slots=NS, batch_size=(B_ // N_DEV) if mesh else B_,
+            layout=layout, sparse_opt=opt_cfg, auc_buckets=100,
+            adjust_ins_weight=(0, 10.0, 5.0),
+            axis_name="dp" if mesh else None,
+        )
+        batch = build_batch(recs, sch)
+        if mesh:
+            plan = make_mesh(N_DEV)
+            step = make_sharded_train_step(model.apply, optax.adam(1e-2), cfg, plan)
+            state = init_sharded_train_state(
+                plan, dev, model.init(jax.random.PRNGKey(0)), optax.adam(1e-2), 100
+            )
+            db = pack_batch_sharded(batch, ws, sch, N_DEV, bucket=32)
+            feed = {
+                k: jax.device_put(v, plan.batch_sharding)
+                for k, v in db.as_dict().items()
+            }
+        else:
+            step = jit_train_step(make_train_step(model.apply, optax.adam(1e-2), cfg))
+            state = init_train_state(
+                jnp.asarray(dev.reshape(-1, layout.width)),
+                model.init(jax.random.PRNGKey(0)), optax.adam(1e-2), 100,
+            )
+            feed = None
+            db = pack_batch(batch, ws, sch, bucket=64)
+            feed = {k: jnp.asarray(v) for k, v in db.as_dict().items()}
+        state, m = step(state, feed)
+        keys = ws.sorted_keys
+        tbl = np.asarray(state.table).reshape(-1, layout.width)
+        return float(m["loss"]), tbl[ws.lookup(keys)], keys
+
+    l1, t1, k1 = run(False)
+    lN, tN, kN = run(True)
+    np.testing.assert_allclose(l1, lN, rtol=1e-5)
+    np.testing.assert_array_equal(k1, kN)
+    np.testing.assert_allclose(t1, tN, rtol=1e-4, atol=1e-6)
+
+
+def test_adjust_ins_weight_never_resurrects_ghosts():
+    """pv ghosts carry a real ad's nid; up-weighting must keep their loss
+    weight at exactly zero."""
+    from paddlebox_tpu.train.train_step import adjusted_loss_weight
+
+    layout = ValueLayout(embedx_dim=4)
+    cfg = TrainStepConfig(
+        num_slots=2, batch_size=4, layout=layout,
+        sparse_opt=SparseOptimizerConfig(), auc_buckets=10,
+        adjust_ins_weight=(0, 10.0, 5.0),
+    )
+    # 4 instances, nid slot single key each; all shows cold (0.0)
+    flat = jnp.zeros((8, layout.pull_width), jnp.float32)
+    segments = jnp.array([0, 1, 2, 3, 4, 5, 6, 7], jnp.int32)  # slot0 ins0-3, slot1 ins0-3
+    ghosts = jnp.array([1.0, 1.0, 0.0, 0.0], jnp.float32)  # last two = ghosts
+    w, denom = adjusted_loss_weight(cfg, flat, segments, ghosts, 4)
+    w = np.asarray(w)
+    assert w[0] > 1.0 and w[1] > 1.0  # cold real ads up-weighted
+    assert w[2] == 0.0 and w[3] == 0.0  # ghosts stay exactly zero
+    assert float(denom) == 2.0  # real-instance count
