@@ -183,8 +183,13 @@ def main():
         trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-3))
         trainer.init_params(jax.random.PRNGKey(0))
 
-        # warmup: compile the step + prime packer scratch
-        trainer.train_pass(ds, n_batches=4)
+        # warmup: compile the step + prime packer scratch. Must cover one
+        # full resident superstep chunk so the scan-K program compiles here,
+        # not inside the timed pass.
+        from paddlebox_tpu import config as _config
+
+        warm = max(4, int(_config.get_flag("resident_scan_batches")))
+        trainer.train_pass(ds, n_batches=warm)
 
         t0 = time.perf_counter()
         out = trainer.train_pass(ds, n_batches=TRAIN_BATCHES, profile=profile)

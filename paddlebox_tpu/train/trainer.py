@@ -36,6 +36,10 @@ from paddlebox_tpu.train.sharded_step import (
     kstep_sync_params,
     make_sharded_train_step,
 )
+from paddlebox_tpu.train.resident_step import (
+    ResidentPass,
+    make_resident_superstep,
+)
 from paddlebox_tpu.train.train_step import (
     TrainState,
     TrainStepConfig,
@@ -44,6 +48,17 @@ from paddlebox_tpu.train.train_step import (
 )
 from paddlebox_tpu.utils.dump import DumpWorkerPool, dump_fields, dump_param
 from paddlebox_tpu.utils.trace import PROFILER
+from paddlebox_tpu import config
+
+config.define_flag(
+    "max_inflight_steps",
+    4,
+    "cap on dispatched-but-unfinished device steps; 0 = unbounded. Keeps "
+    "the async dispatch queue shallow: enough depth to hide host->device "
+    "round-trip latency behind compute, shallow enough that transfers and "
+    "executions don't pile up on the transport (an unbounded queue measured "
+    "3x slower end-to-end on a tunneled TPU than a depth-2 window)",
+)
 
 
 class CTRTrainer:
@@ -397,6 +412,172 @@ class CTRTrainer:
                 ins_ids=ids,
             )
 
+    def _classic_stepper(
+        self, iterator, holder, step_fn, is_async, profile, t_feed, t_disp, t_dev
+    ):
+        """Per-batch dispatch over a host-packed feed iterator.
+
+        Yields (batch_index, metrics, aux). Keeps a shallow dispatch window
+        (max_inflight_steps): deep enough to hide host->device round-trip
+        latency behind compute, shallow enough that transfers and
+        executions can't pile up on the transport (an unbounded queue
+        measured 3x slower end-to-end on a tunneled TPU than a small
+        window)."""
+        from collections import deque
+
+        max_inflight = config.get_flag("max_inflight_steps")
+        inflight: deque = deque()
+        it = iter(iterator)
+        i = 0
+        while True:
+            t_feed.start()
+            try:
+                with PROFILER.record_event("feed_wait", "pass"):
+                    feed, aux = next(it)
+            except StopIteration:
+                return
+            finally:
+                t_feed.pause()  # idempotent
+            if is_async:  # PullDense / PushDense worker loop (B6)
+                holder["state"] = holder["state"]._replace(
+                    params=jax.device_put(self.async_dense.pull_dense())
+                )
+            t_disp.start()
+            with PROFILER.record_event("train_step_dispatch", "pass"):
+                holder["state"], m = step_fn(holder["state"], feed)
+            t_disp.pause()
+            if profile:
+                t_dev.start()
+                with PROFILER.record_event("device_step", "device"):
+                    jax.block_until_ready(m["loss"])
+                t_dev.pause()
+            elif max_inflight:
+                inflight.append(m["loss"])
+                if len(inflight) > max_inflight:
+                    t_dev.start()
+                    jax.block_until_ready(inflight.popleft())
+                    t_dev.pause()
+            yield i, m, aux
+            i += 1
+
+    def _get_resident(self, dataset):
+        """Pass-scoped ResidentPass cache (same lifetime as the packer:
+        rebuilt when the store or working set changes)."""
+        c = getattr(self, "_resident_cache", None)
+        if c is not None and c[0] is dataset.store and c[1] is dataset.ws:
+            return c[2]
+        # release the PREVIOUS pass's device arrays (and the jitted
+        # supersteps whose closures pin them) BEFORE uploading the new
+        # pass's set — otherwise both passes' resident arrays coexist in
+        # HBM during prepare, doubling peak device memory
+        self._resident_cache = None
+        self._sstep_cache = {}
+        rp = ResidentPass(
+            dataset.store,
+            dataset.ws,
+            self._schema,
+            dense_slot=self.dense_slot,
+            dense_dim=self.dense_dim,
+            bucket=self.pack_bucket,
+        )
+        self._resident_cache = (dataset.store, dataset.ws, rp)
+        return rp
+
+    def _resident_superstep(self, rp, eval_mode):
+        # keyed cache (not a single slot): a per-pass train -> eval -> train
+        # alternation must reuse both compiled scan programs, like the
+        # classic path keeps _step and _eval_step_cache alive side by side
+        cache = getattr(self, "_sstep_cache", None)
+        if cache is None:
+            cache = self._sstep_cache = {}
+        key = (id(rp), eval_mode, rp.L_pad, rp.U_pad)
+        ss = cache.get(key)
+        if ss is None:
+            ss = cache[key] = make_resident_superstep(
+                self.model.apply, self.dense_opt, self.cfg, rp,
+                eval_mode=eval_mode,
+            )
+        return ss
+
+    def _resident_stepper(
+        self, dataset, n_batches, holder, eval_mode, profile, t_feed, t_disp, t_dev
+    ):
+        """Superstep dispatch: K batches per lax.scan call, index-only feed.
+
+        Yields the same (batch_index, metrics, aux) stream as the classic
+        stepper — metrics are lazy scan-axis slices of the stacked chunk
+        output, so unconsumed fields never leave the device."""
+        t_feed.start()
+        with PROFILER.record_event("resident_prepare", "pass"):
+            rp = self._get_resident(dataset)
+            blocks = [
+                np.asarray(b, dtype=np.int32)
+                for b in dataset.batch_indices(n_batches)
+            ]
+            rp.ensure(blocks)
+            sstep = self._resident_superstep(rp, eval_mode)
+        t_feed.pause()
+        # profiling wants per-batch device attribution: drop to one batch
+        # per dispatch (the same overlap-for-attribution trade the classic
+        # path makes by blocking every step)
+        K = 1 if profile else max(1, int(config.get_flag("resident_scan_batches")))
+        store = dataset.store
+        has_meta = store.ins_id_off is not None
+        want_ids = has_meta and self.dump_pool is not None
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        # ins_id string extraction belongs off the dispatch thread (same
+        # rule as the prefetch worker in _fast_feed_iter): one background
+        # worker resolves a chunk's ids while its superstep runs
+        ids_ex = ThreadPoolExecutor(max_workers=1) if want_ids else None
+        try:
+            inflight: deque = deque()
+            i = 0
+            for c0 in range(0, len(blocks), K):
+                chunk = blocks[c0 : c0 + K]
+                ids_fut = (
+                    ids_ex.submit(
+                        lambda ch: [
+                            [store.ins_id(int(r)) for r in idx] for idx in ch
+                        ],
+                        chunk,
+                    )
+                    if want_ids
+                    else None
+                )
+                t_disp.start()
+                with PROFILER.record_event("superstep_dispatch", "pass"):
+                    holder["state"], mstack = sstep(
+                        holder["state"], jnp.asarray(np.stack(chunk))
+                    )
+                t_disp.pause()
+                if profile:
+                    t_dev.start()
+                    with PROFILER.record_event("device_superstep", "device"):
+                        jax.block_until_ready(mstack["loss"])
+                    t_dev.pause()
+                else:
+                    inflight.append(mstack["loss"])
+                    if len(inflight) > 1:  # double-buffer supersteps
+                        t_dev.start()
+                        jax.block_until_ready(inflight.popleft())
+                        t_dev.pause()
+                chunk_ids = ids_fut.result() if ids_fut is not None else None
+                for j, idx in enumerate(chunk):
+                    m = {k: v[j] for k, v in mstack.items()}
+                    aux = {}
+                    if has_meta:
+                        aux["cmatch"] = store.cmatch[idx]
+                        aux["rank"] = store.rank[idx]
+                    if chunk_ids is not None:
+                        aux["ins_ids"] = chunk_ids[j]
+                    yield i, m, aux
+                    i += 1
+        finally:
+            if ids_ex is not None:
+                ids_ex.shutdown(wait=False)
+
     def train_pass(
         self,
         dataset: BoxPSDataset,
@@ -428,7 +609,23 @@ class CTRTrainer:
         # weights; update phase serves flat batches (EnablePvMerge branch,
         # data_feed.cc:2165-2198)
         use_pv = dataset.pv_merged and dataset.current_phase == 1
-        if use_pv:
+        eval_mode = self._eval_active
+        is_async = self.cfg.dense_sync_mode == "async" and not eval_mode
+        # resident fast path: pass data lives in device HBM, feeds are
+        # index-only, K steps per dispatch (train/resident_step.py)
+        use_resident = (
+            bool(config.get_flag("enable_resident_feed"))
+            and self.plan is None
+            and not use_pv
+            and not is_async
+            and not self.cfg.model_takes_rank_offset
+            and dataset.store is not None
+            and len(dataset.store.u64_values) < (1 << 31)
+        )
+        iterator = None
+        if use_resident:
+            step_fn = None
+        elif use_pv:
             if self.plan is not None and jax.process_count() > 1:
                 raise NotImplementedError(
                     "join-phase pv batches are not transport-locksteped "
@@ -436,13 +633,13 @@ class CTRTrainer:
                     "the mesh); run the join phase on a single-host mesh"
                 )
             iterator = self._pv_feed_iter(dataset, n_batches)
+            step_fn = self._eval_step() if eval_mode else self._step
         elif dataset.store is not None:
             iterator = self._fast_feed_iter(dataset, n_batches)
+            step_fn = self._eval_step() if eval_mode else self._step
         else:
             iterator = self._slow_feed_iter(dataset, n_batches)
-        eval_mode = self._eval_active
-        step_fn = self._eval_step() if eval_mode else self._step
-        is_async = self.cfg.dense_sync_mode == "async" and not eval_mode
+            step_fn = self._eval_step() if eval_mode else self._step
         # AUC buckets accumulate in device state across train_pass calls
         # within one pass (warmup epochs, join/update phases, sequential
         # slot-shuffle evals); snapshot them so THIS call's metrics are a
@@ -472,32 +669,23 @@ class CTRTrainer:
         t_feed, t_disp, t_dev, t_host = Timer(), Timer(), Timer(), Timer()
         skip_flags: list = []
 
-        def timed(it):
-            while True:
-                t_feed.start()
-                try:
-                    with PROFILER.record_event("feed_wait", "pass"):
-                        item = next(it)
-                except StopIteration:
-                    return
-                finally:
-                    t_feed.pause()
-                yield item
+        # the stepper generators mutate holder["state"] as they dispatch;
+        # the consumer loop below is shared between the classic per-batch
+        # path and the resident scan path so host-side semantics (registry,
+        # dumps, NaN containment, callbacks) can never diverge
+        holder = {"state": state}
+        if use_resident:
+            stepper = self._resident_stepper(
+                dataset, n_batches, holder, eval_mode, profile,
+                t_feed, t_disp, t_dev,
+            )
+        else:
+            stepper = self._classic_stepper(
+                iterator, holder, step_fn, is_async, profile,
+                t_feed, t_disp, t_dev,
+            )
 
-        for i, (feed, aux) in enumerate(timed(iter(iterator))):
-            if is_async:  # PullDense / PushDense worker loop (B6)
-                state = state._replace(
-                    params=jax.device_put(self.async_dense.pull_dense())
-                )
-            t_disp.start()
-            with PROFILER.record_event("train_step_dispatch", "pass"):
-                state, m = step_fn(state, feed)
-            t_disp.pause()
-            if profile:
-                t_dev.start()
-                with PROFILER.record_event("device_step", "device"):
-                    jax.block_until_ready(m["loss"])
-                t_dev.pause()
+        for i, m, aux in stepper:
             t_host.start()
             if "nan_skipped" in m:  # lazy device array: no per-batch sync
                 skip_flags.append(m["nan_skipped"])
@@ -524,6 +712,7 @@ class CTRTrainer:
                 on_batch(i, m)
             losses.append(m["loss"])
             t_host.pause()
+        state = holder["state"]
         # persist dense side for the next pass; state.table stays for writeback
         if eval_mode:
             # values are bit-identical, but the OLD buffers were donated into

@@ -241,6 +241,9 @@ def test_train_pass_chrome_trace(tmp_path):
     tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
     tr.init_params(jax.random.PRNGKey(0))
 
+    from paddlebox_tpu import config as _config
+
+    # resident path (default): superstep spans
     PROFILER.reset()
     PROFILER.enable()
     try:
@@ -251,7 +254,22 @@ def test_train_pass_chrome_trace(tmp_path):
     n = PROFILER.export_chrome_trace(out)
     assert n > 0
     names = {e["name"] for e in _json.load(open(out))["traceEvents"]}
-    assert {"feed_wait", "train_step_dispatch", "pack+upload"} <= names
+    assert {"resident_prepare", "superstep_dispatch"} <= names
+
+    # classic host-packed path: per-batch feed/dispatch spans
+    prev_flag = _config.get_flag("enable_resident_feed")
+    _config.set_flag("enable_resident_feed", 0)
+    PROFILER.reset()
+    PROFILER.enable()
+    try:
+        tr.train_pass(ds)
+    finally:
+        PROFILER.disable()
+        _config.set_flag("enable_resident_feed", prev_flag)
+    out2 = str(tmp_path / "trace2.json")
+    assert PROFILER.export_chrome_trace(out2) > 0
+    names2 = {e["name"] for e in _json.load(open(out2))["traceEvents"]}
+    assert {"feed_wait", "train_step_dispatch", "pack+upload"} <= names2
     PROFILER.reset()
 
 

@@ -1,0 +1,200 @@
+"""Simulate the train_pass loop at bench shapes with different feed
+strategies (4-array dict vs one fused buffer; same-thread vs prefetch
+threads) and dispatch windows, to pick the fastest transport discipline.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.table import SparseOptimizerConfig, ValueLayout
+from paddlebox_tpu.train import TrainStepConfig
+from paddlebox_tpu.train.train_step import (
+    init_train_state,
+    jit_train_step,
+    make_train_step,
+)
+
+NUM_SLOTS = 39
+EMBEDX_DIM = 16
+BATCH = 4096
+HIDDEN = (512, 256, 128)
+ROWS = 2_514_944
+L = NUM_SLOTS * BATCH
+U = 131_072
+N_BATCHES = 48
+
+
+def make_host_batches(rng, n):
+    out = []
+    for _ in range(n):
+        out.append(
+            {
+                "uniq_rows": rng.integers(0, ROWS, U).astype(np.int32),
+                "inverse": rng.integers(0, U, L).astype(np.int32),
+                "segments": (np.arange(L) % (NUM_SLOTS * BATCH)).astype(np.int32),
+                "labels": (rng.random(BATCH) < 0.2).astype(np.float32),
+            }
+        )
+    return out
+
+
+def main():
+    layout = ValueLayout(embedx_dim=EMBEDX_DIM)
+    opt_cfg = SparseOptimizerConfig(embedx_threshold=0.0)
+    rng = np.random.default_rng(0)
+    host_table = rng.standard_normal((ROWS, layout.width)).astype(np.float32) * 0.01
+    model = DeepFM(
+        num_slots=NUM_SLOTS, feat_width=layout.pull_width,
+        embedx_dim=EMBEDX_DIM, hidden=HIDDEN,
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = TrainStepConfig(
+        num_slots=NUM_SLOTS, batch_size=BATCH, layout=layout,
+        sparse_opt=opt_cfg, auc_buckets=100_000,
+    )
+    step = jit_train_step(make_train_step(model.apply, optax.adam(1e-3), cfg))
+    host_batches = make_host_batches(rng, N_BATCHES)
+
+    # fused variant: one int32 buffer; unpack inside jit
+    def fuse(hb):
+        return np.concatenate(
+            [
+                hb["uniq_rows"],
+                hb["inverse"],
+                hb["segments"],
+                hb["labels"].view(np.int32),
+            ]
+        )
+
+    fused_batches = [fuse(hb) for hb in host_batches]
+    raw_step = make_train_step(model.apply, optax.adam(1e-3), cfg)
+
+    def step_fused_fn(state, buf):
+        o = 0
+        uniq_rows = jax.lax.dynamic_slice_in_dim(buf, o, U); o += U
+        inverse = jax.lax.dynamic_slice_in_dim(buf, o, L); o += L
+        segments = jax.lax.dynamic_slice_in_dim(buf, o, L); o += L
+        labels = jax.lax.bitcast_convert_type(
+            jax.lax.dynamic_slice_in_dim(buf, o, BATCH), jnp.float32
+        )
+        return raw_step(
+            state,
+            {
+                "uniq_rows": uniq_rows,
+                "inverse": inverse,
+                "segments": segments,
+                "labels": labels,
+            },
+        )
+
+    step_fused = jax.jit(step_fused_fn, donate_argnums=(0,))
+
+    def run(name, mode, inflight_cap, workers=3, depth=6):
+        table = jax.device_put(host_table)
+        jax.block_until_ready(table)
+        # fresh params per run: the step donates state, so a prior run's
+        # params buffers are dead
+        state = init_train_state(
+            table, model.init(jax.random.PRNGKey(0)), optax.adam(1e-3), 100_000
+        )
+        ex = ThreadPoolExecutor(workers)
+
+        if mode == "dict":
+            put = lambda i: {
+                k: jax.device_put(v) for k, v in host_batches[i % len(host_batches)].items()
+            }
+            stepf = step
+        else:
+            put = lambda i: jax.device_put(fused_batches[i % len(fused_batches)])
+            stepf = step_fused
+
+        # warmup/compile
+        st, m = stepf(state, put(0))
+        jax.block_until_ready(m["loss"])
+        state = st
+
+        futs: deque = deque()
+        for i in range(min(depth, N_BATCHES)):
+            futs.append(ex.submit(put, i))
+        inflight: deque = deque()
+        t0 = time.perf_counter()
+        for i in range(N_BATCHES):
+            feed = futs.popleft().result()
+            nxt = i + depth
+            if nxt < N_BATCHES:
+                futs.append(ex.submit(put, nxt))
+            state, m = stepf(state, feed)
+            inflight.append(m["loss"])
+            if len(inflight) > inflight_cap:
+                jax.block_until_ready(inflight.popleft())
+        final_loss = float(m["loss"])  # forces the full chain
+        jax.block_until_ready(state.table)
+        dt = time.perf_counter() - t0
+        sps = N_BATCHES * BATCH / dt
+        print(f"{name:34s} {dt/N_BATCHES*1e3:8.2f} ms/batch  {sps:10.0f} sps  loss={final_loss:.4f}")
+        ex.shutdown(wait=False)
+
+    def run_steps_only(name, inflight_cap):
+        """Preload every feed to the device first: pure step throughput."""
+        table = jax.device_put(host_table)
+        jax.block_until_ready(table)
+        state = init_train_state(
+            table, model.init(jax.random.PRNGKey(0)), optax.adam(1e-3), 100_000
+        )
+        feeds = [jax.device_put(fb) for fb in fused_batches]
+        jax.block_until_ready(feeds)
+        st, m = step_fused(state, feeds[0])
+        jax.block_until_ready(m["loss"])
+        state = st
+        inflight: deque = deque()
+        t0 = time.perf_counter()
+        for i in range(1, N_BATCHES):
+            state, m = step_fused(state, feeds[i])
+            inflight.append(m["loss"])
+            if len(inflight) > inflight_cap:
+                jax.block_until_ready(inflight.popleft())
+        final_loss = float(m["loss"])
+        jax.block_until_ready(state.table)
+        dt = time.perf_counter() - t0
+        print(
+            f"{name:34s} {dt/(N_BATCHES-1)*1e3:8.2f} ms/batch  "
+            f"{(N_BATCHES-1)*BATCH/dt:10.0f} sps  loss={final_loss:.4f}"
+        )
+
+    def run_transfers_only(name, workers=3, depth=6):
+        """No compute: just stream every fused buffer to the device."""
+        ex = ThreadPoolExecutor(workers)
+        t0 = time.perf_counter()
+        futs = [ex.submit(jax.device_put, fb) for fb in fused_batches]
+        outs = [f.result() for f in futs]
+        jax.block_until_ready(outs)
+        dt = time.perf_counter() - t0
+        mb = sum(fb.nbytes for fb in fused_batches) / 1e6
+        print(
+            f"{name:34s} {dt/N_BATCHES*1e3:8.2f} ms/batch  "
+            f"({mb/dt:8.1f} MB/s)"
+        )
+        ex.shutdown(wait=False)
+
+    for trial in range(2):
+        run_steps_only("steps only (preloaded feeds)", 4)
+        run_transfers_only("transfers only")
+        run("fused feed, inflight=4", "fused", 4)
+        run("dict feed, inflight=4", "dict", 4)
+
+
+if __name__ == "__main__":
+    main()

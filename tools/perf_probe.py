@@ -1,0 +1,174 @@
+"""Micro-attribution of the bench.py device step on the live backend.
+
+Times each stage of the jitted train step in isolation at bench shapes so a
+slow headline number can be blamed on a specific op (pull gather, fwd/bwd,
+push scatter, AUC, H2D feed). Not part of the test suite — a tuning tool.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.ops.pull_push import pull_sparse_rows, push_sparse_rows
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
+from paddlebox_tpu.table import SparseOptimizerConfig, ValueLayout
+from paddlebox_tpu.train import TrainStepConfig
+from paddlebox_tpu.train.train_step import (
+    init_train_state,
+    jit_train_step,
+    make_train_step,
+)
+
+NUM_SLOTS = 39
+EMBEDX_DIM = 16
+BATCH = 4096
+HIDDEN = (512, 256, 128)
+ROWS = 2_514_944  # ~bench pass working set, rounded
+L = NUM_SLOTS * BATCH  # flat keys (1 key/slot like bench data)
+U = 131_072  # deduped uniq rows per batch, bucket-padded
+
+
+def timeit(name, fn, *args, n=20):
+    fn(*args)  # compile
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n * 1e3
+    print(f"{name:28s} {dt:9.3f} ms")
+    return dt
+
+
+def main():
+    print("platform:", jax.devices()[0].platform)
+    layout = ValueLayout(embedx_dim=EMBEDX_DIM)
+    opt_cfg = SparseOptimizerConfig(embedx_threshold=0.0)
+    rng = np.random.default_rng(0)
+    W = layout.width
+    table = jnp.asarray(rng.standard_normal((ROWS, W)).astype(np.float32) * 0.01)
+    uniq_rows = jnp.asarray(
+        rng.integers(0, ROWS, U).astype(np.int32)
+    )
+    inverse = jnp.asarray(rng.integers(0, U, L).astype(np.int32))
+    segments = jnp.asarray(np.arange(L, dtype=np.int32) % (NUM_SLOTS * BATCH))
+    labels = jnp.asarray((rng.random(BATCH) < 0.2).astype(np.float32))
+
+    model = DeepFM(
+        num_slots=NUM_SLOTS, feat_width=layout.pull_width,
+        embedx_dim=EMBEDX_DIM, hidden=HIDDEN,
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = TrainStepConfig(
+        num_slots=NUM_SLOTS, batch_size=BATCH, layout=layout,
+        sparse_opt=opt_cfg, auc_buckets=100_000,
+    )
+
+    # --- stage 1: pull gather + inverse take
+    @jax.jit
+    def stage_pull(table, uniq_rows, inverse):
+        pulled = pull_sparse_rows(table, uniq_rows, layout, 0.0, 1.0)
+        return jnp.take(pulled, inverse, axis=0)
+
+    timeit("pull gather+take", stage_pull, table, uniq_rows, inverse)
+
+    # --- stage 2: seqpool + model fwd/bwd (dense math only)
+    flat = stage_pull(table, uniq_rows, inverse)
+
+    @jax.jit
+    def stage_fwdbwd(params, flat):
+        def loss_fn(p, fr):
+            feats = fused_seqpool_cvm(
+                fr, segments, num_slots=NUM_SLOTS, batch_size=BATCH
+            )
+            logits = model.apply(p, feats, None)
+            return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+        return jax.value_and_grad(loss_fn, argnums=(0, 1))(params, flat)
+
+    timeit("seqpool+fwd/bwd", stage_fwdbwd, params, flat)
+
+    # --- stage 3: grad merge (segment_sum at L->U)
+    gflat = stage_fwdbwd(params, flat)[1][1]
+
+    @jax.jit
+    def stage_merge(gflat):
+        merged = jax.ops.segment_sum(gflat, inverse, num_segments=U)
+        show = jax.ops.segment_sum(
+            jnp.ones((L,), jnp.float32), inverse, num_segments=U
+        )
+        return merged, show
+
+    timeit("grad segment_sum", stage_merge, gflat)
+
+    # --- stage 4: push scatter (adagrad + at[].add)
+    merged, show = stage_merge(gflat)
+
+    @jax.jit
+    def stage_push(table, uniq_rows, merged, show):
+        return push_sparse_rows(
+            table, uniq_rows, merged, show, show * 0.2, layout, opt_cfg
+        )
+
+    timeit("push update+scatter", stage_push, table, uniq_rows, merged, show)
+
+    # --- stage 5: AUC bucket update
+    from paddlebox_tpu.metrics.auc import auc_init, auc_update
+
+    auc = auc_init(100_000)
+    preds = jax.nn.sigmoid(jnp.asarray(rng.standard_normal(BATCH), jnp.float32))
+
+    @jax.jit
+    def stage_auc(auc, preds, labels):
+        return auc_update(auc, preds, labels)
+
+    timeit("auc bucket update", stage_auc, auc, preds, labels)
+
+    # --- full fused step (donated), on-device feed
+    step = jit_train_step(make_train_step(model.apply, optax.adam(1e-3), cfg))
+    state = init_train_state(table, params, optax.adam(1e-3), 100_000)
+    batch = {
+        "uniq_rows": uniq_rows,
+        "inverse": inverse,
+        "segments": segments,
+        "labels": labels,
+    }
+
+    state, m = step(state, batch)  # compile
+    jax.block_until_ready(state.table)
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, m = step(state, batch)
+    jax.block_until_ready(state.table)
+    print(f"{'FULL step (device feed)':28s} {(time.perf_counter()-t0)/n*1e3:9.3f} ms")
+
+    # --- H2D feed transfer alone
+    host_batch = {k: np.asarray(v) for k, v in batch.items()}
+
+    def h2d(hb):
+        return {k: jax.device_put(v) for k, v in hb.items()}
+
+    out = h2d(host_batch)
+    jax.block_until_ready(list(out.values()))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = h2d(host_batch)
+        jax.block_until_ready(list(out.values()))
+    print(f"{'H2D feed transfer':28s} {(time.perf_counter()-t0)/n*1e3:9.3f} ms")
+    nbytes = sum(v.nbytes for v in host_batch.values())
+    print(f"feed bytes/batch: {nbytes/1e6:.2f} MB")
+
+
+if __name__ == "__main__":
+    main()

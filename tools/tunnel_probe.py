@@ -1,0 +1,108 @@
+"""Characterize H2D transfer behavior on the live backend (axon tunnel).
+
+Answers: is device_put latency- or bandwidth-bound? do concurrent
+device_puts from threads pipeline? does a transfer overlap device compute?
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    print("platform:", jax.devices()[0].platform)
+
+    # latency vs size
+    for nbytes in (4_096, 65_536, 524_288, 2_097_152, 8_388_608, 33_554_432):
+        a = np.ones(nbytes // 4, np.float32)
+        x = jax.device_put(a)
+        jax.block_until_ready(x)
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            jax.block_until_ready(jax.device_put(a))
+        dt = (time.perf_counter() - t0) / n
+        print(f"device_put {nbytes/1e6:8.3f} MB: {dt*1e3:8.2f} ms  "
+              f"({nbytes/dt/1e6:8.1f} MB/s)")
+
+    # D2H for comparison
+    big = jax.device_put(np.ones(8_388_608 // 4, np.float32))
+    jax.block_until_ready(big)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        np.asarray(big)
+    print(f"D2H 8.4 MB: {(time.perf_counter()-t0)/5*1e3:8.2f} ms")
+
+    # 4 arrays of 0.5MB: sequential vs one fused 2MB
+    arrs = [np.ones(131_072, np.float32) for _ in range(4)]
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        outs = [jax.device_put(a) for a in arrs]
+        jax.block_until_ready(outs)
+    print(f"4x0.5MB seq device_put: {(time.perf_counter()-t0)/n*1e3:8.2f} ms")
+
+    fused = np.concatenate(arrs)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        jax.block_until_ready(jax.device_put(fused))
+    print(f"1x2MB fused device_put: {(time.perf_counter()-t0)/n*1e3:8.2f} ms")
+
+    # threaded: 4 device_puts from 4 threads
+    ex = ThreadPoolExecutor(4)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        futs = [ex.submit(lambda a=a: jax.block_until_ready(jax.device_put(a))) for a in arrs]
+        [f.result() for f in futs]
+    print(f"4x0.5MB threaded:       {(time.perf_counter()-t0)/n*1e3:8.2f} ms")
+
+    # overlap with compute: run a ~30ms matmul loop while a transfer flies
+    m = jax.device_put(np.ones((8192, 8192), np.float32))
+
+    @jax.jit
+    def burn(m):
+        for _ in range(12):
+            m = m @ m * 1e-4
+        return m
+
+    jax.block_until_ready(burn(m))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        jax.block_until_ready(burn(m))
+    tc = (time.perf_counter() - t0) / 5
+    print(f"compute alone: {tc*1e3:8.2f} ms")
+
+    t0 = time.perf_counter()
+    for _ in range(5):
+        f = ex.submit(lambda: jax.block_until_ready(jax.device_put(fused)))
+        r = burn(m)
+        jax.block_until_ready(r)
+        f.result()
+    to = (time.perf_counter() - t0) / 5
+    print(f"compute + 2MB transfer concurrent: {to*1e3:8.2f} ms "
+          f"(sum would be {tc*1e3 + 14.5:,.1f}+)")
+
+    # dispatch latency of a trivial jitted fn (tunnel RPC round trip)
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    s = jax.device_put(np.float32(1))
+    jax.block_until_ready(tiny(s))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        jax.block_until_ready(tiny(s))
+    print(f"tiny jit round-trip: {(time.perf_counter()-t0)/20*1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
