@@ -183,11 +183,13 @@ def main():
         trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-3))
         trainer.init_params(jax.random.PRNGKey(0))
 
-        # warmup: compile the step + prime packer scratch. Must cover one
-        # full resident superstep chunk so the scan-K program compiles here,
-        # not inside the timed pass.
+        # warmup: freeze pad shapes over the FULL timed partition (so no
+        # shape growth -> recompile lands inside the timed region), then
+        # train one superstep chunk to compile the scan-K program and prime
+        # packer scratch.
         from paddlebox_tpu import config as _config
 
+        trainer.prepare_pass(ds, n_batches=TRAIN_BATCHES)
         warm = max(4, int(_config.get_flag("resident_scan_batches")))
         trainer.train_pass(ds, n_batches=warm)
 

@@ -220,7 +220,14 @@ class BoxPSDataset:
                 if self._order is not None
                 else np.arange(len(self.store))
             )
-            self._records = [self.store.record(int(i)) for i in order]
+            recs = []
+            for i in order:
+                r = self.store.record(int(i))
+                # remember provenance so a reordering round-trip (pv merge ->
+                # flatten) can stay columnar as a permutation of the store
+                r._store_idx = int(i)
+                recs.append(r)
+            self._records = recs
         return self._records
 
     @records.setter
@@ -277,20 +284,56 @@ class BoxPSDataset:
 
     def postprocess_instance(self) -> None:
         """Restore the flat record view for the update phase
-        (PostprocessInstance parity)."""
-        if getattr(self, "_pv_merged", False):
-            self.records = flatten_pv_instances(self.pvs)
-            self.pvs = []
-            self._pv_merged = False
+        (PostprocessInstance parity).
 
-    def pv_batches(self, n_batches: Optional[int] = None, n_devices: int = 1):
+        When the pass is store-backed and every record still knows its
+        store index, the pv-flattened order becomes a PERMUTATION of the
+        columnar store — the update phase keeps the fast path (and, on a
+        multi-host mesh, the transport-locksteped pads that require it)."""
+        if not getattr(self, "_pv_merged", False):
+            return
+        flat = flatten_pv_instances(self.pvs)
+        idx = [getattr(r, "_store_idx", None) for r in flat]
+        if (
+            self.store is not None
+            and len(flat) == len(self.store)
+            and all(i is not None for i in idx)
+        ):
+            self._records = flat
+            self._order = np.asarray(idx, dtype=np.int64)
+        else:
+            self.records = flat  # setter: list becomes source of truth
+        self.pvs = []
+        self._pv_merged = False
+
+    def num_pv_batches(self, n_devices: int = 1, global_count: bool = False) -> int:
+        """Join-phase batch count; ``global_count`` allreduce-maxes it over
+        the transport so every host runs the same number of mesh
+        collectives (the pv analog of ``num_batches(global_count=True)``,
+        compute_thread_batch_nccl parity data_set.cc:2069-2135)."""
+        if not getattr(self, "_pv_merged", False):
+            raise RuntimeError("preprocess_instance first")
+        from paddlebox_tpu.data.pv_instance import count_pv_batches
+
+        n = count_pv_batches(self.pvs, self.batch_size, n_devices=n_devices)
+        if global_count and self.transport is not None and self.transport.n_ranks > 1:
+            n = self.transport.allreduce_max(n, f"pv-count:{self.pass_id}")
+        return n
+
+    def pv_batches(
+        self,
+        n_batches: Optional[int] = None,
+        n_devices: int = 1,
+        min_batches: int = 0,
+    ):
         """Join-phase batches: (SlotBatch with rank_offset, ins_weight).
 
         Whole pvs pack into ``batch_size`` instance slots, ghost-padded
         (see data/pv_instance.py). SlotBatch.rank_offset is set; ins_weight
         masks ghosts out of loss/metrics/show-clk. With ``n_devices > 1``
         the batch is device-blocked (no pv crosses a device, rank_offset
-        rows device-local) for the mesh join step.
+        rows device-local) for the mesh join step. ``min_batches`` appends
+        all-ghost batches for multi-host lockstep (see pack_pv_batches).
         """
         if not getattr(self, "_pv_merged", False):
             raise RuntimeError("preprocess_instance first")
@@ -300,6 +343,7 @@ class BoxPSDataset:
             max_rank=self._pv_max_rank,
             valid_cmatch=self._pv_valid_cmatch,
             n_devices=n_devices,
+            min_batches=min_batches,
         )
         if n_batches is not None:
             packed = itertools.islice(packed, n_batches)

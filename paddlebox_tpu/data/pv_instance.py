@@ -110,6 +110,7 @@ def pack_pv_batches(
     valid_cmatch: Sequence[int] = DEFAULT_VALID_CMATCH,
     drop_remainder: bool = False,
     n_devices: int = 1,
+    min_batches: int = 0,
 ) -> Iterator[Tuple[List[SlotRecord], np.ndarray, np.ndarray]]:
     """Yield (records, rank_offset, ins_weight) join-phase batches.
 
@@ -123,6 +124,12 @@ def pack_pv_batches(
     shape the mesh join step's per-device rank_attention gathers over. The
     records stream out device-major, matching the sharded packer's
     ins -> device mapping (ins // b).
+
+    ``min_batches`` keeps multi-host meshes in lockstep (the pv analog of
+    compute_thread_batch_nccl, data_set.cc:2069-2135): after the local pvs
+    run out, all-ghost batches (weight 0 everywhere, rank_offset all -1)
+    are emitted until ``min_batches`` have been yielded, so a host with
+    fewer page views still executes every collective of the pass.
     """
     if batch_size % n_devices:
         raise ValueError(f"batch {batch_size} not divisible by {n_devices} devices")
@@ -152,6 +159,10 @@ def pack_pv_batches(
                     return pv.ads[0]
         raise ValueError("cannot ghost-pad an entirely empty pv batch")
 
+    if min_batches and drop_remainder:
+        raise ValueError("min_batches (lockstep) and drop_remainder conflict")
+    emitted = 0
+    ghost_rec: List[SlotRecord] = []  # first real ad seen, for all-ghost pads
     blocks: List[List[PvInstance]] = [[]]
     cur_ins = 0
     for pv in pvs:
@@ -161,9 +172,12 @@ def pack_pv_batches(
                 f"pv with {n} ads exceeds join block size {b} "
                 f"({batch_size} instances / {n_devices} devices)"
             )
+        if not ghost_rec and pv.ads:
+            ghost_rec.append(pv.ads[0])
         if cur_ins + n > b:
             if len(blocks) == n_devices:
                 yield emit(blocks)
+                emitted += 1
                 blocks = [[]]
             else:
                 blocks.append([])
@@ -172,3 +186,45 @@ def pack_pv_batches(
         cur_ins += n
     if any(g for g in blocks) and not drop_remainder:
         yield emit(blocks)
+        emitted += 1
+    while emitted < min_batches:
+        if not ghost_rec:
+            raise ValueError(
+                "lockstep needs at least one local record to ghost-pad "
+                "with (this host holds zero page views)"
+            )
+        ghost = ghost_rec[0]
+        records = [ghost] * batch_size
+        yield (
+            records,
+            np.full((batch_size, 2 * max_rank + 1), -1, dtype=np.int32),
+            np.zeros(batch_size, dtype=np.float32),
+        )
+        emitted += 1
+
+
+def count_pv_batches(
+    pvs: Sequence[PvInstance], batch_size: int, n_devices: int = 1
+) -> int:
+    """Number of batches pack_pv_batches will yield (no materialization).
+
+    Multi-host join phases allreduce-max this count so every host runs the
+    same number of mesh collectives (lockstep parity)."""
+    if batch_size % n_devices:
+        raise ValueError(f"batch {batch_size} not divisible by {n_devices} devices")
+    b = batch_size // n_devices
+    count, n_blocks, cur_ins, n_pvs = 0, 1, 0, 0
+    for pv in pvs:
+        n = len(pv.ads)
+        if cur_ins + n > b:
+            if n_blocks == n_devices:
+                count += 1
+                n_blocks = 1
+            else:
+                n_blocks += 1
+            cur_ins = 0
+        cur_ins += n
+        n_pvs += 1
+    # the packer always emits a final partial batch when any pv exists
+    # (every pv lands in a block after the last mid-loop yield)
+    return count + (1 if n_pvs else 0)

@@ -99,7 +99,9 @@ class ResidentPass:
             self.dense = jnp.asarray(store.float_slot_matrix(di, dense_dim))
         self.L_pad = 0
         self.U_pad = 0
-        self._uniq_cache: Dict[int, int] = {}  # idx-block fingerprint -> n_uniq
+        # keyed by the exact index bytes, not a hash — a collision would
+        # freeze U_pad too small and silently merge distinct rows
+        self._uniq_cache: Dict[bytes, int] = {}
 
     def ensure(self, batch_indices) -> None:
         """Freeze/grow L_pad and U_pad to cover every batch in the partition
@@ -109,7 +111,7 @@ class ResidentPass:
         for idx in batch_indices:
             idx = np.asarray(idx)
             max_L = max(max_L, int(self._key_counts[idx].sum()))
-            fp = hash(idx.tobytes())
+            fp = idx.tobytes()
             n_uniq = self._uniq_cache.get(fp)
             if n_uniq is None:
                 from paddlebox_tpu.data.record_store import _ragged_indices

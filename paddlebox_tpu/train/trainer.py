@@ -306,9 +306,86 @@ class CTRTrainer:
             aux["ins_ids"] = ins_ids
         return feed, aux
 
+    def _pv_lockstep(self, dataset, n_dev: int) -> int:
+        """Multi-host join phase: equalize batch counts and pad shapes.
+
+        The pv analog of the fast path's transport-locksteped freeze_shapes
+        (compute_thread_batch_nccl parity, data_set.cc:2069-2135): (a)
+        allreduce-max the local pv batch count — short hosts emit all-ghost
+        batches; (b) allreduce-max the per-device L and per-(device, shard)
+        request-bucket K over every local batch INCLUDING the ghost tail, and
+        seed the sticky pack floors, so every host compiles the same mesh
+        program and no collective ever sees mismatched shapes.
+        Returns the global batch count (min_batches for pv_batches)."""
+        from paddlebox_tpu.data.device_pack import _round_bucket
+        from paddlebox_tpu.data.pv_instance import pack_pv_batches
+
+        cached = getattr(self, "_pv_lockstep_cache", None)
+        if (
+            cached is not None
+            and cached[0] is dataset.pvs
+            and cached[1] is dataset.ws
+        ):
+            # repeat join-phase calls over the same pvs/ws (warmup epoch,
+            # join eval) skip the host re-pack sweep AND the allreduces —
+            # re-entering the collectives alone would desync any host that
+            # took the cache hit
+            min_b, k_glob, l_glob = cached[2]
+            self._pads_ws = dataset.ws
+            self._pads = [k_glob, l_glob]
+            return min_b
+        tp = dataset.transport
+        if tp is None:
+            raise RuntimeError(
+                "multi-host join-phase (pv) training needs a dataset "
+                "transport to lockstep batch counts and pad shapes across "
+                "hosts (pass transport= to BoxPSDataset)"
+            )
+        min_b = dataset.num_pv_batches(n_devices=n_dev, global_count=True)
+        ws = dataset.ws
+        cap, ns = ws.capacity, ws.n_mesh_shards
+        bucket = self.pack_bucket or config.get_flag("batch_bucket_rounding")
+        b = dataset.batch_size // n_dev
+        max_L, max_bucket = 1, 0
+        for records, _ro, _w in pack_pv_batches(
+            dataset.pvs,
+            dataset.batch_size,
+            max_rank=dataset._pv_max_rank,
+            valid_cmatch=dataset._pv_valid_cmatch,
+            n_devices=n_dev,
+            min_batches=min_b,
+        ):
+            for d in range(n_dev):
+                recs = records[d * b : (d + 1) * b]
+                if not recs:
+                    continue
+                keys = np.concatenate([r.u64_values for r in recs])
+                if not len(keys):
+                    continue
+                max_L = max(max_L, len(keys))
+                uniq = np.unique(ws.lookup(keys))
+                max_bucket = max(
+                    max_bucket, int(np.bincount(uniq // cap, minlength=ns).max())
+                )
+        k_glob = tp.allreduce_max(
+            _round_bucket(max_bucket + 1, bucket), f"pv-K:{dataset.pass_id}"
+        )
+        l_glob = tp.allreduce_max(
+            _round_bucket(max_L, bucket), f"pv-L:{dataset.pass_id}"
+        )
+        self._pads_ws = dataset.ws
+        self._pads = [k_glob, l_glob]
+        self._pv_lockstep_cache = (dataset.pvs, dataset.ws, (min_b, k_glob, l_glob))
+        return min_b
+
     def _pv_feed_iter(self, dataset, n_batches):
         n_dev = 1 if self.plan is None else self._n_pack_devices
-        for batch, ins_weight in dataset.pv_batches(n_batches, n_devices=n_dev):
+        min_b = 0
+        if self.plan is not None and jax.process_count() > 1:
+            min_b = self._pv_lockstep(dataset, n_dev)
+        for batch, ins_weight in dataset.pv_batches(
+            n_batches, n_devices=n_dev, min_batches=min_b
+        ):
             feed = self._pack_and_put(batch, dataset.ws)
             if self.plan is None:
                 if ins_weight is not None:
@@ -470,6 +547,7 @@ class CTRTrainer:
         # supersteps whose closures pin them) BEFORE uploading the new
         # pass's set — otherwise both passes' resident arrays coexist in
         # HBM during prepare, doubling peak device memory
+        c = None  # the local ref would keep the old arrays alive too
         self._resident_cache = None
         self._sstep_cache = {}
         rp = ResidentPass(
@@ -578,6 +656,38 @@ class CTRTrainer:
             if ids_ex is not None:
                 ids_ex.shutdown(wait=False)
 
+    def prepare_pass(
+        self, dataset: BoxPSDataset, n_batches: Optional[int] = None
+    ) -> None:
+        """Pre-freeze this pass's pad shapes for the given batch partition.
+
+        Optional warm-start hook: calling this (or training a warmup slice
+        covering the partition) before a timed/measured train_pass keeps
+        shape growth — and the XLA recompile it triggers — out of the
+        measured region. Covers both the resident path (L_pad/U_pad) and
+        the columnar packer (freeze_shapes)."""
+        self._schema = dataset.schema
+        if dataset.store is None or dataset.ws is None:
+            return
+        if (
+            bool(config.get_flag("enable_resident_feed"))
+            and self.plan is None
+            and not (dataset.pv_merged and dataset.current_phase == 1)
+            and self.cfg.dense_sync_mode != "async"
+            and not self.cfg.model_takes_rank_offset
+            and len(dataset.store.u64_values) < (1 << 31)
+        ):
+            self._get_resident(dataset).ensure(
+                np.asarray(b, dtype=np.int32)
+                for b in dataset.batch_indices(n_batches)
+            )
+        else:
+            self._get_packer(dataset).freeze_shapes(
+                dataset.batch_indices(n_batches),
+                n_devices=self._n_pack_devices if self.plan is not None else 0,
+                transport=dataset.transport,
+            )
+
     def train_pass(
         self,
         dataset: BoxPSDataset,
@@ -626,12 +736,6 @@ class CTRTrainer:
         if use_resident:
             step_fn = None
         elif use_pv:
-            if self.plan is not None and jax.process_count() > 1:
-                raise NotImplementedError(
-                    "join-phase pv batches are not transport-locksteped "
-                    "across hosts yet (local pv counts/pads would desync "
-                    "the mesh); run the join phase on a single-host mesh"
-                )
             iterator = self._pv_feed_iter(dataset, n_batches)
             step_fn = self._eval_step() if eval_mode else self._step
         elif dataset.store is not None:
@@ -828,3 +932,22 @@ class CTRTrainer:
         if self.plan is not None and jax.process_count() > 1:
             return local_slice(self.plan, self._state.table)
         return np.asarray(self._state.table)
+
+    def handoff_table(self, dataset: BoxPSDataset) -> None:
+        """Carry this trainer's trained table into ANOTHER trainer's
+        train_pass over the same working set.
+
+        The reference's join and update phases push into one live PS table
+        (phase machinery box_wrapper.h:620-622; the dataset is trained twice
+        per pass, test_paddlebox_datafeed.py:103-119). Here each CTRTrainer
+        binds one step config, so a two-phase pass uses two trainers — the
+        join trainer must hand its sparse updates to the update trainer
+        explicitly, else phase 2 silently restarts from the pass-open table:
+
+            join_tr.train_pass(ds); join_tr.handoff_table(ds)
+            upd_tr.train_pass(ds);  ds.end_pass(upd_tr.trained_table())
+        """
+        t = self.trained_table()
+        if t.ndim == 2:  # single-device flat layout -> ws shard layout
+            t = t.reshape(-1, dataset.ws.capacity, t.shape[-1])
+        dataset.device_table = t

@@ -148,5 +148,149 @@ def main():
     print(f"rank {rank}: ok", flush=True)
 
 
+
+
+def pv_main():
+    """Join(pv) -> update two-phase pass on the 2-host mesh: search_id
+    global shuffle co-locates each query's ads on its owner host, pv batch
+    counts and pack pads are transport-locksteped (ghost batches on the
+    short host), then the update phase runs the store fast path."""
+    _, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    rank = int(rank_s)
+    with open(os.path.join(workdir, "conf.json")) as f:
+        conf = json.load(f)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{conf['coord_port']}",
+        num_processes=2,
+        process_id=rank,
+    )
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.ops import rank_attention
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.parallel.transport import TcpTransport, TcpShuffleRouter
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+
+    NS = conf["num_slots"]
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+        parse_logkey=True,
+    )
+    layout = ValueLayout(embedx_dim=conf["embedx_dim"])
+    opt_cfg = SparseOptimizerConfig(
+        embed_lr=0.2, embedx_lr=0.2, embedx_threshold=0.0, initial_range=0.01
+    )
+    table = HostSparseTable(layout, opt_cfg, n_shards=4, seed=0)
+
+    eps = [f"127.0.0.1:{p}" for p in conf["tp_ports"]]
+    transport = TcpTransport(rank, eps, timeout=60.0)
+    router = TcpShuffleRouter(transport)
+
+    n_global_dev = 4
+    plan = make_mesh(n_global_dev)
+
+    ds = BoxPSDataset(
+        schema,
+        table,
+        batch_size=conf["local_batch"],
+        n_mesh_shards=n_global_dev,
+        rank=rank,
+        nranks=2,
+        shuffle_mode="search_id",  # co-locate each pv on its owner host
+        router=router,
+        transport=transport,
+        seed=0,
+    )
+    ds.set_filelist(conf["files"])
+    ds.set_date("20260101")
+    ds.load_into_memory()
+    ds.begin_pass(round_to=conf["round_to"])
+
+    base = DeepFM(
+        num_slots=NS, feat_width=layout.pull_width,
+        embedx_dim=conf["embedx_dim"], hidden=(16,),
+    )
+    in_dim = NS * layout.pull_width
+
+    class RankModel:
+        """DeepFM + rank_attention over the pv rank matrix (join phase);
+        update phase calls it without rank_offset (attention skipped)."""
+
+        def init(self, rng):
+            p = base.init(rng)
+            p["rank_param"] = jnp.full((9 * in_dim, 1), 0.01, jnp.float32)
+            return p
+
+        def apply(self, p, feats, dense=None, rank_offset=None):
+            logit = base.apply(
+                {k: v for k, v in p.items() if k != "rank_param"}, feats, dense
+            )
+            if rank_offset is not None:
+                x = feats.reshape(feats.shape[0], -1)
+                logit = logit + rank_attention(x, rank_offset, p["rank_param"], 3)[:, 0]
+            return logit
+
+    model = RankModel()
+    per_dev_b = conf["local_batch"] // 2
+    cfg_join = TrainStepConfig(
+        num_slots=NS, batch_size=per_dev_b, layout=layout, sparse_opt=opt_cfg,
+        auc_buckets=1000, axis_name=plan.axis, model_takes_rank_offset=True,
+    )
+    join_tr = CTRTrainer(model, cfg_join, dense_opt=optax.adam(1e-2), plan=plan)
+    join_tr.init_params(jax.random.PRNGKey(0))
+
+    ds.set_current_phase(1)
+    n_pvs = ds.preprocess_instance()
+    local_pv_batches = ds.num_pv_batches(n_devices=2)
+    out_j = join_tr.train_pass(ds)
+
+    ds.set_current_phase(0)
+    ds.postprocess_instance()
+    cfg_upd = TrainStepConfig(
+        num_slots=NS, batch_size=per_dev_b, layout=layout, sparse_opt=opt_cfg,
+        auc_buckets=1000, axis_name=plan.axis,
+    )
+    upd_tr = CTRTrainer(model, cfg_upd, dense_opt=optax.adam(1e-2), plan=plan)
+    upd_tr.params = join_tr.params
+    upd_tr.opt_state = optax.adam(1e-2).init(join_tr.params)
+    upd_tr.init_params = lambda rng=None: None
+    join_tr.handoff_table(ds)  # join-phase sparse updates carry into update
+    out_u = upd_tr.train_pass(ds)
+
+    local_table = upd_tr.trained_table()
+    ds.end_pass(local_table, shrink=False)
+    np.savez(
+        os.path.join(workdir, f"rank{rank}.npz"),
+        n_pvs=np.array([n_pvs]),
+        local_pv_batches=np.array([local_pv_batches]),
+        join_batches=np.array([out_j["batches"]]),
+        join_loss=np.array([out_j["loss"]]),
+        join_auc=np.array([out_j["auc"]]),
+        join_ins=np.array([out_j["ins_num"]]),
+        upd_batches=np.array([out_u["batches"]]),
+        upd_loss=np.array([out_u["loss"]]),
+        n_records=np.array([ds.memory_data_size()]),
+    )
+    print(f"rank {rank}: pv ok", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if sys.argv[1] == "pv":
+        pv_main()
+    else:
+        main()

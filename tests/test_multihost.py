@@ -261,3 +261,59 @@ def test_zero1_across_processes(tmp_path):
     assert abs(dumps[0]["loss"][0] - dumps[1]["loss"][0]) < 1e-9
     # trained shard blocks are disjoint and real
     assert not np.array_equal(dumps[0]["local_table"], dumps[1]["local_table"])
+
+
+def _write_pv_files(tmp_path, n_even_queries, n_odd_queries):
+    """Logkey'd pv data with a skewed search_id parity split: after
+    search_id-mode global shuffle, rank 0 owns ~n_even and rank 1 ~n_odd
+    page views — unequal join batch counts force ghost equalization."""
+    rng = np.random.default_rng(11)
+    sids = [2 * (i + 1) for i in range(n_even_queries)] + [
+        2 * (i + 1) + 1 for i in range(n_odd_queries)
+    ]
+    rng.shuffle(sids)
+    files = [str(tmp_path / "part-0.txt"), str(tmp_path / "part-1.txt")]
+    handles = [open(p, "w") for p in files]
+    total = 0
+    for qi, sid in enumerate(sids):
+        n_ads = int(rng.integers(1, 4))
+        for rank in range(1, n_ads + 1):
+            keys = rng.integers(1, 500, NS)
+            cmatch = 222 if rng.random() < 0.8 else 999  # some rank-invalid
+            logkey = "0" * 11 + f"{cmatch:03x}" + f"{rank:02x}" + f"{sid:016x}"
+            handles[qi % 2].write(
+                f"1 {logkey} 1 {int(keys[0]) % 2}.0 "
+                + " ".join(f"1 {k}" for k in keys)
+                + "\n"
+            )
+            total += 1
+    for h in handles:
+        h.close()
+    return files, total
+
+
+def test_two_process_pv_join_update_lockstep(tmp_path):
+    """Multi-host join-phase (pv) training: search_id shuffle co-locates
+    queries, batch counts + pack pads are transport-locksteped (the host
+    with fewer pvs runs all-ghost batches), rank_offset stays device-local,
+    and the update phase reuses the join-trained table. The config the
+    trainer used to reject outright."""
+    files, total = _write_pv_files(tmp_path, n_even_queries=30, n_odd_queries=8)
+    outs = _run_cluster(tmp_path, "pv", files, GLOBAL_BATCH // 2, False)
+    r0, r1 = outs
+    # lockstep: both ranks ran the SAME number of join batches...
+    assert int(r0["join_batches"][0]) == int(r1["join_batches"][0])
+    # ...which is the max of the two local needs (ghosts on the short rank)
+    local = sorted(
+        (int(r0["local_pv_batches"][0]), int(r1["local_pv_batches"][0]))
+    )
+    assert local[0] < local[1], "test data must give unequal pv loads"
+    assert int(r0["join_batches"][0]) == local[1]
+    # every real ad trained exactly once globally: the psum'd AUC bucket
+    # totals count real instances only (ghosts masked), same on both ranks
+    assert int(r0["join_ins"][0]) == int(r1["join_ins"][0]) == total
+    # update phase ran in lockstep too, losses finite everywhere
+    assert int(r0["upd_batches"][0]) == int(r1["upd_batches"][0]) > 0
+    for r in outs:
+        assert np.isfinite(r["join_loss"][0]) and np.isfinite(r["upd_loss"][0])
+        assert 0.0 <= r["join_auc"][0] <= 1.0

@@ -185,6 +185,10 @@ def test_two_phase_join_update_end_to_end(tmp_path):
     assert m_join["ins_num"] == ds.memory_data_size()
 
     # ---- update phase: flat batches, same trained table carries on
+    trainer.handoff_table(ds)  # join-phase sparse updates feed phase 2
+    np.testing.assert_array_equal(
+        ds.device_table.reshape(-1, layout.width), trainer.trained_table()
+    )
     ds.set_current_phase(0)
     ds.postprocess_instance()
     cfg_upd = TrainStepConfig(

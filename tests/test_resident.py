@@ -132,13 +132,14 @@ def test_resident_eval_mode_is_identity(tmp_path):
 def test_resident_scan_chunking_matches_per_batch(tmp_path):
     """resident_scan_batches=1 (per-batch dispatch) and =4 (scan) produce
     identical results — the scan is pure restructuring."""
-    config.set_flag("resident_scan_batches", 1)
+    prev_k = config.get_flag("resident_scan_batches")
     try:
+        config.set_flag("resident_scan_batches", 1)
         out_1, table_1, _, _ = _run(tmp_path / "a", resident=True, n_batches=8)
-    finally:
         config.set_flag("resident_scan_batches", 4)
-    out_4, table_4, _, _ = _run(tmp_path / "b", resident=True, n_batches=8)
-    config.set_flag("resident_scan_batches", 8)
+        out_4, table_4, _, _ = _run(tmp_path / "b", resident=True, n_batches=8)
+    finally:
+        config.set_flag("resident_scan_batches", prev_k)
     assert np.isclose(out_1["loss"], out_4["loss"], atol=1e-6)
     np.testing.assert_allclose(table_1, table_4, atol=1e-5)
 
