@@ -103,6 +103,45 @@ def build_rank_offset(
     return mat
 
 
+def _iter_pv_blocks(
+    pvs: Sequence[PvInstance],
+    b: int,
+    n_devices: int,
+    drop_remainder: bool = False,
+) -> Iterator[List[List[PvInstance]]]:
+    """The greedy pv->block packing grid, shared by pack/count/stats so the
+    three can never disagree about batch composition. Each yielded item is
+    up to n_devices groups of whole pvs, each group <= b instances."""
+    blocks: List[List[PvInstance]] = [[]]
+    cur_ins = 0
+    for pv in pvs:
+        n = len(pv.ads)
+        if n > b:
+            raise ValueError(
+                f"pv with {n} ads exceeds join block size {b} "
+                f"({b * n_devices} instances / {n_devices} devices)"
+            )
+        if cur_ins + n > b:
+            if len(blocks) == n_devices:
+                yield blocks
+                blocks = [[]]
+            else:
+                blocks.append([])
+            cur_ins = 0
+        blocks[-1].append(pv)
+        cur_ins += n
+    if any(g for g in blocks) and not drop_remainder:
+        yield blocks
+
+
+def first_pv_record(pvs: Sequence[PvInstance]):
+    """First real ad, used as the weight-0 ghost for all-ghost batches."""
+    for pv in pvs:
+        if pv.ads:
+            return pv.ads[0]
+    return None
+
+
 def pack_pv_batches(
     pvs: Sequence[PvInstance],
     batch_size: int,
@@ -162,41 +201,18 @@ def pack_pv_batches(
     if min_batches and drop_remainder:
         raise ValueError("min_batches (lockstep) and drop_remainder conflict")
     emitted = 0
-    ghost_rec: List[SlotRecord] = []  # first real ad seen, for all-ghost pads
-    blocks: List[List[PvInstance]] = [[]]
-    cur_ins = 0
-    for pv in pvs:
-        n = len(pv.ads)
-        if n > b:
-            raise ValueError(
-                f"pv with {n} ads exceeds join block size {b} "
-                f"({batch_size} instances / {n_devices} devices)"
-            )
-        if not ghost_rec and pv.ads:
-            ghost_rec.append(pv.ads[0])
-        if cur_ins + n > b:
-            if len(blocks) == n_devices:
-                yield emit(blocks)
-                emitted += 1
-                blocks = [[]]
-            else:
-                blocks.append([])
-            cur_ins = 0
-        blocks[-1].append(pv)
-        cur_ins += n
-    if any(g for g in blocks) and not drop_remainder:
+    for blocks in _iter_pv_blocks(pvs, b, n_devices, drop_remainder):
         yield emit(blocks)
         emitted += 1
     while emitted < min_batches:
-        if not ghost_rec:
+        ghost = first_pv_record(pvs)
+        if ghost is None:
             raise ValueError(
                 "lockstep needs at least one local record to ghost-pad "
                 "with (this host holds zero page views)"
             )
-        ghost = ghost_rec[0]
-        records = [ghost] * batch_size
         yield (
-            records,
+            [ghost] * batch_size,
             np.full((batch_size, 2 * max_rank + 1), -1, dtype=np.int32),
             np.zeros(batch_size, dtype=np.float32),
         )
@@ -213,18 +229,4 @@ def count_pv_batches(
     if batch_size % n_devices:
         raise ValueError(f"batch {batch_size} not divisible by {n_devices} devices")
     b = batch_size // n_devices
-    count, n_blocks, cur_ins, n_pvs = 0, 1, 0, 0
-    for pv in pvs:
-        n = len(pv.ads)
-        if cur_ins + n > b:
-            if n_blocks == n_devices:
-                count += 1
-                n_blocks = 1
-            else:
-                n_blocks += 1
-            cur_ins = 0
-        cur_ins += n
-        n_pvs += 1
-    # the packer always emits a final partial batch when any pv exists
-    # (every pv lands in a block after the last mid-loop yield)
-    return count + (1 if n_pvs else 0)
+    return sum(1 for _ in _iter_pv_blocks(pvs, b, n_devices))

@@ -53,13 +53,17 @@ def auc_update(
         imask = mask.astype(jnp.int32)
     bucket = jnp.clip((preds * n_buckets).astype(jnp.int32), 0, n_buckets - 1)
     ilab = (labels > 0.5).astype(jnp.int32)
+    # ONE fused scatter over [pos ++ neg]: a click adds at bucket, a
+    # non-click at n_buckets + bucket — halves the per-step scatter cost
+    # vs two separate bucket-table updates (cuda_add_data also writes both
+    # tables in one kernel, box_wrapper.cu:1581)
+    tab = jnp.concatenate([state.pos, state.neg])
+    tab = tab.at[bucket + (1 - ilab) * n_buckets].add(imask)
     # saturate at 2^30: a bucket that hot stops counting instead of
     # wrapping int32 and corrupting every derived metric; auc_compute
     # reports `saturated` so the condition is visible
-    return AucState(
-        pos=jnp.minimum(state.pos.at[bucket].add(ilab * imask), AUC_BUCKET_CAP),
-        neg=jnp.minimum(state.neg.at[bucket].add((1 - ilab) * imask), AUC_BUCKET_CAP),
-    )
+    tab = jnp.minimum(tab, AUC_BUCKET_CAP)
+    return AucState(pos=tab[:n_buckets], neg=tab[n_buckets:])
 
 
 def auc_psum(state: AucState, axis_name: str) -> AucState:

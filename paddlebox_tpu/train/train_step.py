@@ -216,12 +216,15 @@ def scale_and_merge_grads(
         pad_mask if ins_weight is None else pad_mask * jnp.take(ins_weight, ins_of_key)
     )
     gflat = gflat * pad_mask[:, None]
-    merged = jax.ops.segment_sum(gflat, inverse, num_segments=num_segments)
-    show = jax.ops.segment_sum(valid, inverse, num_segments=num_segments)
-    clk = jax.ops.segment_sum(
-        jnp.take(labels, ins_of_key) * valid, inverse, num_segments=num_segments
+    # ONE segment reduction for grads + show + clk: scatter passes dominate
+    # the push side on TPU, and three width-w scatters cost ~3x one
+    # width-(w+2) scatter (PushMergeCopy fuses the same way, box_wrapper.cu)
+    ext = jnp.concatenate(
+        [gflat, valid[:, None], (jnp.take(labels, ins_of_key) * valid)[:, None]],
+        axis=1,
     )
-    return merged, show, clk
+    summed = jax.ops.segment_sum(ext, inverse, num_segments=num_segments)
+    return summed[:, :-2], summed[:, -2], summed[:, -1]
 
 
 def adjusted_loss_weight(

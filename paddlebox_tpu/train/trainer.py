@@ -318,7 +318,6 @@ class CTRTrainer:
         program and no collective ever sees mismatched shapes.
         Returns the global batch count (min_batches for pv_batches)."""
         from paddlebox_tpu.data.device_pack import _round_bucket
-        from paddlebox_tpu.data.pv_instance import pack_pv_batches
 
         cached = getattr(self, "_pv_lockstep_cache", None)
         if (
@@ -346,27 +345,52 @@ class CTRTrainer:
         cap, ns = ws.capacity, ws.n_mesh_shards
         bucket = self.pack_bucket or config.get_flag("batch_bucket_rounding")
         b = dataset.batch_size // n_dev
+
+        def block_stats(recs, ghost, n_real):
+            """(L, shard-bucket max) of one device block incl. ghost pad —
+            ghosts repeat an existing record, so they add keys but no new
+            unique rows beyond the ghost's own."""
+            keys_parts = [r.u64_values for r in recs]
+            if n_real < b and ghost is not None:
+                keys_parts.extend([ghost.u64_values] * (b - n_real))
+            keys = (
+                np.concatenate(keys_parts)
+                if keys_parts
+                else np.zeros(0, np.uint64)
+            )
+            if not len(keys):
+                return 0, 0
+            uniq = np.unique(ws.lookup(keys))
+            return len(keys), int(np.bincount(uniq // cap, minlength=ns).max())
+
+        from paddlebox_tpu.data.pv_instance import (
+            _iter_pv_blocks,
+            first_pv_record,
+            flatten_pv_instances,
+        )
+
         max_L, max_bucket = 1, 0
-        for records, _ro, _w in pack_pv_batches(
-            dataset.pvs,
-            dataset.batch_size,
-            max_rank=dataset._pv_max_rank,
-            valid_cmatch=dataset._pv_valid_cmatch,
-            n_devices=n_dev,
-            min_batches=min_b,
-        ):
-            for d in range(n_dev):
-                recs = records[d * b : (d + 1) * b]
-                if not recs:
-                    continue
-                keys = np.concatenate([r.u64_values for r in recs])
-                if not len(keys):
-                    continue
-                max_L = max(max_L, len(keys))
-                uniq = np.unique(ws.lookup(keys))
-                max_bucket = max(
-                    max_bucket, int(np.bincount(uniq // cap, minlength=ns).max())
-                )
+        fallback = first_pv_record(dataset.pvs)
+        n_local = 0
+        for blocks in _iter_pv_blocks(dataset.pvs, b, n_dev):
+            n_local += 1
+            groups = list(blocks) + [[]] * (n_dev - len(blocks))
+            # emit()'s ghost for an all-empty group is the first ad WITHIN
+            # this batch (_GHOST_FALLBACK) — mirror it exactly so L matches
+            batch_ghost = next(
+                (pv.ads[0] for g in groups for pv in g if pv.ads), fallback
+            )
+            for group in groups:
+                recs = flatten_pv_instances(group)
+                ghost = recs[-1] if recs else batch_ghost
+                L, bmax = block_stats(recs, ghost, len(recs))
+                max_L = max(max_L, L)
+                max_bucket = max(max_bucket, bmax)
+        if n_local < min_b and fallback is not None:
+            # lockstep all-ghost batches: b copies of one record per device
+            L, bmax = block_stats([], fallback, 0)
+            max_L = max(max_L, L)
+            max_bucket = max(max_bucket, bmax)
         k_glob = tp.allreduce_max(
             _round_bucket(max_bucket + 1, bucket), f"pv-K:{dataset.pass_id}"
         )
@@ -656,6 +680,20 @@ class CTRTrainer:
             if ids_ex is not None:
                 ids_ex.shutdown(wait=False)
 
+    def _use_resident(self, dataset: BoxPSDataset, use_pv: bool, is_async: bool) -> bool:
+        """One predicate for the resident-vs-packer path, shared by
+        train_pass and prepare_pass so the warm-start hook can never
+        pre-freeze a different feed path than training will take."""
+        return (
+            bool(config.get_flag("enable_resident_feed"))
+            and self.plan is None
+            and not use_pv
+            and not is_async
+            and not self.cfg.model_takes_rank_offset
+            and dataset.store is not None
+            and len(dataset.store.u64_values) < (1 << 31)
+        )
+
     def prepare_pass(
         self, dataset: BoxPSDataset, n_batches: Optional[int] = None
     ) -> None:
@@ -669,14 +707,12 @@ class CTRTrainer:
         self._schema = dataset.schema
         if dataset.store is None or dataset.ws is None:
             return
-        if (
-            bool(config.get_flag("enable_resident_feed"))
-            and self.plan is None
-            and not (dataset.pv_merged and dataset.current_phase == 1)
-            and self.cfg.dense_sync_mode != "async"
-            and not self.cfg.model_takes_rank_offset
-            and len(dataset.store.u64_values) < (1 << 31)
-        ):
+        use_pv = dataset.pv_merged and dataset.current_phase == 1
+        if use_pv:
+            # pv pads live in _pads, frozen by _pv_lockstep at feed time
+            return
+        is_async = self.cfg.dense_sync_mode == "async" and not self._eval_active
+        if self._use_resident(dataset, use_pv, is_async):
             self._get_resident(dataset).ensure(
                 np.asarray(b, dtype=np.int32)
                 for b in dataset.batch_indices(n_batches)
@@ -723,15 +759,7 @@ class CTRTrainer:
         is_async = self.cfg.dense_sync_mode == "async" and not eval_mode
         # resident fast path: pass data lives in device HBM, feeds are
         # index-only, K steps per dispatch (train/resident_step.py)
-        use_resident = (
-            bool(config.get_flag("enable_resident_feed"))
-            and self.plan is None
-            and not use_pv
-            and not is_async
-            and not self.cfg.model_takes_rank_offset
-            and dataset.store is not None
-            and len(dataset.store.u64_values) < (1 << 31)
-        )
+        use_resident = self._use_resident(dataset, use_pv, is_async)
         iterator = None
         if use_resident:
             step_fn = None
