@@ -203,6 +203,7 @@ class BoxPSDataset:
         self.stats = PassStats()
         self._preload_thread: Optional[threading.Thread] = None
         self._preload_exc: Optional[BaseException] = None
+        self._end_pass_fut = None  # pending end_pass_async worker
         self._in_pass = False
         self._staged = None  # (records, ws, stats) loaded but not begun
         self._loading_stats = self.stats
@@ -633,6 +634,9 @@ class BoxPSDataset:
         ``trainer``, the dense params/opt state) are snapshotted so
         ``revert_pass()`` can reject everything this pass publishes;
         ``end_pass`` confirms."""
+        # a pending async end_pass mutates the host table (writeback/decay/
+        # spill); finalize must see its final state
+        self.wait_end_pass()
         if self._staged is not None:
             if self._in_pass:
                 raise RuntimeError("end_pass the previous pass before begin_pass")
@@ -664,6 +668,11 @@ class BoxPSDataset:
         pre-pass value (undoing any partial/complete writeback), the dense
         side restores, and the in-memory data re-arms so ``begin_pass`` can
         retrain it from scratch."""
+        if self._end_pass_fut is not None:
+            try:
+                self.wait_end_pass()
+            except Exception:
+                pass  # a failed publish is exactly what revert undoes
         guard = getattr(self, "_guard", None)
         if guard is None or not guard.armed:
             raise RuntimeError(
@@ -693,31 +702,85 @@ class BoxPSDataset:
     ) -> dict:
         """Flush trained rows to the host store, decay/shrink, optional delta
         save (EndPass box_wrapper.cc:627 + SaveDelta :1316)."""
+        self.end_pass_async(
+            trained_table,
+            need_save_delta=need_save_delta,
+            delta_dir=delta_dir,
+            shrink=shrink,
+        )
+        return self.wait_end_pass()
+
+    def end_pass_async(
+        self,
+        trained_table: Optional[np.ndarray] = None,
+        need_save_delta: bool = False,
+        delta_dir: Optional[str] = None,
+        shrink: bool = True,
+    ) -> None:
+        """EndPass in a background thread, overlapped with the next pass's
+        ``set_date``/``load_into_memory``/``preload_into_memory``.
+
+        The device->host pull of the trained table plus the host writeback,
+        decay/shrink, delta save, and disk spill are the dominant
+        between-pass cost; none of it touches what the next LOAD needs (the
+        load only reads files and collects keys — the host table is first
+        consulted again at ``begin_pass`` finalize, which joins this thread
+        automatically). The same overlap the reference gets from BoxHelper's
+        feed/end thread pair (box_wrapper.h:897-959). ``trained_table`` may
+        be the live device array — the transfer happens on the worker.
+        Results surface from ``wait_end_pass`` (or the next begin_pass)."""
         if not self._in_pass:
             raise RuntimeError("begin_pass first")
-        if trained_table is not None:
-            self.ws.writeback(np.asarray(trained_table))
-        dropped = self.table.decay_and_shrink() if shrink else 0
-        saved = 0
-        if need_save_delta:
-            if delta_dir is None:
-                raise ValueError("need_save_delta requires delta_dir")
-            saved = self.table.save_delta(delta_dir)
-        # enforce the host-RAM cap: evict cold rows to the disk tier
-        # (LoadSSD2Mem inverse; next pass's finalize promotes what it needs)
-        if getattr(self.table, "mem_cap_rows", None) is not None:
-            self.table.maybe_spill()
-        # the pass is published: drop the rollback snapshot (Confirm parity)
-        guard = getattr(self, "_guard", None)
-        if guard is not None and guard.armed:
-            guard.confirm()
-        self._guard = None
+        if need_save_delta and delta_dir is None:
+            raise ValueError("need_save_delta requires delta_dir")
+        ws, guard, table = self.ws, getattr(self, "_guard", None), self.table
+        # the pass state clears NOW so the next load starts immediately.
+        # _guard intentionally STAYS set until the worker confirms: if the
+        # worker fails mid-writeback, revert_pass can still roll the pass
+        # back (the next begin_pass barriers on the worker and re-raises
+        # before arming a new guard, so the handles can't collide)
         self.records = []
         self.ws = None
         self.device_table = None
         self._in_pass = False
         self._auc_runner = None  # pools reference this pass's records only
-        return {"dropped": dropped, "delta_keys": saved}
+
+        def run():
+            if trained_table is not None:
+                ws.writeback(np.asarray(trained_table))
+            dropped = table.decay_and_shrink() if shrink else 0
+            saved = table.save_delta(delta_dir) if need_save_delta else 0
+            # enforce the host-RAM cap: evict cold rows to the disk tier
+            # (LoadSSD2Mem inverse; next finalize promotes what it needs)
+            if getattr(table, "mem_cap_rows", None) is not None:
+                table.maybe_spill()
+            # the pass is published: drop the rollback snapshot (Confirm)
+            if guard is not None and guard.armed:
+                guard.confirm()
+            if self._guard is guard:
+                self._guard = None
+            return {"dropped": dropped, "delta_keys": saved}
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        ex = ThreadPoolExecutor(max_workers=1)
+        self._end_pass_fut = ex.submit(run)
+        ex.shutdown(wait=False)
+
+    def wait_end_pass(self) -> dict:
+        """Join a pending end_pass_async; returns its result dict (or the
+        last one again if already joined; {} if none ever ran)."""
+        fut = self._end_pass_fut
+        if fut is not None:
+            try:
+                self._end_pass_result = fut.result()
+            except BaseException:
+                # never let a failed pass alias the previous pass's success
+                self._end_pass_result = {}
+                raise
+            finally:
+                self._end_pass_fut = None
+        return getattr(self, "_end_pass_result", {})
 
     # ---- batch serving ---------------------------------------------------
 

@@ -204,8 +204,8 @@ def pack_pv_batches(
     for blocks in _iter_pv_blocks(pvs, b, n_devices, drop_remainder):
         yield emit(blocks)
         emitted += 1
+    ghost = first_pv_record(pvs) if emitted < min_batches else None
     while emitted < min_batches:
-        ghost = first_pv_record(pvs)
         if ghost is None:
             raise ValueError(
                 "lockstep needs at least one local record to ghost-pad "

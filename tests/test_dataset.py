@@ -241,3 +241,69 @@ def test_trainer_multi_pass_with_preload(tmp_path):
     after = [np.asarray(x) for x in __import__("jax").tree.leaves(trainer.params)]
     for a, b in zip(before, after):
         np.testing.assert_array_equal(a, b)
+
+
+def test_end_pass_async_overlaps_next_load(tmp_path):
+    """end_pass_async runs writeback/decay in the background while the next
+    pass loads; begin_pass barriers on it. Final table state must equal the
+    fully-synchronous sequence."""
+    import optax
+
+    from paddlebox_tpu.models import LogisticRegression
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+
+    rng = np.random.default_rng(5)
+    files = write_files(tmp_path, 2, 64, rng)
+
+    def run(async_end):
+        layout = ValueLayout(embedx_dim=4)
+        opt = SparseOptimizerConfig(embedx_threshold=0.0)
+        table = HostSparseTable(layout, opt, n_shards=2, seed=0)
+        ds = BoxPSDataset(make_schema(), table, batch_size=16, seed=0)
+        model = LogisticRegression(num_slots=NUM_SLOTS, feat_width=layout.pull_width)
+        cfg = TrainStepConfig(
+            num_slots=NUM_SLOTS, batch_size=16, layout=layout,
+            sparse_opt=opt, auc_buckets=100,
+        )
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+        tr.init_params(jax.random.PRNGKey(0))
+        outs = []
+        for day, fl in (("20260101", files), ("20260102", files)):
+            ds.set_date(day)
+            ds.set_filelist(fl)
+            ds.load_into_memory()
+            ds.begin_pass(round_to=32)
+            tr.train_pass(ds)
+            if async_end:
+                ds.end_pass_async(tr.trained_table())
+            else:
+                outs.append(ds.end_pass(tr.trained_table()))
+        if async_end:
+            outs.append(ds.wait_end_pass())
+        keys = np.sort(table.keys())
+        return keys, table.pull_or_create(keys), outs[-1]
+
+    import jax
+
+    k_sync, v_sync, out_sync = run(False)
+    k_async, v_async, out_async = run(True)
+    np.testing.assert_array_equal(k_sync, k_async)
+    np.testing.assert_allclose(v_sync, v_async, atol=0)
+    assert out_sync["dropped"] == out_async["dropped"]
+
+
+def test_end_pass_async_rejects_double_call(tmp_path):
+    rng = np.random.default_rng(6)
+    files = write_files(tmp_path, 1, 32, rng)
+    layout = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(
+        layout, SparseOptimizerConfig(embedx_threshold=0.0), n_shards=2, seed=0
+    )
+    ds = BoxPSDataset(make_schema(), table, batch_size=16, seed=0)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+    ds.end_pass_async(None)
+    with pytest.raises(RuntimeError, match="begin_pass first"):
+        ds.end_pass_async(None)  # pass already closed
+    ds.wait_end_pass()
