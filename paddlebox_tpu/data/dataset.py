@@ -637,6 +637,14 @@ class BoxPSDataset:
         # a pending async end_pass mutates the host table (writeback/decay/
         # spill); finalize must see its final state
         self.wait_end_pass()
+        if self._in_pass:
+            # a FAILED end_pass re-opened the previous pass; silently
+            # starting a new one would strand its half-published state
+            # (and discard any armed rollback snapshot)
+            raise RuntimeError(
+                "previous pass is still open (its end_pass failed); retry "
+                "end_pass or revert_pass first"
+            )
         if self._staged is not None:
             if self._in_pass:
                 raise RuntimeError("end_pass the previous pass before begin_pass")
@@ -735,37 +743,55 @@ class BoxPSDataset:
             raise ValueError("need_save_delta requires delta_dir")
         ws, guard, table = self.ws, getattr(self, "_guard", None), self.table
         # the pass state clears NOW so the next load starts immediately.
-        # _guard intentionally STAYS set until the worker confirms: if the
-        # worker fails mid-writeback, revert_pass can still roll the pass
-        # back (the next begin_pass barriers on the worker and re-raises
-        # before arming a new guard, so the handles can't collide)
-        self.records = []
+        # _guard intentionally STAYS set until the worker confirms, and a
+        # worker FAILURE restores the cleared state — so a failed publish
+        # (bad delta dir, full disk) leaves the pass open for a retried
+        # end_pass, or revertible via revert_pass when a guard is armed;
+        # begin_pass refuses to start a new pass over the unresolved one
+        saved_state = (self.store, self._order, self._records)
+        self._records = []
+        self.store = None
+        self._order = None
         self.ws = None
         self.device_table = None
         self._in_pass = False
         self._auc_runner = None  # pools reference this pass's records only
 
         def run():
-            if trained_table is not None:
-                ws.writeback(np.asarray(trained_table))
-            dropped = table.decay_and_shrink() if shrink else 0
-            saved = table.save_delta(delta_dir) if need_save_delta else 0
-            # enforce the host-RAM cap: evict cold rows to the disk tier
-            # (LoadSSD2Mem inverse; next finalize promotes what it needs)
-            if getattr(table, "mem_cap_rows", None) is not None:
-                table.maybe_spill()
-            # the pass is published: drop the rollback snapshot (Confirm)
-            if guard is not None and guard.armed:
-                guard.confirm()
-            if self._guard is guard:
-                self._guard = None
-            return {"dropped": dropped, "delta_keys": saved}
+            try:
+                if trained_table is not None:
+                    ws.writeback(np.asarray(trained_table))
+                dropped = table.decay_and_shrink() if shrink else 0
+                saved = table.save_delta(delta_dir) if need_save_delta else 0
+                # enforce the host-RAM cap: evict cold rows to the disk tier
+                # (LoadSSD2Mem inverse; next finalize promotes what it needs)
+                if getattr(table, "mem_cap_rows", None) is not None:
+                    table.maybe_spill()
+                # the pass is published: drop the rollback snapshot (Confirm)
+                if guard is not None and guard.armed:
+                    guard.confirm()
+                if self._guard is guard:
+                    self._guard = None
+                return {"dropped": dropped, "delta_keys": saved}
+            except BaseException:
+                # re-open the pass so the failure is recoverable
+                self.store, self._order, self._records = saved_state
+                self.ws = ws
+                self._in_pass = True
+                raise
 
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import Future
 
-        ex = ThreadPoolExecutor(max_workers=1)
-        self._end_pass_fut = ex.submit(run)
-        ex.shutdown(wait=False)
+        fut: Future = Future()
+
+        def worker():
+            try:
+                fut.set_result(run())
+            except BaseException as e:
+                fut.set_exception(e)
+
+        self._end_pass_fut = fut
+        threading.Thread(target=worker, daemon=True).start()
 
     def wait_end_pass(self) -> dict:
         """Join a pending end_pass_async; returns its result dict (or the

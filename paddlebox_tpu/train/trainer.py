@@ -817,33 +817,18 @@ class CTRTrainer:
                 t_feed, t_disp, t_dev,
             )
 
-        for i, m, aux in stepper:
-            t_host.start()
-            if "nan_skipped" in m:  # lazy device array: no per-batch sync
-                skip_flags.append(m["nan_skipped"])
-            # containment must extend to every host-side consumer: a skipped
-            # batch's NaN preds/grads reach neither the async dense table
-            # nor the registry/dumps. The int() sync only happens when such
-            # a consumer exists (those paths already sync per batch).
-            skipped_now = 0
-            if "nan_skipped" in m and (
-                is_async or self.metric_registry is not None or self.dump_pool is not None
-            ):
-                skipped_now = int(m["nan_skipped"])
-            if is_async and not skipped_now:
-                self.async_dense.push_dense(jax.tree.map(np.asarray, m["gparams"]))
-            if self.metric_registry is not None and not skipped_now:
-                # per-batch registry feed with phase + logkey-derived vars
-                # (AddAucMonitor parity, boxps_worker.cc:408-418)
-                outputs = dict(m)
-                outputs.update(aux)
-                self.metric_registry.add_all(outputs, phase=dataset.current_phase)
-            if self.dump_pool is not None and not skipped_now:
-                self._dump_batch(i, m, aux)
-            if on_batch is not None:
-                on_batch(i, m)
-            losses.append(m["loss"])
-            t_host.pause()
+        try:
+            for i, m, aux in stepper:
+                self._consume_batch(
+                    i, m, aux, dataset, is_async, on_batch, losses,
+                    skip_flags, t_host,
+                )
+        except BaseException:
+            # the cached pre-pass state was donated into this pass's steps;
+            # re-point at the last GOOD returned state so a retry (or
+            # revert+retrain) never touches deleted buffers
+            self._state = holder["state"]
+            raise
         state = holder["state"]
         # persist dense side for the next pass; state.table stays for writeback
         if eval_mode:
@@ -918,6 +903,37 @@ class CTRTrainer:
                 "host_metrics_s": round(t_host.elapsed_sec(), 4),
             }
         return out
+
+    def _consume_batch(
+        self, i, m, aux, dataset, is_async, on_batch, losses, skip_flags, t_host
+    ) -> None:
+        """Host-side per-batch consumers, shared by both steppers."""
+        t_host.start()
+        if "nan_skipped" in m:  # lazy device array: no per-batch sync
+            skip_flags.append(m["nan_skipped"])
+        # containment must extend to every host-side consumer: a skipped
+        # batch's NaN preds/grads reach neither the async dense table
+        # nor the registry/dumps. The int() sync only happens when such
+        # a consumer exists (those paths already sync per batch).
+        skipped_now = 0
+        if "nan_skipped" in m and (
+            is_async or self.metric_registry is not None or self.dump_pool is not None
+        ):
+            skipped_now = int(m["nan_skipped"])
+        if is_async and not skipped_now:
+            self.async_dense.push_dense(jax.tree.map(np.asarray, m["gparams"]))
+        if self.metric_registry is not None and not skipped_now:
+            # per-batch registry feed with phase + logkey-derived vars
+            # (AddAucMonitor parity, boxps_worker.cc:408-418)
+            outputs = dict(m)
+            outputs.update(aux)
+            self.metric_registry.add_all(outputs, phase=dataset.current_phase)
+        if self.dump_pool is not None and not skipped_now:
+            self._dump_batch(i, m, aux)
+        if on_batch is not None:
+            on_batch(i, m)
+        losses.append(m["loss"])
+        t_host.pause()
 
     def _dump_batch(self, step_i: int, m: Dict, aux: Dict) -> None:
         """Per-batch field dump (DeviceWorker::DumpField parity,

@@ -307,3 +307,39 @@ def test_end_pass_async_rejects_double_call(tmp_path):
     with pytest.raises(RuntimeError, match="begin_pass first"):
         ds.end_pass_async(None)  # pass already closed
     ds.wait_end_pass()
+
+
+def test_end_pass_async_failure_is_recoverable(tmp_path):
+    """A worker failure (e.g. delta save to a broken path) re-opens the
+    pass: begin_pass refuses to start a new one, and a retried end_pass
+    completes with the same final state as a never-failed run."""
+    rng = np.random.default_rng(7)
+    files = write_files(tmp_path, 1, 32, rng)
+    layout = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(
+        layout, SparseOptimizerConfig(embedx_threshold=0.0), n_shards=2, seed=0
+    )
+    ds = BoxPSDataset(make_schema(), table, batch_size=16, seed=0)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+    n_records = ds.memory_data_size()
+
+    real_save = type(table).save_delta
+    type(table).save_delta = lambda self, d: (_ for _ in ()).throw(
+        OSError("disk full")
+    )
+    try:
+        ds.end_pass_async(None, need_save_delta=True, delta_dir=str(tmp_path / "d"))
+        with pytest.raises(OSError, match="disk full"):
+            ds.wait_end_pass()
+    finally:
+        type(table).save_delta = real_save
+    # the pass re-opened: data intact, new pass refused
+    assert ds.memory_data_size() == n_records and ds.ws is not None
+    with pytest.raises(RuntimeError, match="still open"):
+        ds.begin_pass(round_to=32)
+    # retry succeeds now that the fault is fixed
+    out = ds.end_pass(None, need_save_delta=True, delta_dir=str(tmp_path / "d"))
+    assert out["delta_keys"] >= 0
+    assert not ds._in_pass

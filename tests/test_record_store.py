@@ -275,3 +275,134 @@ def test_store_path_train_matches_slow_path(tmp_path):
 
 import jax  # noqa: E402  (used by the end-to-end test)
 from paddlebox_tpu import config as config  # noqa: F811
+
+
+def test_failing_pack_thread_mid_pass_surfaces_cleanly(tmp_path):
+    """A pack worker dying mid-pass must surface its error at the failing
+    batch's position (no hang, no silent truncation), and the trainer must
+    stay usable for a retrain (the recovery path confirm/revert relies on)."""
+    import optax
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.data import BoxPSDataset
+    from paddlebox_tpu.data.device_pack import BatchPacker
+    from paddlebox_tpu.models import LogisticRegression
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+    from paddlebox_tpu.utils import native
+
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(8)
+    schema = make_schema()
+    lines = []
+    for _ in range(96):
+        parts = [f"1 {float(rng.integers(0, 2))}"]
+        for _ in range(NS):
+            parts.append(f"1 {rng.integers(1, 200)}")
+        lines.append(" ".join(parts))
+    p = tmp_path / "d.txt"
+    p.write_text("\n".join(lines) + "\n")
+
+    layout = ValueLayout(embedx_dim=4)
+    opt = SparseOptimizerConfig(embedx_threshold=0.0)
+    table = HostSparseTable(layout, opt, n_shards=2, seed=0)
+    ds = BoxPSDataset(schema, table, batch_size=16, seed=0)
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=32)
+    model = LogisticRegression(num_slots=NS, feat_width=layout.pull_width)
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=16, layout=layout, sparse_opt=opt,
+        auc_buckets=100,
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+
+    real_pack = BatchPacker.pack
+    calls = {"n": 0}
+
+    def failing_pack(self, idx):
+        calls["n"] += 1
+        if calls["n"] == 4:
+            raise RuntimeError("pack thread died")
+        return real_pack(self, idx)
+
+    prev = config.get_flag("enable_resident_feed")
+    config.set_flag("enable_resident_feed", 0)  # exercise the threaded packer
+    try:
+        BatchPacker.pack = failing_pack
+        seen = []
+        with pytest.raises(RuntimeError, match="pack thread died"):
+            tr.train_pass(ds, n_batches=6, on_batch=lambda i, m: seen.append(i))
+        # batches before the failing position were consumed in order
+        assert seen == [0, 1, 2]
+        BatchPacker.pack = real_pack
+        out = tr.train_pass(ds, n_batches=6)  # trainer still usable
+        assert out["batches"] == 6 and np.isfinite(out["loss"])
+    finally:
+        BatchPacker.pack = real_pack
+        config.set_flag("enable_resident_feed", prev)
+
+
+def test_frozen_shapes_compile_once_across_growing_batches(tmp_path):
+    """freeze_shapes pins L/U pads from the whole partition upfront: a pass
+    whose later batches have more keys/uniques than its first must still
+    compile exactly ONE device program (classic path) / one scan program
+    per chunk length (resident path)."""
+    import optax
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.data import BoxPSDataset
+    from paddlebox_tpu.models import LogisticRegression
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+    from paddlebox_tpu.utils import native
+
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(9)
+    schema = make_schema()
+    lines = []
+    # keys-per-slot GROWS through the file: early batches are small, late
+    # batches have 3x the keys and far more uniques
+    for i in range(128):
+        parts = [f"1 {float(rng.integers(0, 2))}"]
+        n = 1 if i < 64 else 3
+        for _ in range(NS):
+            parts.append(
+                f"{n} " + " ".join(str(rng.integers(1, 5000)) for _ in range(n))
+            )
+        lines.append(" ".join(parts))
+    p = tmp_path / "d.txt"
+    p.write_text("\n".join(lines) + "\n")
+
+    def run(resident):
+        layout = ValueLayout(embedx_dim=4)
+        opt = SparseOptimizerConfig(embedx_threshold=0.0)
+        table = HostSparseTable(layout, opt, n_shards=2, seed=0)
+        ds = BoxPSDataset(schema, table, batch_size=16, shuffle_mode="none")
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=32)
+        model = LogisticRegression(num_slots=NS, feat_width=layout.pull_width)
+        cfg = TrainStepConfig(
+            num_slots=NS, batch_size=16, layout=layout, sparse_opt=opt,
+            auc_buckets=100,
+        )
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+        tr.init_params(jax.random.PRNGKey(0))
+        prev = config.get_flag("enable_resident_feed")
+        config.set_flag("enable_resident_feed", resident)
+        try:
+            tr.train_pass(ds)
+        finally:
+            config.set_flag("enable_resident_feed", prev)
+        return tr
+
+    tr = run(resident=0)
+    assert tr._step._cache_size() == 1, "classic path must compile once"
+    tr = run(resident=1)
+    sizes = [s._cache_size() for s in tr._sstep_cache.values()]
+    assert sizes and all(s <= 2 for s in sizes), (
+        "resident superstep must compile once per chunk length "
+        f"(full + tail), got cache sizes {sizes}"
+    )
