@@ -638,16 +638,14 @@ class BoxPSDataset:
         # spill); finalize must see its final state
         self.wait_end_pass()
         if self._in_pass:
-            # a FAILED end_pass re-opened the previous pass; silently
-            # starting a new one would strand its half-published state
-            # (and discard any armed rollback snapshot)
+            # either end_pass was never called, or a FAILED end_pass
+            # re-opened the pass; silently starting a new one would strand
+            # its state (and discard any armed rollback snapshot)
             raise RuntimeError(
-                "previous pass is still open (its end_pass failed); retry "
-                "end_pass or revert_pass first"
+                "previous pass is still open — call end_pass (or, after a "
+                "failed end_pass, retry it / revert_pass) before begin_pass"
             )
         if self._staged is not None:
-            if self._in_pass:
-                raise RuntimeError("end_pass the previous pass before begin_pass")
             self._publish(self._staged)
             self._staged = None
         if self.ws is None:
@@ -791,7 +789,9 @@ class BoxPSDataset:
                 fut.set_exception(e)
 
         self._end_pass_fut = fut
-        threading.Thread(target=worker, daemon=True).start()
+        # non-daemon: interpreter exit JOINS an in-flight publish instead of
+        # killing it mid-write (truncated delta files, lost writeback)
+        threading.Thread(target=worker, daemon=False).start()
 
     def wait_end_pass(self) -> dict:
         """Join a pending end_pass_async; returns its result dict (or the

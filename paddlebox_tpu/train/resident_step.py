@@ -99,9 +99,11 @@ class ResidentPass:
             self.dense = jnp.asarray(store.float_slot_matrix(di, dense_dim))
         self.L_pad = 0
         self.U_pad = 0
+        self.K_pad = 0  # mesh tier: per-(device, shard) request bucket
         # keyed by the exact index bytes, not a hash — a collision would
         # freeze U_pad too small and silently merge distinct rows
         self._uniq_cache: Dict[bytes, int] = {}
+        self._mesh_cache: Dict = {}  # (device, idx bytes) -> (L, bucket max)
 
     def ensure(self, batch_indices) -> None:
         """Freeze/grow L_pad and U_pad to cover every batch in the partition
@@ -210,3 +212,186 @@ def make_resident_superstep(
         return jax.lax.scan(body, state, idx_block)
 
     return jax.jit(superstep, donate_argnums=(0,))
+
+
+# ---- mesh (single-host) resident tier --------------------------------------
+
+
+def ensure_sharded(rp: ResidentPass, batch_indices, n_devices: int) -> None:
+    """Freeze/grow the mesh pads: per-DEVICE L_pad and the per-(device,
+    shard) request bucket K_pad (exact scan, cached per index block — the
+    resident analog of BatchPacker.freeze_shapes' lockstep branch)."""
+    cap, ns = rp.ws.capacity, rp.ws.n_mesh_shards
+    max_L, max_bucket = 1, 0
+    for idx in batch_indices:
+        idx = np.asarray(idx)
+        b = len(idx) // n_devices
+        for d in range(n_devices):
+            sl = idx[d * b : (d + 1) * b]
+            fp = (d, sl.tobytes())
+            cached = rp._mesh_cache.get(fp)
+            if cached is None:
+                from paddlebox_tpu.data.record_store import _ragged_indices
+
+                counts = rp._key_counts[sl]
+                rows = rp._host_rows[
+                    _ragged_indices(rp.store.u64_base[sl], counts)
+                ]
+                L = len(rows)
+                if L:
+                    uniq = np.unique(rows)
+                    bmax = int(np.bincount(uniq // cap, minlength=ns).max())
+                else:
+                    bmax = 0
+                cached = rp._mesh_cache[fp] = (L, bmax)
+            max_L = max(max_L, cached[0])
+            max_bucket = max(max_bucket, cached[1])
+    rp.L_pad = max(rp.L_pad, _round_bucket(max_L, rp.bucket))
+    rp.K_pad = max(rp.K_pad, _round_bucket(max_bucket + 1, rp.bucket))
+
+
+def build_mesh_device_batch(
+    rp_arrays: Dict[str, jnp.ndarray],
+    cfg: TrainStepConfig,
+    idx_dev: jnp.ndarray,  # [b] this device's record indices
+    L_pad: int,
+    K: int,
+    ns: int,
+    cap: int,
+) -> Dict[str, jnp.ndarray]:
+    """One device's mesh batch (req_ranks/inverse/segments/labels) built on
+    device from the resident arrays — the _route_sharded host routine as
+    static-shape XLA ops (sort groups rows by owner shard for free since
+    global row ids are shard-major: row = shard*cap + rank)."""
+    S, b = cfg.num_slots, cfg.batch_size
+    rows_res, off_res, labels_res = (
+        rp_arrays["rows"], rp_arrays["off"], rp_arrays["labels"],
+    )
+    off_b = off_res[idx_dev]  # [b, S+1]
+    lens_b = off_b[:, 1:] - off_b[:, :-1]
+    starts_b = off_b[:, :-1]
+    lens_flat = lens_b.T.reshape(-1)  # slot-major [S*b]
+    starts_flat = starts_b.T.reshape(-1)
+    cum = jnp.cumsum(lens_flat)
+    L_real = cum[-1]
+    pos = jnp.arange(L_pad, dtype=jnp.int32)
+    seg_c = jnp.minimum(
+        jnp.searchsorted(cum, pos, side="right").astype(jnp.int32), S * b - 1
+    )
+    within = pos - (cum[seg_c] - lens_flat[seg_c])
+    src = jnp.clip(starts_flat[seg_c] + within, 0, rows_res.shape[0] - 1)
+    valid = pos < L_real
+    rows_flat = jnp.where(valid, rows_res[src], jnp.int32(ns * cap))
+    segments = jnp.where(valid, seg_c, S * b)  # local slot*b + ins
+
+    # route: sort by global row id (== by owner shard), first-occurrence
+    # scan assigns each unique row its request-bucket slot j within its
+    # shard; pads ride in bucket (shard 0, K-1), whose row is the reserved
+    # padding row cap-1 via the req_ranks fill
+    INF = jnp.int32(ns * cap)  # rows_flat already pads with this sentinel
+    sorted_rows, perm = jax.lax.sort_key_val(
+        rows_flat, jnp.arange(L_pad, dtype=jnp.int32)
+    )
+    real = sorted_rows < INF
+    first = (
+        jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sorted_rows[1:] != sorted_rows[:-1]]
+        )
+        & real
+    )
+    uniq_seq = jnp.cumsum(first.astype(jnp.int32)) - 1  # global unique ordinal
+    shard = jnp.where(real, sorted_rows // cap, 0)
+    cnts = jax.ops.segment_sum(
+        first.astype(jnp.int32), shard, num_segments=ns
+    )  # uniques per shard
+    shard_start = jnp.cumsum(cnts) - cnts  # exclusive
+    j = jnp.clip(uniq_seq - shard_start[shard], 0, K - 2)
+    bucket_sorted = jnp.where(real, shard * K + j, (K - 1))  # pads -> shard 0
+    inverse = jnp.zeros((L_pad,), jnp.int32).at[perm].set(bucket_sorted)
+    # request matrix: rank-within-shard at (shard, j) for each first
+    # occurrence; everything else (incl. the K-1 pad slot) = cap-1 pad row
+    flat_pos = jnp.where(first, shard * K + j, ns * K)  # non-first -> dropped
+    req_ranks = (
+        jnp.full((ns * K,), cap - 1, jnp.int32)
+        .at[flat_pos]
+        .set(jnp.where(real, sorted_rows % cap, cap - 1).astype(jnp.int32),
+             mode="drop")
+        .reshape(ns, K)
+    )
+    return {
+        "req_ranks": req_ranks,
+        "inverse": inverse,
+        "segments": segments,
+        "labels": labels_res[idx_dev],
+    }
+
+
+def make_resident_mesh_superstep(
+    model_apply: Callable,
+    dense_opt,
+    cfg: TrainStepConfig,
+    rp: ResidentPass,
+    plan,
+    eval_mode: bool = False,
+) -> Callable:
+    """``superstep(state, idx_block [K_scan, n_dev, b]) -> (state, metrics)``
+    on a SINGLE-HOST mesh: resident arrays replicated across local devices,
+    each device builds its own route buckets, then the shared per-device
+    mesh step body runs (make_local_mesh_step — identical numerics to the
+    host-packed path)."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddlebox_tpu.train.sharded_step import (
+        make_local_mesh_step,
+        mesh_metric_specs,
+        mesh_state_specs,
+    )
+
+    if _jax.process_count() > 1:
+        raise NotImplementedError(
+            "resident mesh feed is single-host (replicated resident arrays); "
+            "multi-host meshes use the transport-locksteped host packer"
+        )
+    local_step = make_local_mesh_step(model_apply, dense_opt, cfg, plan, eval_mode)
+    ns, cap = rp.ws.n_mesh_shards, rp.ws.capacity
+    L_pad, K = rp.L_pad, rp.K_pad
+
+    def superstep_local(state, idx_block, rows, off, labels):
+        rp_arrays = {"rows": rows, "off": off, "labels": labels}
+
+        def body(st, idx):  # idx [1, b] (this device's slice)
+            batch = build_mesh_device_batch(
+                rp_arrays, cfg, idx[0], L_pad, K, ns, cap
+            )
+            batch = {k: v[None] for k, v in batch.items()}
+            return local_step(st, batch)
+
+        return _jax.lax.scan(body, state, idx_block)
+
+    state_specs = mesh_state_specs(cfg, dense_opt, plan)
+    # per-step metric specs shift one dim right under the scan stacking:
+    # preds/labels come out [K_scan, b] per device and assemble
+    # [K_scan, n_dev*b] — P(axis) on dim 0 would interleave devices into
+    # the scan axis and hand consumers only device 0's slice
+    per_step = mesh_metric_specs(cfg, plan, eval_mode)
+    metric_specs = {
+        k: (P(None, *s) if s else P()) for k, s in per_step.items()
+    }
+    rep = P()
+
+    def superstep(state, idx_block):
+        mapped = _jax.shard_map(
+            superstep_local,
+            mesh=plan.mesh,
+            in_specs=(
+                state_specs,
+                P(None, plan.axis),  # scan axis whole, device axis split
+                rep, rep, rep,
+            ),
+            out_specs=(state_specs, metric_specs),
+            check_vma=False,
+        )
+        return mapped(state, idx_block, rp.rows, rp.off, rp.labels)
+
+    return _jax.jit(superstep, donate_argnums=(0,))

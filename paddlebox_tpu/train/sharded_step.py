@@ -96,23 +96,19 @@ def init_sharded_train_state(
     )
 
 
-def make_sharded_train_step(
+def make_local_mesh_step(
     model_apply: Callable,
     dense_opt: optax.GradientTransformation,
     cfg: TrainStepConfig,
     plan: MeshPlan,
     eval_mode: bool = False,
 ) -> Callable:
-    """Build jitted ``step(state, batch_dict) -> (state, metrics)`` on the mesh.
+    """The PER-DEVICE mesh step body (runs inside shard_map).
 
-    ``cfg.batch_size`` is the PER-DEVICE batch; ``batch_dict`` fields come from
-    ``pack_batch_sharded`` (req_ranks/inverse/segments/labels[/dense], all with
-    a leading device axis) placed with ``plan.batch_sharding``.
-
-    ``eval_mode`` (SetTestMode parity, box_wrapper.cc:623): forward +
-    metrics only — the sharded pull/all_to_all still runs, but no push, no
-    dense update; table/params/opt_state return bit-identical.
-    """
+    Factored out of make_sharded_train_step so the resident-feed tier can
+    reuse the exact same numerics after building the batch on device; the
+    host-packed path wraps it in shard_map directly. Batch fields carry a
+    unit leading device axis (the dp shard of the global batch)."""
     if cfg.axis_name not in (None, plan.axis):
         raise ValueError(
             f"cfg.axis_name {cfg.axis_name!r} != mesh axis {plan.axis!r}; the "
@@ -342,10 +338,15 @@ def make_sharded_train_step(
         )
         return new_state, metrics
 
-    dp = P(ax)
-    rep = P()
+    return local_step
+
+
+def mesh_state_specs(cfg: TrainStepConfig, dense_opt, plan: MeshPlan) -> TrainState:
+    """PartitionSpecs of the sharded TrainState (shared by both feed tiers)."""
+    dp, rep = P(plan.axis), P()
     kstep_mode = cfg.dense_sync_mode == "kstep"
-    state_specs = TrainState(
+    is_zero = isinstance(dense_opt, Zero1Optimizer)
+    return TrainState(
         table=dp,
         params=dp if kstep_mode else rep,
         opt_state=dp if (kstep_mode or is_zero) else rep,
@@ -353,12 +354,39 @@ def make_sharded_train_step(
         step=rep,
     )
 
-    def batch_specs(batch):
-        return {k: dp for k in batch}
 
+def mesh_metric_specs(cfg: TrainStepConfig, plan: MeshPlan, eval_mode: bool) -> Dict:
+    dp, rep = P(plan.axis), P()
     metric_specs = {"loss": rep, "step": rep, "preds": dp, "labels": dp}
     if cfg.check_nan and not eval_mode:
         metric_specs["nan_skipped"] = rep  # psum'd -> uniform
+    return metric_specs
+
+
+def make_sharded_train_step(
+    model_apply: Callable,
+    dense_opt: optax.GradientTransformation,
+    cfg: TrainStepConfig,
+    plan: MeshPlan,
+    eval_mode: bool = False,
+) -> Callable:
+    """Build jitted ``step(state, batch_dict) -> (state, metrics)`` on the mesh.
+
+    ``cfg.batch_size`` is the PER-DEVICE batch; ``batch_dict`` fields come from
+    ``pack_batch_sharded`` (req_ranks/inverse/segments/labels[/dense], all with
+    a leading device axis) placed with ``plan.batch_sharding``.
+
+    ``eval_mode`` (SetTestMode parity, box_wrapper.cc:623): forward +
+    metrics only — the sharded pull/all_to_all still runs, but no push, no
+    dense update; table/params/opt_state return bit-identical.
+    """
+    local_step = make_local_mesh_step(model_apply, dense_opt, cfg, plan, eval_mode)
+    dp = P(plan.axis)
+    state_specs = mesh_state_specs(cfg, dense_opt, plan)
+    metric_specs = mesh_metric_specs(cfg, plan, eval_mode)
+
+    def batch_specs(batch):
+        return {k: dp for k in batch}
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
         mapped = jax.shard_map(

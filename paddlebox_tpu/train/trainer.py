@@ -592,13 +592,24 @@ class CTRTrainer:
         cache = getattr(self, "_sstep_cache", None)
         if cache is None:
             cache = self._sstep_cache = {}
-        key = (id(rp), eval_mode, rp.L_pad, rp.U_pad)
+        key = (id(rp), eval_mode, rp.L_pad, rp.U_pad, rp.K_pad)
         ss = cache.get(key)
         if ss is None:
-            ss = cache[key] = make_resident_superstep(
-                self.model.apply, self.dense_opt, self.cfg, rp,
-                eval_mode=eval_mode,
-            )
+            if self.plan is None:
+                ss = make_resident_superstep(
+                    self.model.apply, self.dense_opt, self.cfg, rp,
+                    eval_mode=eval_mode,
+                )
+            else:
+                from paddlebox_tpu.train.resident_step import (
+                    make_resident_mesh_superstep,
+                )
+
+                ss = make_resident_mesh_superstep(
+                    self.model.apply, self.dense_opt, self.cfg, rp,
+                    self.plan, eval_mode=eval_mode,
+                )
+            cache[key] = ss
         return ss
 
     def _resident_stepper(
@@ -616,7 +627,12 @@ class CTRTrainer:
                 np.asarray(b, dtype=np.int32)
                 for b in dataset.batch_indices(n_batches)
             ]
-            rp.ensure(blocks)
+            if self.plan is None:
+                rp.ensure(blocks)
+            else:
+                from paddlebox_tpu.train.resident_step import ensure_sharded
+
+                ensure_sharded(rp, blocks, self.plan.n_devices)
             sstep = self._resident_superstep(rp, eval_mode)
         t_feed.pause()
         # profiling wants per-batch device attribution: drop to one batch
@@ -648,11 +664,25 @@ class CTRTrainer:
                     if want_ids
                     else None
                 )
+                idx_block = np.stack(chunk)
+                if self.plan is not None:
+                    # [K, B_global] -> [K, n_dev, b]: record r -> device
+                    # r // b, the same ins // b mapping the sharded packer
+                    # uses; the scan axis stays whole, devices split
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+
+                    idx_dev = jax.device_put(
+                        idx_block.reshape(
+                            len(chunk), self.plan.n_devices, -1
+                        ),
+                        NamedSharding(self.plan.mesh, P(None, self.plan.axis)),
+                    )
+                else:
+                    idx_dev = jnp.asarray(idx_block)
                 t_disp.start()
                 with PROFILER.record_event("superstep_dispatch", "pass"):
-                    holder["state"], mstack = sstep(
-                        holder["state"], jnp.asarray(np.stack(chunk))
-                    )
+                    holder["state"], mstack = sstep(holder["state"], idx_dev)
                 t_disp.pause()
                 if profile:
                     t_dev.start()
@@ -683,10 +713,14 @@ class CTRTrainer:
     def _use_resident(self, dataset: BoxPSDataset, use_pv: bool, is_async: bool) -> bool:
         """One predicate for the resident-vs-packer path, shared by
         train_pass and prepare_pass so the warm-start hook can never
-        pre-freeze a different feed path than training will take."""
+        pre-freeze a different feed path than training will take.
+
+        Covers the single-device step and SINGLE-HOST meshes (resident
+        arrays replicate across local devices); multi-host meshes keep the
+        transport-locksteped host packer."""
         return (
             bool(config.get_flag("enable_resident_feed"))
-            and self.plan is None
+            and (self.plan is None or jax.process_count() == 1)
             and not use_pv
             and not is_async
             and not self.cfg.model_takes_rank_offset
@@ -713,10 +747,17 @@ class CTRTrainer:
             return
         is_async = self.cfg.dense_sync_mode == "async" and not self._eval_active
         if self._use_resident(dataset, use_pv, is_async):
-            self._get_resident(dataset).ensure(
+            rp = self._get_resident(dataset)
+            blocks = (
                 np.asarray(b, dtype=np.int32)
                 for b in dataset.batch_indices(n_batches)
             )
+            if self.plan is None:
+                rp.ensure(blocks)
+            else:
+                from paddlebox_tpu.train.resident_step import ensure_sharded
+
+                ensure_sharded(rp, blocks, self.plan.n_devices)
         else:
             self._get_packer(dataset).freeze_shapes(
                 dataset.batch_indices(n_batches),
@@ -825,9 +866,18 @@ class CTRTrainer:
                 )
         except BaseException:
             # the cached pre-pass state was donated into this pass's steps;
-            # re-point at the last GOOD returned state so a retry (or
-            # revert+retrain) never touches deleted buffers
-            self._state = holder["state"]
+            # re-point at the last returned state so a retry (or
+            # revert+retrain) doesn't touch deleted buffers. If the FAILING
+            # call itself consumed that state (XLA runtime error after
+            # donation), drop the cache so the retry rebuilds from the
+            # dataset's pass-open table instead of crashing on dead arrays
+            st = holder["state"]
+            alive = True
+            try:
+                alive = not st.table.is_deleted()
+            except AttributeError:
+                pass  # host-side array: always alive
+            self._state = st if alive else None
             raise
         state = holder["state"]
         # persist dense side for the next pass; state.table stays for writeback

@@ -250,3 +250,63 @@ def test_resident_registry_and_dump_consumers(tmp_path):
     for (_, lc), (_, lr) in zip(seen_c, seen_r):
         assert np.isclose(lc, lr, atol=1e-5)
     assert np.isclose(auc_c, auc_r, atol=1e-6)
+
+
+def test_resident_mesh_matches_host_packed_mesh(tmp_path):
+    """Single-host mesh: the device-built route buckets (sort-based shard
+    grouping) train to the same losses/table as the host-packed
+    pack_batch_sharded path — internal bucket order may differ, sums
+    must not."""
+    from paddlebox_tpu.parallel import make_mesh
+
+    from paddlebox_tpu.metrics.registry import MetricRegistry
+
+    def run(resident):
+        prev = config.get_flag("enable_resident_feed")
+        config.set_flag("enable_resident_feed", resident)
+        try:
+            schema = _schema()
+            layout = ValueLayout(embedx_dim=4)
+            table = HostSparseTable(
+                layout, SparseOptimizerConfig(embedx_threshold=0.0),
+                n_shards=4, seed=0,
+            )
+            plan = make_mesh(4)
+            ds = BoxPSDataset(
+                schema, table, batch_size=16, n_mesh_shards=4,
+                shuffle_mode="none",
+            )
+            ds.set_filelist(_write_files(tmp_path / f"r{resident}", n=64))
+            ds.load_into_memory()
+            ds.begin_pass(round_to=16)
+            model = DeepFM(
+                num_slots=S, feat_width=layout.pull_width, embedx_dim=4,
+                hidden=(8,),
+            )
+            cfg = TrainStepConfig(
+                num_slots=S, batch_size=4, layout=layout,
+                sparse_opt=SparseOptimizerConfig(embedx_threshold=0.0),
+                auc_buckets=100, axis_name=plan.axis,
+            )
+            reg = MetricRegistry()
+            reg.init_metric("auc", "auc", phase=-1)
+            tr = CTRTrainer(
+                model, cfg, dense_opt=optax.adam(1e-2), plan=plan,
+                metric_registry=reg,
+            )
+            tr.init_params(jax.random.PRNGKey(0))
+            out = tr.train_pass(ds)
+            return out, np.asarray(tr.trained_table()), reg.get_metric("auc")
+        finally:
+            config.set_flag("enable_resident_feed", prev)
+
+    out_h, table_h, reg_h = run(0)
+    out_r, table_r, reg_r = run(1)
+    assert out_r["batches"] == out_h["batches"]
+    assert np.isclose(out_r["loss"], out_h["loss"], atol=1e-5)
+    assert np.isclose(out_r["auc"], out_h["auc"], atol=1e-6)
+    np.testing.assert_allclose(table_r, table_h, atol=1e-4)
+    # consumers must see EVERY device's slice of each batch (a wrong
+    # scan-axis spec would hand the registry 1/n_dev of the data)
+    assert reg_r["ins_num"] == reg_h["ins_num"] == 64
+    assert np.isclose(reg_r["auc"], reg_h["auc"], atol=1e-6)
