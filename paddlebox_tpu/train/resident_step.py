@@ -131,6 +131,38 @@ class ResidentPass:
 
 
 
+def _ragged_rows(
+    rows_res: jnp.ndarray,
+    off_res: jnp.ndarray,
+    idx: jnp.ndarray,  # [B] record indices
+    S: int,
+    B: int,
+    L_pad: int,
+    pad_value,
+):
+    """Shared ragged gather: record indices -> (rows_flat, segments, valid)
+    in slot-major flat order. ``pad_value`` fills invalid tail rows (the
+    single-device tier pads with the real padding row; the mesh tier with
+    an out-of-range sentinel its sort treats as +inf)."""
+    off_b = off_res[idx]  # [B, S+1]
+    lens_b = off_b[:, 1:] - off_b[:, :-1]
+    starts_b = off_b[:, :-1]
+    lens_flat = lens_b.T.reshape(-1)  # [S*B] slot-major
+    starts_flat = starts_b.T.reshape(-1)
+    cum = jnp.cumsum(lens_flat)
+    L_real = cum[-1]
+    pos = jnp.arange(L_pad, dtype=jnp.int32)
+    seg_c = jnp.minimum(
+        jnp.searchsorted(cum, pos, side="right").astype(jnp.int32), S * B - 1
+    )
+    within = pos - (cum[seg_c] - lens_flat[seg_c])
+    src = jnp.clip(starts_flat[seg_c] + within, 0, rows_res.shape[0] - 1)
+    valid = pos < L_real
+    rows_flat = jnp.where(valid, rows_res[src], pad_value)
+    segments = jnp.where(valid, seg_c, S * B)  # seg_c IS slot*B + ins
+    return rows_flat, segments, valid
+
+
 def build_device_batch(
     rp: ResidentPass, cfg: TrainStepConfig, idx: jnp.ndarray
 ) -> Dict[str, jnp.ndarray]:
@@ -142,23 +174,9 @@ def build_device_batch(
     """
     S, B = cfg.num_slots, cfg.batch_size
     L_pad, U_pad = rp.L_pad, rp.U_pad
-    off_b = rp.off[idx]  # [B, S+1]
-    lens_b = off_b[:, 1:] - off_b[:, :-1]
-    starts_b = off_b[:, :-1]
-    # slot-major flat order: all instances' slot-0 keys, then slot 1 ...
-    lens_flat = lens_b.T.reshape(-1)  # [S*B]
-    starts_flat = starts_b.T.reshape(-1)
-    cum = jnp.cumsum(lens_flat)
-    L_real = cum[-1]
-    pos = jnp.arange(L_pad, dtype=jnp.int32)
-    seg_c = jnp.minimum(
-        jnp.searchsorted(cum, pos, side="right").astype(jnp.int32), S * B - 1
+    rows_flat, segments, valid = _ragged_rows(
+        rp.rows, rp.off, idx, S, B, L_pad, rp.pad_row
     )
-    within = pos - (cum[seg_c] - lens_flat[seg_c])
-    src = jnp.clip(starts_flat[seg_c] + within, 0, rp.rows.shape[0] - 1)
-    valid = pos < L_real
-    rows_flat = jnp.where(valid, rp.rows[src], rp.pad_row)
-    segments = jnp.where(valid, seg_c, S * B)  # seg_c IS slot*B + ins
     # cross-slot dedup on device: sort rows, first-occurrence scan
     INF = jnp.int32(rp.n_table_rows)
     sort_keys = jnp.where(valid, rows_flat, INF)
@@ -225,6 +243,11 @@ def ensure_sharded(rp: ResidentPass, batch_indices, n_devices: int) -> None:
     max_L, max_bucket = 1, 0
     for idx in batch_indices:
         idx = np.asarray(idx)
+        if len(idx) % n_devices:
+            raise ValueError(
+                f"batch of {len(idx)} records not divisible by "
+                f"{n_devices} devices (same contract as the host packer)"
+            )
         b = len(idx) // n_devices
         for d in range(n_devices):
             sl = idx[d * b : (d + 1) * b]
@@ -267,22 +290,9 @@ def build_mesh_device_batch(
     rows_res, off_res, labels_res = (
         rp_arrays["rows"], rp_arrays["off"], rp_arrays["labels"],
     )
-    off_b = off_res[idx_dev]  # [b, S+1]
-    lens_b = off_b[:, 1:] - off_b[:, :-1]
-    starts_b = off_b[:, :-1]
-    lens_flat = lens_b.T.reshape(-1)  # slot-major [S*b]
-    starts_flat = starts_b.T.reshape(-1)
-    cum = jnp.cumsum(lens_flat)
-    L_real = cum[-1]
-    pos = jnp.arange(L_pad, dtype=jnp.int32)
-    seg_c = jnp.minimum(
-        jnp.searchsorted(cum, pos, side="right").astype(jnp.int32), S * b - 1
+    rows_flat, segments, valid = _ragged_rows(
+        rows_res, off_res, idx_dev, S, b, L_pad, jnp.int32(ns * cap)
     )
-    within = pos - (cum[seg_c] - lens_flat[seg_c])
-    src = jnp.clip(starts_flat[seg_c] + within, 0, rows_res.shape[0] - 1)
-    valid = pos < L_real
-    rows_flat = jnp.where(valid, rows_res[src], jnp.int32(ns * cap))
-    segments = jnp.where(valid, seg_c, S * b)  # local slot*b + ins
 
     # route: sort by global row id (== by owner shard), first-occurrence
     # scan assigns each unique row its request-bucket slot j within its
@@ -318,12 +328,15 @@ def build_mesh_device_batch(
              mode="drop")
         .reshape(ns, K)
     )
-    return {
+    out = {
         "req_ranks": req_ranks,
         "inverse": inverse,
         "segments": segments,
         "labels": labels_res[idx_dev],
     }
+    if "dense" in rp_arrays:
+        out["dense"] = rp_arrays["dense"][idx_dev]
+    return out
 
 
 def make_resident_mesh_superstep(
@@ -357,8 +370,12 @@ def make_resident_mesh_superstep(
     ns, cap = rp.ws.n_mesh_shards, rp.ws.capacity
     L_pad, K = rp.L_pad, rp.K_pad
 
-    def superstep_local(state, idx_block, rows, off, labels):
+    has_dense = rp.dense is not None
+
+    def superstep_local(state, idx_block, rows, off, labels, dense):
         rp_arrays = {"rows": rows, "off": off, "labels": labels}
+        if has_dense:
+            rp_arrays["dense"] = dense
 
         def body(st, idx):  # idx [1, b] (this device's slice)
             batch = build_mesh_device_batch(
@@ -387,11 +404,12 @@ def make_resident_mesh_superstep(
             in_specs=(
                 state_specs,
                 P(None, plan.axis),  # scan axis whole, device axis split
-                rep, rep, rep,
+                rep, rep, rep, rep,
             ),
             out_specs=(state_specs, metric_specs),
             check_vma=False,
         )
-        return mapped(state, idx_block, rp.rows, rp.off, rp.labels)
+        dense = rp.dense if has_dense else jnp.zeros((1, 1), jnp.float32)
+        return mapped(state, idx_block, rp.rows, rp.off, rp.labels, dense)
 
     return _jax.jit(superstep, donate_argnums=(0,))

@@ -224,10 +224,14 @@ class CTRTrainer:
             self.init_params()
         if self.plan is None:
             flat = jnp.asarray(dev_table.reshape(-1, dev_table.shape[-1]))
+            # device COPIES of params/opt_state: the step donates its state,
+            # so handing self.params's own buffers over would delete them —
+            # a mid-pass save_dense or an aborted pass would then read dead
+            # arrays (the mesh path's put_replicated already copies)
             return TrainState(
                 table=flat,
-                params=self.params,
-                opt_state=self.opt_state,
+                params=jax.tree.map(jnp.copy, self.params),
+                opt_state=jax.tree.map(jnp.copy, self.opt_state),
                 auc=auc_init(self.cfg.auc_buckets),
                 step=jnp.zeros((), jnp.int32),
             )

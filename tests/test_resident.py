@@ -310,3 +310,92 @@ def test_resident_mesh_matches_host_packed_mesh(tmp_path):
     # scan-axis spec would hand the registry 1/n_dev of the data)
     assert reg_r["ins_num"] == reg_h["ins_num"] == 64
     assert np.isclose(reg_r["auc"], reg_h["auc"], atol=1e-6)
+
+
+def test_resident_mesh_dense_features_match(tmp_path):
+    """Dense float features flow through the mesh resident build (a feed
+    that silently dropped them would diverge from the host-packed path)."""
+    from paddlebox_tpu.parallel import make_mesh
+
+    def write(tmp):
+        rng = np.random.default_rng(3)
+        tmp.mkdir(parents=True, exist_ok=True)
+        p = tmp / "d.txt"
+        with open(p, "w") as f:
+            for _ in range(32):
+                ks = rng.integers(1, 100, S)
+                dvals = rng.random(3)
+                f.write(
+                    f"1 {int(ks[0]) % 2}.0 "
+                    + "3 " + " ".join(f"{v:.3f}" for v in dvals) + " "
+                    + " ".join(f"1 {k}" for k in ks)
+                    + "\n"
+                )
+        return [str(p)]
+
+    schema = SlotSchema(
+        [
+            SlotInfo("label", type="float", dense=True, dim=1),
+            SlotInfo("dfeat", type="float", dense=True, dim=3),
+        ]
+        + [SlotInfo(f"s{i}") for i in range(S)],
+        label_slot="label",
+    )
+
+    class DenseAwareModel:
+        def __init__(self, base):
+            self.base = base
+
+        def init(self, rng):
+            p = self.base.init(rng)
+            p["dw"] = jnp.ones((3,), jnp.float32) * 0.5
+            return p
+
+        def apply(self, p, feats, dense=None):
+            logit = self.base.apply(
+                {k: v for k, v in p.items() if k != "dw"}, feats, None
+            )
+            if dense is not None:
+                logit = logit + dense @ p["dw"]
+            return logit
+
+    def run(resident):
+        prev = config.get_flag("enable_resident_feed")
+        config.set_flag("enable_resident_feed", resident)
+        try:
+            layout = ValueLayout(embedx_dim=4)
+            table = HostSparseTable(
+                layout, SparseOptimizerConfig(embedx_threshold=0.0),
+                n_shards=4, seed=0,
+            )
+            plan = make_mesh(4)
+            ds = BoxPSDataset(
+                schema, table, batch_size=16, n_mesh_shards=4,
+                shuffle_mode="none",
+            )
+            ds.set_filelist(write(tmp_path / f"r{resident}"))
+            ds.load_into_memory()
+            ds.begin_pass(round_to=16)
+            base = DeepFM(
+                num_slots=S, feat_width=layout.pull_width, embedx_dim=4,
+                hidden=(8,),
+            )
+            cfg = TrainStepConfig(
+                num_slots=S, batch_size=4, layout=layout,
+                sparse_opt=SparseOptimizerConfig(embedx_threshold=0.0),
+                auc_buckets=100, axis_name=plan.axis,
+            )
+            tr = CTRTrainer(
+                DenseAwareModel(base), cfg, dense_opt=optax.adam(1e-2),
+                plan=plan, dense_slot="dfeat", dense_dim=3,
+            )
+            tr.init_params(jax.random.PRNGKey(0))
+            out = tr.train_pass(ds)
+            return out, np.asarray(tr.trained_table())
+        finally:
+            config.set_flag("enable_resident_feed", prev)
+
+    out_h, table_h = run(0)
+    out_r, table_r = run(1)
+    assert np.isclose(out_r["loss"], out_h["loss"], atol=1e-5)
+    np.testing.assert_allclose(table_r, table_h, atol=1e-4)
