@@ -29,6 +29,16 @@ from typing import Dict, List, Optional, Tuple
 
 _HDR = struct.Struct("<III")  # src_rank, tag_len, payload_len
 
+from paddlebox_tpu import config
+
+config.define_flag(
+    "shuffle_chunk_bytes",
+    64 << 20,
+    "max serialized bytes per shuffle sub-chunk: bounds the sender's "
+    "serialization RAM and keeps frames flowing so the receive timeout "
+    "paces per-chunk gaps, not whole-pass serialization",
+)
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
@@ -119,6 +129,11 @@ class TcpTransport:
                 del self._inbox[(tag, src)]
             return payload
 
+    def recv(self, tag: str, src: int) -> bytes:
+        """Blocking receive of one frame (tag, src) — the public primitive
+        streamed protocols (TcpShuffleRouter) build on."""
+        return self._take(tag, src)
+
     # ---- send side -------------------------------------------------------
 
     def _sock_to(self, dst: int) -> socket.socket:
@@ -179,8 +194,17 @@ class TcpShuffleRouter:
     One router per (transport, dataset); ``exchange`` serializes each
     destination's ColumnarRecords chunk and all-to-alls them; ``collect``
     deserializes what arrived. The zero-length completion message of the
-    reference's protocol (data_set.cc:1835-1866) is implicit: alltoall
-    always delivers exactly one (possibly empty) chunk per peer.
+    reference's protocol (data_set.cc:1835-1866) is implicit: the chunk
+    count header always arrives, even when zero chunks follow.
+
+    Large passes stream in bounded sub-chunks (``shuffle_chunk_bytes``):
+    the sender serializes at most one sub-chunk per destination at a time
+    (peak extra RAM is the chunk size, not the whole part) and frames start
+    arriving as soon as the first sub-chunk is cut, so the receive timeout
+    paces per-chunk gaps instead of whole-pass serialization. The
+    receiver's inbox is intentionally UNBOUNDED — it holds at most the
+    in-flight pass, exactly like the reference's shuffle_channel_
+    (data_set.cc:1870-1926); chunking bounds the sender side only.
     """
 
     def __init__(self, transport: TcpTransport):
@@ -188,35 +212,76 @@ class TcpShuffleRouter:
         self.n_nodes = transport.n_ranks
         self._round = 0
 
+    @staticmethod
+    def _sub_ranges(chunk, chunk_bytes: int):
+        """Split a ColumnarRecords part into ~<=chunk_bytes record ranges.
+
+        Sized from EVERY serialized component (values, offsets, bases,
+        search/cmatch/rank metadata, ins_id chars) — undercounting would
+        let metadata-heavy stores blow past the sender-RAM bound."""
+        import numpy as np
+
+        n = len(chunk)
+        total = (
+            chunk.u64_values.nbytes
+            + chunk.f_values.nbytes
+            + chunk.u64_offsets.nbytes
+            + chunk.f_offsets.nbytes
+            + chunk.u64_base.nbytes
+            + chunk.f_base.nbytes
+            + chunk.search_ids.nbytes
+            + chunk.cmatch.nbytes
+            + chunk.rank.nbytes
+            + (len(chunk.ins_id_chars) if chunk.ins_id_chars else 0)
+            + (chunk.ins_id_off.nbytes if chunk.ins_id_off is not None else 0)
+        )
+        per = max(1, int(n * chunk_bytes / max(total, 1)))
+        return [np.arange(i, min(i + per, n)) for i in range(0, n, per)]
+
     def exchange(self, from_node: int, parts: list) -> None:
         from paddlebox_tpu.data.record_store import ColumnarRecords
 
         if from_node != self.transport.rank:
             raise ValueError("exchange must be called by the owning rank")
-        payloads = []
-        for chunk in parts:
+        chunk_bytes = int(config.get_flag("shuffle_chunk_bytes"))
+        tag = f"shuffle:{self._round}"
+        tp = self.transport
+        # header first (sub-chunk count), then the streamed sub-chunks;
+        # destinations interleave so no single slow peer starves the rest
+        ranges = []
+        for dst, chunk in enumerate(parts):
             if isinstance(chunk, ColumnarRecords):
-                payloads.append(chunk.to_bytes())
+                ranges.append(self._sub_ranges(chunk, chunk_bytes) if len(chunk) else [])
             elif len(chunk) == 0:
-                payloads.append(b"")
+                ranges.append([])
             else:
                 raise TypeError(
                     "TcpShuffleRouter moves ColumnarRecords chunks; got "
                     f"{type(chunk).__name__} (enable the native parser or "
                     "convert with ColumnarRecords.from_records)"
                 )
-        self._received = self.transport.alltoall(
-            payloads, f"shuffle:{self._round}"
-        )
+        for dst, rs in enumerate(ranges):
+            tp.send(dst, tag + "/n", struct.pack("<I", len(rs)))
+        max_chunks = max((len(rs) for rs in ranges), default=0)
+        for i in range(max_chunks):
+            for dst, rs in enumerate(ranges):
+                if i < len(rs):
+                    tp.send(dst, f"{tag}/{i}", parts[dst].select(rs[i]).to_bytes())
 
     def collect(self, node: int) -> list:
         from paddlebox_tpu.data.record_store import ColumnarRecords
 
         if node != self.transport.rank:
             raise ValueError("collect must be called by the owning rank")
-        out = [
-            ColumnarRecords.from_bytes(p) for p in self._received if p
+        tag = f"shuffle:{self._round}"
+        tp = self.transport
+        out = []
+        counts = [
+            struct.unpack("<I", tp.recv(tag + "/n", src))[0]
+            for src in range(self.n_nodes)
         ]
-        self._received = None
+        for src, n in enumerate(counts):
+            for i in range(n):
+                out.append(ColumnarRecords.from_bytes(tp.recv(f"{tag}/{i}", src)))
         self._round += 1
         return out

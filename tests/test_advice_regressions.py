@@ -329,3 +329,83 @@ def test_empty_distributed_working_set_lookup_raises_keyerror():
     dws.finalize(table, round_to=8)
     with pytest.raises(KeyError, match="empty"):
         dws.lookup(np.array([42], dtype=np.uint64))
+
+
+def test_shuffle_router_chunked_exchange_preserves_multiset():
+    """Tiny shuffle_chunk_bytes forces many sub-chunks per destination; the
+    exchanged record multiset must survive chunking exactly (and empty
+    destinations still deliver their zero-count header)."""
+    import numpy as np
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.data.record_store import ColumnarRecords
+    from paddlebox_tpu.data.slot_record import SlotRecord
+    from paddlebox_tpu.data.slot_schema import SlotInfo, SlotSchema
+    from paddlebox_tpu.parallel.transport import TcpTransport, TcpShuffleRouter
+
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1), SlotInfo("s0")],
+        label_slot="label",
+    )
+
+    def mk_store(keys):
+        recs = [
+            SlotRecord(
+                u64_values=np.array([k], np.uint64),
+                u64_offsets=np.array([0, 1], np.uint32),
+                f_values=np.array([float(k % 2)], np.float32),
+                f_offsets=np.array([0, 1], np.uint32),
+            )
+            for k in keys
+        ]
+        return ColumnarRecords.from_records(recs, schema)
+
+    import socket as _s
+
+    socks = [_s.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    eps = [f"127.0.0.1:{p}" for p in ports]  # same pattern as _free_ports
+    t0 = TcpTransport(0, eps)
+    t1 = TcpTransport(1, eps)
+    r0, r1 = TcpShuffleRouter(t0), TcpShuffleRouter(t1)
+
+    prev = config.get_flag("shuffle_chunk_bytes")
+    config.set_flag("shuffle_chunk_bytes", 64)  # ~a few records per chunk
+    try:
+        import threading
+
+        # rank 0 sends 100 records to rank 1 and 3 to itself; rank 1 sends
+        # nothing anywhere (empty-destination headers)
+        out = {}
+
+        def run0():
+            r0.exchange(0, [mk_store(range(1, 4)), mk_store(range(100, 200))])
+            out[0] = r0.collect(0)
+
+        def run1():
+            empty = mk_store([])
+            r1.exchange(1, [empty, empty])
+            out[1] = r1.collect(1)
+
+        th = [threading.Thread(target=run0), threading.Thread(target=run1)]
+        [t.start() for t in th]
+        [t.join(timeout=60) for t in th]
+        assert not any(t.is_alive() for t in th), "exchange deadlocked"
+        got0 = sorted(
+            int(k) for c in out[0] for k in np.asarray(c.u64_values)
+        )
+        got1 = sorted(
+            int(k) for c in out[1] for k in np.asarray(c.u64_values)
+        )
+        assert got0 == [1, 2, 3]
+        assert got1 == list(range(100, 200))
+        # chunking actually happened (many sub-chunks, not one blob)
+        assert len(out[1]) > 3
+    finally:
+        config.set_flag("shuffle_chunk_bytes", prev)
+        t0.close()
+        t1.close()
