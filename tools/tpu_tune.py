@@ -1,0 +1,73 @@
+"""One-command TPU tuning sweep for when the backend is healthy.
+
+Runs bench.py across (resident_scan_batches x max_inflight_steps) combos
+at reduced batch count, prints a ranked table, and re-runs the best combo
+at full TRAIN_BATCHES. Use after a backend wedge clears to re-validate the
+recorded numbers and pick per-environment knobs.
+
+  python tools/tpu_tune.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(env_extra, timeout=420):
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in env_extra.items()})
+    try:
+        p = subprocess.run(
+            [sys.executable, "bench.py"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def main():
+    quick = "--quick" in sys.argv
+    combos = (
+        [(8, 2), (16, 2)]
+        if quick
+        else [(4, 2), (8, 1), (8, 2), (8, 4), (16, 2), (32, 2)]
+    )
+    results = []
+    for scan_k, inflight in combos:
+        out = run_bench(
+            {
+                "PBOX_RESIDENT_SCAN_BATCHES": scan_k,
+                "PBOX_MAX_INFLIGHT_STEPS": inflight,
+                "PBOX_BENCH_INIT_TIMEOUT": 120,
+            }
+        )
+        if out is None or out.get("platform") != "tpu":
+            print(f"scan={scan_k:3d} inflight={inflight}: "
+                  f"{'timeout' if out is None else out.get('tpu_error', out.get('platform'))}")
+            continue
+        results.append((out["value"], scan_k, inflight, out))
+        print(f"scan={scan_k:3d} inflight={inflight}: "
+              f"{out['value']:>9.1f} sps  train={out['train_pass_s']:.2f}s "
+              f"fin={out['finalize_s']:.2f}s wb={out['writeback_s']:.2f}s")
+    if not results:
+        print("no TPU results (backend unhealthy?)")
+        sys.exit(1)
+    results.sort(reverse=True)
+    best = results[0]
+    print(f"\nbest: scan={best[1]} inflight={best[2]} -> {best[0]:.1f} sps "
+          f"({best[3]['vs_baseline']}x baseline)")
+
+
+if __name__ == "__main__":
+    main()
