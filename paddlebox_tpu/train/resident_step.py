@@ -42,8 +42,8 @@ config.define_flag(
     "enable_resident_feed",
     1,
     "keep the pass's row stream resident in device HBM and feed only "
-    "record indices per batch (single-device fast path; 0 = classic "
-    "per-batch host packing)",
+    "record indices per batch (single-device and single-host-mesh fast "
+    "path; 0 = classic per-batch host packing)",
 )
 config.define_flag(
     "resident_scan_batches",
