@@ -399,3 +399,17 @@ def test_resident_mesh_dense_features_match(tmp_path):
     out_r, table_r = run(1)
     assert np.isclose(out_r["loss"], out_h["loss"], atol=1e-5)
     np.testing.assert_allclose(table_r, table_h, atol=1e-4)
+
+
+def test_prepare_pass_prefreezes_shapes(tmp_path):
+    """After prepare_pass over the full partition, train_pass must not grow
+    the pads or build a second superstep (the warm-start contract bench.py
+    relies on to keep compiles out of its timed region)."""
+    ds, tr, _ = _fresh(tmp_path)
+    tr.prepare_pass(ds, n_batches=8)
+    rp = tr._get_resident(ds)
+    pads_before = (rp.L_pad, rp.U_pad)
+    assert pads_before[0] > 0 and pads_before[1] > 0
+    tr.train_pass(ds, n_batches=8)
+    assert (rp.L_pad, rp.U_pad) == pads_before
+    assert len(tr._sstep_cache) == 1  # one train superstep, no regrowth
