@@ -411,9 +411,9 @@ class CTRTrainer:
         min_b = 0
         if self.plan is not None and jax.process_count() > 1:
             min_b = self._pv_lockstep(dataset, n_dev)
-        for batch, ins_weight in dataset.pv_batches(
-            n_batches, n_devices=n_dev, min_batches=min_b
-        ):
+
+        def prepare(item):
+            batch, ins_weight = item
             feed = self._pack_and_put(batch, dataset.ws)
             if self.plan is None:
                 if ins_weight is not None:
@@ -431,7 +431,17 @@ class CTRTrainer:
                 feed["rank_offset"] = put_sharded(
                     self.plan, ro.reshape(n_dev, b, ro.shape[-1])
                 )
-            yield self._feed_aux(feed, batch=batch, ins_weight=ins_weight)
+            return self._feed_aux(feed, batch=batch, ins_weight=ins_weight)
+
+        # ONE worker, shallow depth: batch i+1 builds+packs while i trains
+        # (join-phase analog of the fast path's prefetch). A single worker
+        # keeps the sticky pad floors race-free and the order deterministic.
+        yield from prefetch(
+            dataset.pv_batches(n_batches, n_devices=n_dev, min_batches=min_b),
+            prepare,
+            workers=1,
+            depth=2,
+        )
 
     def _slow_feed_iter(self, dataset, n_batches):
         for batch in dataset.batches(n_batches):
