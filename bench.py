@@ -96,6 +96,89 @@ def probe_backend(timeout_s: float):
         return None, f"backend probe produced no JSON: {proc.stdout[-200:]!r}"
 
 
+def probe_backend_with_retries(timeout_s: float):
+    """Probe the backend repeatedly with backoff before giving up on TPU.
+
+    The axon backend's wedges last hours-but-not-forever; a single probe
+    maximizes the chance of recording a CPU fallback on a chip that would
+    have come back mid-run. Budget is controlled by env:
+      PBOX_BENCH_INIT_RETRIES  number of probes (default 6)
+      PBOX_BENCH_INIT_TIMEOUT  per-probe subprocess watchdog (default 150s)
+      PBOX_BENCH_INIT_BACKOFF  first sleep between probes, doubled each
+                               time and capped at 240s (default 30s)
+    Returns (info, probe_log); info is None if every probe failed. Each
+    probe_log entry is {"ts", "elapsed_s", "ok", "detail"} — the multi-probe
+    wedge evidence recorded into the output JSON when TPU never comes up.
+    """
+    retries = max(1, int(os.environ.get("PBOX_BENCH_INIT_RETRIES", "6")))
+    backoff = float(os.environ.get("PBOX_BENCH_INIT_BACKOFF", "30"))
+    probe_log = []
+    for attempt in range(retries):
+        t0 = time.time()
+        info, err = probe_backend(timeout_s)
+        entry = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
+            "elapsed_s": round(time.time() - t0, 1),
+            "ok": err is None,
+            "detail": "ok" if err is None else err,
+        }
+        probe_log.append(entry)
+        # progress to stderr as it happens: a driver with a wall-clock
+        # watchdog must see life during the (up to ~25 min) retry budget,
+        # or it kills the run before the JSON evidence is ever emitted
+        print(f"[bench] probe {attempt + 1}/{retries}: {entry['detail']}",
+              file=sys.stderr, flush=True)
+        if err is None:
+            return info, probe_log
+        if attempt + 1 < retries:
+            time.sleep(min(backoff, 240.0))
+            backoff *= 2
+    return None, probe_log
+
+
+LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "last_good_tpu_bench.json")
+PROBE_LOOP_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "tpu_probe_log.jsonl")
+
+
+def bench_config_id() -> str:
+    """Identity of the measured workload: a cached last-good number is only
+    comparable to runs of the SAME bench definition."""
+    return (
+        f"slots={NUM_SLOTS},emb={EMBEDX_DIM},B={BATCH},hid={HIDDEN},"
+        f"files={N_FILES}x{RECORDS_PER_FILE},keys={KEY_SPACE},"
+        f"batches={TRAIN_BATCHES}"
+    )
+
+
+def read_last_good():
+    """Most recent successful TPU measurement, cached on disk by main()."""
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def read_probe_loop_tail(n: int = 30):
+    """Tail of the long-running background probe log (tools/tpu_probe_loop.sh),
+    if one was kept during the build session — independent wedge evidence
+    spanning hours, not just this bench invocation."""
+    try:
+        with open(PROBE_LOOP_LOG) as f:
+            lines = f.read().strip().splitlines()
+    except OSError:
+        return None
+    out = []
+    for ln in lines[-n:]:
+        try:
+            out.append(json.loads(ln))
+        except ValueError:
+            pass
+    return out or None
+
+
 def fail_fast(reason: str) -> None:
     print(
         json.dumps(
@@ -113,21 +196,22 @@ def fail_fast(reason: str) -> None:
 
 def main():
     profile = "--profile" in sys.argv
-    timeout_s = float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "180"))
-    info, err = probe_backend(timeout_s)
+    timeout_s = float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "150"))
+    info, probe_log = probe_backend_with_retries(timeout_s)
     tpu_error = None
-    if err is not None:
-        # Wedged/absent accelerator: fall back to the CPU backend so the
-        # driver still records a real end-to-end number (clearly labeled
-        # with platform + the accelerator failure) instead of nothing.
-        tpu_error = err
+    if info is None:
+        # Wedged/absent accelerator after the full retry budget: fall back to
+        # the CPU backend so the driver still records a real end-to-end number
+        # (clearly labeled with platform + the per-probe wedge evidence +
+        # the last measurement taken on a healthy chip) instead of nothing.
+        tpu_error = probe_log[-1]["detail"]
         import jax
 
         try:
             jax.config.update("jax_platforms", "cpu")
             info = {"platform": jax.devices()[0].platform, "n_devices": jax.device_count()}
         except Exception as e:  # CPU fallback itself failed: diagnose fast
-            fail_fast(f"{err}; cpu fallback failed: {e!r}")
+            fail_fast(f"{tpu_error}; cpu fallback failed: {e!r}")
 
     import jax
     import optax
@@ -202,7 +286,28 @@ def main():
         writeback_s = time.perf_counter() - t0
 
     sps = TRAIN_BATCHES * BATCH / train_s
-    extra = {} if tpu_error is None else {"tpu_error": tpu_error}
+    extra = {}
+    if len(probe_log) > 1:
+        # a recovered-after-retries chip is wedge evidence too — record the
+        # failed probes even when the run ultimately lands on TPU
+        extra["tpu_probe_log"] = probe_log
+    if tpu_error is not None:
+        extra["tpu_error"] = tpu_error
+        extra["tpu_probe_log"] = probe_log
+        loop_tail = read_probe_loop_tail()
+        if loop_tail is not None:
+            extra["tpu_probe_loop_tail"] = loop_tail
+        last_good = read_last_good()
+        if last_good is not None:
+            if last_good.get("bench_config") == bench_config_id():
+                extra["last_good_tpu"] = last_good
+            else:
+                extra["last_good_tpu_stale"] = {
+                    "measured_at": last_good.get("measured_at"),
+                    "bench_config": last_good.get("bench_config"),
+                    "note": "cached TPU measurement predates a bench config "
+                    "change; not comparable",
+                }
     if profile:
         # per-stage attribution (TrainFilesWithProfiler parity) — table to
         # stderr so stdout stays one JSON line for the driver
@@ -213,25 +318,35 @@ def main():
             print(f"  {k:18s} {v:8.3f}", file=sys.stderr)
         for k, v in (("load", load_s), ("finalize", finalize_s), ("train", train_s)):
             print(f"  {k + '_total':18s} {v:8.3f}", file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                **extra,
-                "metric": "deepfm_e2e_train_samples_per_sec_per_chip",
-                "value": round(sps, 1),
-                "unit": "samples/s/chip",
-                "vs_baseline": round(sps / BASELINE_PER_CHIP, 3),
-                "train_pass_s": round(train_s, 3),
-                "load_s": round(load_s, 3),
-                "finalize_s": round(finalize_s, 3),
-                "writeback_s": round(writeback_s, 3),
-                "pass_keys": int(ds.stats.keys),
-                "native_store": native_store,
-                "platform": info["platform"],
-                "auc": round(out["auc"], 4),
-            }
-        )
-    )
+    result = {
+        **extra,
+        "metric": "deepfm_e2e_train_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(sps / BASELINE_PER_CHIP, 3),
+        "train_pass_s": round(train_s, 3),
+        "load_s": round(load_s, 3),
+        "finalize_s": round(finalize_s, 3),
+        "writeback_s": round(writeback_s, 3),
+        "pass_keys": int(ds.stats.keys),
+        "native_store": native_store,
+        "platform": info["platform"],
+        "auc": round(out["auc"], 4),
+    }
+    if info["platform"] == "tpu":
+        # Cache this healthy-chip measurement; a later wedged run emits it
+        # as "last_good_tpu" alongside its CPU fallback number.
+        try:
+            cached = dict(result)
+            cached["measured_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            )
+            cached["bench_config"] = bench_config_id()
+            with open(LAST_GOOD_PATH, "w") as f:
+                json.dump(cached, f)
+        except OSError:
+            pass
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

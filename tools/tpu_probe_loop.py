@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Background TPU-health probe loop.
+
+Appends one JSON line per probe to tools/tpu_probe_log.jsonl:
+    {"ts": ..., "ok": ..., "elapsed_s": ..., "detail": ...}
+
+Reuses bench.probe_backend (one watchdogged subprocess per probe — the axon
+backend init is known to wedge for hours inside make_c_api_client, and a hung
+child is killable while a hung in-process import is not). The log is the
+long-horizon wedge evidence bench.py attaches to its output JSON when the
+chip never comes up during a run.
+
+Usage: nohup python tools/tpu_probe_loop.py &  (from the repo root)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import PROBE_LOOP_LOG, probe_backend  # noqa: E402
+
+
+def main() -> None:
+    interval = float(os.environ.get("PBOX_PROBE_INTERVAL", "420"))
+    healthy_interval = float(os.environ.get("PBOX_PROBE_HEALTHY_INTERVAL", "1800"))
+    timeout_s = float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "150"))
+    while True:
+        t0 = time.time()
+        info, err = probe_backend(timeout_s)
+        entry = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
+            "ok": err is None,
+            "elapsed_s": round(time.time() - t0, 1),
+            "detail": json.dumps(info) if err is None else err[:200],
+        }
+        with open(PROBE_LOOP_LOG, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+        time.sleep(healthy_interval if err is None else interval)
+
+
+if __name__ == "__main__":
+    main()
