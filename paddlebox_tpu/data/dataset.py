@@ -306,6 +306,37 @@ class BoxPSDataset:
             self.records = flat  # setter: list becomes source of truth
         self.pvs = []
         self._pv_merged = False
+        self._pv_plan_cache = None
+
+    def pv_plan(self, n_devices: int = 1, min_batches: int = 0):
+        """Cached index-level join-phase feed plan (see PvPlan).
+
+        None when the pass isn't store-backed (records lack store indices);
+        then consumers fall back to the record-level pv path. The cache is
+        keyed by the pvs object identity plus the packing args — a repeat
+        call over the same merged pass (warmup epoch, join eval, pad
+        lockstep) costs nothing."""
+        if not getattr(self, "_pv_merged", False):
+            raise RuntimeError("preprocess_instance first")
+        if self.store is None:
+            return None
+        key = (n_devices, min_batches)
+        c = getattr(self, "_pv_plan_cache", None)
+        if c is None or c[0] is not self.pvs:
+            c = (self.pvs, {})
+            self._pv_plan_cache = c
+        if key not in c[1]:
+            from paddlebox_tpu.data.pv_instance import build_pv_plan
+
+            c[1][key] = build_pv_plan(
+                self.pvs,
+                self.batch_size,
+                max_rank=self._pv_max_rank,
+                valid_cmatch=self._pv_valid_cmatch,
+                n_devices=n_devices,
+                min_batches=min_batches,
+            )
+        return c[1][key]
 
     def num_pv_batches(self, n_devices: int = 1, global_count: bool = False) -> int:
         """Join-phase batch count; ``global_count`` allreduce-maxes it over

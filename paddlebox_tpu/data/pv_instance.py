@@ -219,6 +219,81 @@ def pack_pv_batches(
         emitted += 1
 
 
+@dataclass
+class PvPlan:
+    """Pass-deterministic join-phase feed plan, as arrays.
+
+    ``pack_pv_batches``' record stream re-expressed at the index level: pv
+    batch composition is fully determined once ``preprocess_instance`` has
+    grouped the pass (the reference likewise fixes batch_offsets_ at
+    PrepareTrain, data_set.cc:2155-2192), so the whole join phase can be
+    materialized ONCE per pass as three stacked tensors and every later
+    consumer — the native host packer, the device-resident feed, the
+    multi-host pad lockstep — becomes vectorized array math instead of a
+    per-record Python sweep.
+
+    - ``idx`` [n_batches, B] int64: store record index per instance slot
+      (ghost padding repeats a real record's index; ``ins_weight`` zeroes it)
+    - ``rank_offset`` [n_batches, B, 2*max_rank+1] int32 (device-local peer
+      rows when ``n_devices`` > 1, matching the mesh join step)
+    - ``ins_weight`` [n_batches, B] float32 (0 on ghosts)
+    """
+
+    idx: np.ndarray
+    rank_offset: np.ndarray
+    ins_weight: np.ndarray
+    n_devices: int
+
+    @property
+    def n_batches(self) -> int:
+        return self.idx.shape[0]
+
+
+def build_pv_plan(
+    pvs: Sequence[PvInstance],
+    batch_size: int,
+    max_rank: int = 3,
+    valid_cmatch: Sequence[int] = DEFAULT_VALID_CMATCH,
+    n_devices: int = 1,
+    min_batches: int = 0,
+):
+    """Materialize pack_pv_batches as a PvPlan (one pass over the pvs).
+
+    Returns None when any record lacks a store index (``_store_idx`` is
+    stamped when records materialize from a ColumnarRecords store) — such
+    datasets keep the record-level pv path.
+    """
+    idxs, ros, wts = [], [], []
+    for recs, ro, w in pack_pv_batches(
+        pvs,
+        batch_size,
+        max_rank=max_rank,
+        valid_cmatch=valid_cmatch,
+        n_devices=n_devices,
+        min_batches=min_batches,
+    ):
+        row = np.empty(len(recs), np.int64)
+        for j, r in enumerate(recs):
+            si = getattr(r, "_store_idx", None)
+            if si is None:
+                return None
+            row[j] = si
+        idxs.append(row)
+        ros.append(ro)
+        wts.append(w)
+    col = 2 * max_rank + 1
+    if not idxs:
+        return PvPlan(
+            np.zeros((0, batch_size), np.int64),
+            np.zeros((0, batch_size, col), np.int32),
+            np.zeros((0, batch_size), np.float32),
+            n_devices,
+        )
+    return PvPlan(
+        np.stack(idxs), np.stack(ros), np.stack(wts), n_devices
+    )
+
+
 def count_pv_batches(
     pvs: Sequence[PvInstance], batch_size: int, n_devices: int = 1
 ) -> int:

@@ -232,6 +232,167 @@ def make_resident_superstep(
     return jax.jit(superstep, donate_argnums=(0,))
 
 
+# ---- resident pv (join-phase) tier -----------------------------------------
+
+
+class ResidentPvFeed:
+    """The pass's PvPlan uploaded to device HBM once.
+
+    Join-phase batches are pass-deterministic after ``preprocess_instance``
+    (PvPlan), so the per-batch feed shrinks to a [K] vector of BATCH
+    POSITIONS — even smaller than the flat tier's [K, B] index feed. The
+    jitted step gathers the batch's record indices, rank_offset, and
+    ins_weight from these resident arrays (the reference keeps pv batches on
+    the same MiniBatchGpuPack fast path as flat ones, data_feed.cc:2404-2522;
+    here they additionally skip the host entirely).
+
+    Mesh layout: idx/ro/w reshape to [n_b, n_dev, ...] and shard on the
+    device axis, so each device stores and reads only its own block.
+    """
+
+    def __init__(self, plan, mesh_plan=None):
+        idx = plan.idx.astype(np.int32)
+        ro = plan.rank_offset
+        w = plan.ins_weight
+        self.n_batches = plan.n_batches
+        if mesh_plan is None:
+            self.idx = jnp.asarray(idx)  # [n_b, B]
+            self.ro = jnp.asarray(ro)  # [n_b, B, R]
+            self.w = jnp.asarray(w)  # [n_b, B]
+        else:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            nd = mesh_plan.n_devices
+            if plan.n_devices != nd:
+                raise ValueError(
+                    f"PvPlan built for {plan.n_devices} devices, mesh has {nd}"
+                )
+            n_b, B = idx.shape
+            b = B // nd
+
+            def shard(a, *trail):
+                a = a.reshape(n_b, nd, b, *trail)
+                spec = P(None, mesh_plan.axis, *([None] * (1 + len(trail))))
+                return jax.device_put(
+                    a, NamedSharding(mesh_plan.mesh, spec)
+                )
+
+            self.idx = shard(idx)  # [n_b, n_dev, b]
+            self.ro = shard(ro, ro.shape[-1])  # [n_b, n_dev, b, R]
+            self.w = shard(w)  # [n_b, n_dev, b]
+
+
+def make_resident_pv_superstep(
+    model_apply: Callable,
+    dense_opt,
+    cfg: TrainStepConfig,
+    rp: ResidentPass,
+    feed: ResidentPvFeed,
+    eval_mode: bool = False,
+) -> Callable:
+    """``superstep(state, pos_block [K]) -> (state, metrics[K])``: the pv
+    analog of make_resident_superstep. Batch assembly reuses
+    build_device_batch (ghosts are ordinary repeated records; their
+    weight-0 rows add no loss, no show/clk, no AUC — same contract as the
+    host-packed pv path)."""
+    raw_step = make_train_step(model_apply, dense_opt, cfg, eval_mode=eval_mode)
+
+    def body(state, pos):
+        batch = build_device_batch(rp, cfg, feed.idx[pos])
+        batch["ins_weight"] = feed.w[pos]
+        batch["rank_offset"] = feed.ro[pos]
+        return raw_step(state, batch)
+
+    def superstep(state, pos_block):
+        return jax.lax.scan(body, state, pos_block)
+
+    return jax.jit(superstep, donate_argnums=(0,))
+
+
+def make_resident_pv_mesh_superstep(
+    model_apply: Callable,
+    dense_opt,
+    cfg: TrainStepConfig,
+    rp: ResidentPass,
+    feed: ResidentPvFeed,
+    plan,
+    eval_mode: bool = False,
+) -> Callable:
+    """Single-host mesh pv superstep: ``superstep(state, pos_block [K])``.
+
+    The pv arrays are device-axis sharded (each device holds its own
+    [n_b, 1, b] block); the position feed is replicated. Per-device batch
+    assembly and step body are shared with the flat mesh tier."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddlebox_tpu.train.sharded_step import (
+        make_local_mesh_step,
+        mesh_metric_specs,
+        mesh_state_specs,
+    )
+
+    if _jax.process_count() > 1:
+        raise NotImplementedError(
+            "resident pv feed is single-host; multi-host join phases use "
+            "the plan-driven host packer"
+        )
+    local_step = make_local_mesh_step(model_apply, dense_opt, cfg, plan, eval_mode)
+    ns, cap = rp.ws.n_mesh_shards, rp.ws.capacity
+    L_pad, K = rp.L_pad, rp.K_pad
+    has_dense = rp.dense is not None
+
+    def superstep_local(
+        state, pos_block, rows, off, labels, dense, pv_idx, pv_ro, pv_w
+    ):
+        rp_arrays = {"rows": rows, "off": off, "labels": labels}
+        if has_dense:
+            rp_arrays["dense"] = dense
+
+        def body(st, pos):
+            batch = build_mesh_device_batch(
+                rp_arrays, cfg, pv_idx[pos, 0], L_pad, K, ns, cap
+            )
+            batch = {k: v[None] for k, v in batch.items()}
+            batch["ins_weight"] = pv_w[pos]  # [1, b] local block
+            batch["rank_offset"] = pv_ro[pos]  # [1, b, R]
+            return local_step(st, batch)
+
+        return _jax.lax.scan(body, state, pos_block)
+
+    state_specs = mesh_state_specs(cfg, dense_opt, plan)
+    per_step = mesh_metric_specs(cfg, plan, eval_mode)
+    metric_specs = {
+        k: (P(None, *s) if s else P()) for k, s in per_step.items()
+    }
+    rep = P()
+    ax = plan.axis
+
+    def superstep(state, pos_block):
+        mapped = _jax.shard_map(
+            superstep_local,
+            mesh=plan.mesh,
+            in_specs=(
+                state_specs,
+                rep,  # batch positions: replicated
+                rep, rep, rep, rep,  # resident flat arrays: replicated
+                P(None, ax, None),  # pv_idx [n_b, n_dev, b]
+                P(None, ax, None, None),  # pv_ro
+                P(None, ax, None),  # pv_w
+            ),
+            out_specs=(state_specs, metric_specs),
+            check_vma=False,
+        )
+        dense = rp.dense if has_dense else jnp.zeros((1, 1), jnp.float32)
+        return mapped(
+            state, pos_block, rp.rows, rp.off, rp.labels, dense,
+            feed.idx, feed.ro, feed.w,
+        )
+
+    return _jax.jit(superstep, donate_argnums=(0,))
+
+
 # ---- mesh (single-host) resident tier --------------------------------------
 
 

@@ -406,10 +406,75 @@ class CTRTrainer:
         self._pv_lockstep_cache = (dataset.pvs, dataset.ws, (min_b, k_glob, l_glob))
         return min_b
 
+    def _pv_plan_feed_iter(self, dataset, plan, n_batches):
+        """Plan-driven join-phase feed: the pv analog of _fast_feed_iter.
+
+        Batch composition comes from the PvPlan's index tensor, so packing
+        runs through the native columnar packer (BatchPacker) instead of
+        the per-record SlotBatch path, with the same prefetch overlap as
+        the flat fast path. On a multi-host mesh, freeze_shapes'
+        transport branch locksteps the pads — replacing the per-record
+        _pv_lockstep sweep with vectorized store math."""
+        store = dataset.store
+        packer = self._get_packer(dataset)
+        n_dev = 1 if self.plan is None else self._n_pack_devices
+        b = dataset.batch_size // n_dev
+        packer.freeze_shapes(
+            plan.idx,
+            n_devices=n_dev if self.plan is not None else 0,
+            transport=dataset.transport,
+        )
+        has_meta = store.ins_id_off is not None
+        want_ids = has_meta and self.dump_pool is not None
+        n = plan.n_batches
+        if n_batches is not None:
+            n = min(n, n_batches)
+
+        def prep(pos):
+            idx = plan.idx[pos]
+            ro = plan.rank_offset[pos]
+            w = plan.ins_weight[pos]
+            if self.plan is None:
+                db = packer.pack(idx)
+                feed = {k: jax.device_put(v) for k, v in db.as_dict().items()}
+                feed["ins_weight"] = jnp.asarray(w)
+                feed["rank_offset"] = jnp.asarray(ro)
+            else:
+                db = packer.pack_sharded(idx, n_dev)
+                feed = {
+                    k: put_sharded(self.plan, v) for k, v in db.as_dict().items()
+                }
+                feed["ins_weight"] = put_sharded(self.plan, w.reshape(n_dev, b))
+                feed["rank_offset"] = put_sharded(
+                    self.plan, ro.reshape(n_dev, b, ro.shape[-1])
+                )
+            ids = [store.ins_id(int(j)) for j in idx] if want_ids else None
+            return idx, feed, w, ids
+
+        for idx, feed, w, ids in prefetch(range(n), prep):
+            yield self._feed_aux(
+                feed,
+                ins_weight=w,
+                cmatch=store.cmatch[idx] if has_meta else None,
+                rank=store.rank[idx] if has_meta else None,
+                ins_ids=ids,
+            )
+
     def _pv_feed_iter(self, dataset, n_batches):
         n_dev = 1 if self.plan is None else self._n_pack_devices
+        multi = self.plan is not None and jax.process_count() > 1
+        if dataset.store is not None:
+            min_b = (
+                dataset.num_pv_batches(n_devices=n_dev, global_count=True)
+                if multi
+                else 0
+            )
+            plan = dataset.pv_plan(n_dev, min_batches=min_b)
+            if plan is not None:
+                yield from self._pv_plan_feed_iter(dataset, plan, n_batches)
+                return
         min_b = 0
-        if self.plan is not None and jax.process_count() > 1:
+        if multi:
             min_b = self._pv_lockstep(dataset, n_dev)
 
         def prepare(item):
@@ -588,6 +653,7 @@ class CTRTrainer:
         c = None  # the local ref would keep the old arrays alive too
         self._resident_cache = None
         self._sstep_cache = {}
+        self._pv_feed_cache = None  # old pass's pv stacks must release too
         rp = ResidentPass(
             dataset.store,
             dataset.ws,
@@ -599,17 +665,56 @@ class CTRTrainer:
         self._resident_cache = (dataset.store, dataset.ws, rp)
         return rp
 
-    def _resident_superstep(self, rp, eval_mode):
+    def _pv_resident_prepare(self, dataset):
+        """(rp, plan, device feed) for the resident join phase: build the
+        PvPlan, freeze the resident pads over ITS batches (ghost repeats
+        count keys but add no uniques), and upload the plan's stacked
+        idx/rank_offset/ins_weight once per pass."""
+        from paddlebox_tpu.train.resident_step import (
+            ResidentPvFeed,
+            ensure_sharded,
+        )
+
+        rp = self._get_resident(dataset)
+        n_dev = self._n_pack_devices if self.plan is not None else 1
+        plan = dataset.pv_plan(n_dev)
+        if self.plan is None:
+            rp.ensure(plan.idx)
+        else:
+            ensure_sharded(rp, plan.idx, self.plan.n_devices)
+        c = getattr(self, "_pv_feed_cache", None)
+        if c is None or c[0] is not plan or c[1] is not rp:
+            feed = ResidentPvFeed(plan, mesh_plan=self.plan)
+            self._pv_feed_cache = (plan, rp, feed)
+        return rp, plan, self._pv_feed_cache[2]
+
+    def _resident_superstep(self, rp, eval_mode, pv_feed=None):
         # keyed cache (not a single slot): a per-pass train -> eval -> train
         # alternation must reuse both compiled scan programs, like the
         # classic path keeps _step and _eval_step_cache alive side by side
         cache = getattr(self, "_sstep_cache", None)
         if cache is None:
             cache = self._sstep_cache = {}
-        key = (id(rp), eval_mode, rp.L_pad, rp.U_pad, rp.K_pad)
+        key = (id(rp), id(pv_feed), eval_mode, rp.L_pad, rp.U_pad, rp.K_pad)
         ss = cache.get(key)
         if ss is None:
-            if self.plan is None:
+            if pv_feed is not None:
+                from paddlebox_tpu.train.resident_step import (
+                    make_resident_pv_mesh_superstep,
+                    make_resident_pv_superstep,
+                )
+
+                if self.plan is None:
+                    ss = make_resident_pv_superstep(
+                        self.model.apply, self.dense_opt, self.cfg, rp,
+                        pv_feed, eval_mode=eval_mode,
+                    )
+                else:
+                    ss = make_resident_pv_mesh_superstep(
+                        self.model.apply, self.dense_opt, self.cfg, rp,
+                        pv_feed, self.plan, eval_mode=eval_mode,
+                    )
+            elif self.plan is None:
                 ss = make_resident_superstep(
                     self.model.apply, self.dense_opt, self.cfg, rp,
                     eval_mode=eval_mode,
@@ -627,27 +732,43 @@ class CTRTrainer:
         return ss
 
     def _resident_stepper(
-        self, dataset, n_batches, holder, eval_mode, profile, t_feed, t_disp, t_dev
+        self, dataset, n_batches, holder, eval_mode, profile, t_feed, t_disp, t_dev,
+        use_pv: bool = False,
     ):
         """Superstep dispatch: K batches per lax.scan call, index-only feed.
 
         Yields the same (batch_index, metrics, aux) stream as the classic
         stepper — metrics are lazy scan-axis slices of the stacked chunk
-        output, so unconsumed fields never leave the device."""
-        t_feed.start()
-        with PROFILER.record_event("resident_prepare", "pass"):
-            rp = self._get_resident(dataset)
-            blocks = [
-                np.asarray(b, dtype=np.int32)
-                for b in dataset.batch_indices(n_batches)
-            ]
-            if self.plan is None:
-                rp.ensure(blocks)
-            else:
-                from paddlebox_tpu.train.resident_step import ensure_sharded
+        output, so unconsumed fields never leave the device.
 
-                ensure_sharded(rp, blocks, self.plan.n_devices)
-            sstep = self._resident_superstep(rp, eval_mode)
+        ``use_pv`` switches to the join-phase tier: batches come from the
+        pass's PvPlan (already resident on device), so the per-chunk feed is
+        a [K] vector of batch positions; rank_offset/ins_weight ride along
+        from the resident stacks."""
+        t_feed.start()
+        pv_w = None
+        with PROFILER.record_event("resident_prepare", "pass"):
+            if use_pv:
+                rp, plan, pv_feed = self._pv_resident_prepare(dataset)
+                n = plan.n_batches
+                if n_batches is not None:
+                    n = min(n, n_batches)
+                blocks = [plan.idx[i] for i in range(n)]
+                pv_w = plan.ins_weight
+                sstep = self._resident_superstep(rp, eval_mode, pv_feed=pv_feed)
+            else:
+                rp = self._get_resident(dataset)
+                blocks = [
+                    np.asarray(b, dtype=np.int32)
+                    for b in dataset.batch_indices(n_batches)
+                ]
+                if self.plan is None:
+                    rp.ensure(blocks)
+                else:
+                    from paddlebox_tpu.train.resident_step import ensure_sharded
+
+                    ensure_sharded(rp, blocks, self.plan.n_devices)
+                sstep = self._resident_superstep(rp, eval_mode)
         t_feed.pause()
         # profiling wants per-batch device attribution: drop to one batch
         # per dispatch (the same overlap-for-attribution trade the classic
@@ -678,8 +799,10 @@ class CTRTrainer:
                     if want_ids
                     else None
                 )
-                idx_block = np.stack(chunk)
-                if self.plan is not None:
+                if use_pv:
+                    # the batches live on device already — feed POSITIONS
+                    idx_dev = jnp.arange(c0, c0 + len(chunk), dtype=jnp.int32)
+                elif self.plan is not None:
                     # [K, B_global] -> [K, n_dev, b]: record r -> device
                     # r // b, the same ins // b mapping the sharded packer
                     # uses; the scan axis stays whole, devices split
@@ -687,13 +810,13 @@ class CTRTrainer:
                     from jax.sharding import PartitionSpec as P
 
                     idx_dev = jax.device_put(
-                        idx_block.reshape(
+                        np.stack(chunk).reshape(
                             len(chunk), self.plan.n_devices, -1
                         ),
                         NamedSharding(self.plan.mesh, P(None, self.plan.axis)),
                     )
                 else:
-                    idx_dev = jnp.asarray(idx_block)
+                    idx_dev = jnp.asarray(np.stack(chunk))
                 t_disp.start()
                 with PROFILER.record_event("superstep_dispatch", "pass"):
                     holder["state"], mstack = sstep(holder["state"], idx_dev)
@@ -716,6 +839,8 @@ class CTRTrainer:
                     if has_meta:
                         aux["cmatch"] = store.cmatch[idx]
                         aux["rank"] = store.rank[idx]
+                    if pv_w is not None:
+                        aux["ins_weight"] = pv_w[c0 + j]
                     if chunk_ids is not None:
                         aux["ins_ids"] = chunk_ids[j]
                     yield i, m, aux
@@ -731,16 +856,32 @@ class CTRTrainer:
 
         Covers the single-device step and SINGLE-HOST meshes (resident
         arrays replicate across local devices); multi-host meshes keep the
-        transport-locksteped host packer."""
-        return (
+        transport-locksteped host packer. Join phases (use_pv) ride the
+        resident tier too, via the pass-deterministic PvPlan — the feed
+        becomes batch POSITIONS into resident idx/rank_offset/ins_weight
+        stacks; a model that takes rank_offset is only excluded from the
+        FLAT tier (no rank matrix exists there to feed it)."""
+        ok = (
             bool(config.get_flag("enable_resident_feed"))
             and (self.plan is None or jax.process_count() == 1)
-            and not use_pv
             and not is_async
-            and not self.cfg.model_takes_rank_offset
             and dataset.store is not None
             and len(dataset.store.u64_values) < (1 << 31)
         )
+        if not ok:
+            # cheap gates first: a multi-host join phase must NOT build the
+            # min_batches=0 plan here (its _pv_feed_iter needs the
+            # min_batches=min_b variant — a different cache key, so this
+            # one would be a wasted full pack sweep)
+            return False
+        if use_pv:
+            # the plan (and with it every record's store index) must exist;
+            # building it here is free for train_pass, which needs it next
+            return (
+                dataset.pv_plan(self._n_pack_devices if self.plan is not None else 1)
+                is not None
+            )
+        return not self.cfg.model_takes_rank_offset
 
     def prepare_pass(
         self, dataset: BoxPSDataset, n_batches: Optional[int] = None
@@ -756,10 +897,13 @@ class CTRTrainer:
         if dataset.store is None or dataset.ws is None:
             return
         use_pv = dataset.pv_merged and dataset.current_phase == 1
-        if use_pv:
-            # pv pads live in _pads, frozen by _pv_lockstep at feed time
-            return
         is_async = self.cfg.dense_sync_mode == "async" and not self._eval_active
+        if use_pv:
+            if self._use_resident(dataset, use_pv, is_async):
+                self._pv_resident_prepare(dataset)
+            # host-packed pv pads freeze at feed time (plan freeze_shapes
+            # or, records-only, the _pv_lockstep sweep)
+            return
         if self._use_resident(dataset, use_pv, is_async):
             rp = self._get_resident(dataset)
             blocks = (
@@ -864,7 +1008,7 @@ class CTRTrainer:
         if use_resident:
             stepper = self._resident_stepper(
                 dataset, n_batches, holder, eval_mode, profile,
-                t_feed, t_disp, t_dev,
+                t_feed, t_disp, t_dev, use_pv=use_pv,
             )
         else:
             stepper = self._classic_stepper(
