@@ -46,6 +46,22 @@ from paddlebox_tpu.utils.line_reader import BufferedLineFileReader
 config.define_flag(
     "padbox_dataset_shuffle_thread_num", 8, "default dataset reader thread count"
 )
+config.define_flag(
+    "enable_carried_table",
+    1,
+    "keep the trained pass table in device HBM across the pass boundary "
+    "and splice surviving rows into the next pass's table device-to-device "
+    "(D2H only the departing keys, H2D only the new ones); 0 = classic "
+    "full writeback + full re-upload",
+)
+config.define_flag(
+    "carried_eager_flush",
+    0,
+    "after the carried-table splice, flush the carrier to the host store "
+    "on a background thread (full-table D2H overlapping the next pass). "
+    "Frees the extra HBM the lazy default pins for a whole pass — use "
+    "when HBM, not transport bandwidth, is the constraint",
+)
 
 
 def _ins_id_dest(ins_id: str, n_parts: int) -> int:
@@ -681,8 +697,29 @@ class BoxPSDataset:
             self._staged = None
         if self.ws is None:
             raise RuntimeError("load_into_memory first")
+        if enable_revert:
+            # the rollback snapshot reads host rows — device-carried values
+            # must land first or the snapshot (and a later revert) would
+            # resurrect pre-carry state
+            self.table.drain_pending()
         if not self.ws._finalized:
-            self.device_table = self.ws.finalize(self.table, round_to=round_to)
+            carrier = getattr(self, "_carrier", None)
+            if carrier is not None and carrier.flushed:
+                carrier = None
+            if carrier is not None:
+                # only PassWorkingSet takes a carrier (the multi-host
+                # DistributedWorkingSet never has one by the carry gate)
+                self.device_table = self.ws.finalize(
+                    self.table, round_to=round_to, carrier=carrier
+                )
+                if config.get_flag("carried_eager_flush"):
+                    threading.Thread(
+                        target=self.table.drain_pending, daemon=False
+                    ).start()
+            else:
+                self.device_table = self.ws.finalize(
+                    self.table, round_to=round_to
+                )
         self.stats.keys = self.ws.n_keys
         # monitor parity: the reference bumps STAT_total_feasign_num_in_mem
         # as passes stage into memory (box_wrapper.cc:1282)
@@ -771,6 +808,41 @@ class BoxPSDataset:
         if need_save_delta and delta_dir is None:
             raise ValueError("need_save_delta requires delta_dir")
         ws, guard, table = self.ws, getattr(self, "_guard", None), self.table
+        # device-carried boundary: retain the trained DEVICE table instead
+        # of fetching it; the next finalize splices surviving rows
+        # device-to-device and fetches only the departing slice (EndPass
+        # HBM-cache-warm parity, box_wrapper.cc:627-651). Gated to the
+        # single-device single-process path; a save/guard/delta in the way
+        # flushes via table.drain_pending.
+        carrier = None
+        if (
+            trained_table is not None
+            and not isinstance(trained_table, np.ndarray)
+            and getattr(trained_table, "ndim", 0) == 2
+            and bool(config.get_flag("enable_carried_table"))
+            and type(ws).__name__ == "PassWorkingSet"
+            and guard is None
+        ):
+            import jax as _jax
+
+            if (
+                isinstance(trained_table, _jax.Array)
+                and _jax.process_count() == 1
+            ):
+                from paddlebox_tpu.table.carrier import TableCarrier
+
+                # decay is NOT pre-set: the worker's decay_and_shrink notes
+                # it on every pending carrier under the maintenance lock,
+                # so a concurrent drain can neither miss nor double it
+                carrier = TableCarrier(trained_table, ws, table.layout)
+                table.add_pending_carrier(carrier)
+                # the PREVIOUS boundary's carrier (if any) is superseded:
+                # its carried keys live on in this carrier's table, its
+                # departed keys were pushed at finalize
+                prev = getattr(self, "_carrier", None)
+                if prev is not None and not prev.flushed:
+                    prev.supersede()
+                self._carrier = carrier
         # the pass state clears NOW so the next load starts immediately.
         # _guard intentionally STAYS set until the worker confirms, and a
         # worker FAILURE restores the cleared state — so a failed publish
@@ -786,10 +858,24 @@ class BoxPSDataset:
         self._in_pass = False
         self._auc_runner = None  # pools reference this pass's records only
 
+        prev_carrier = getattr(self, "_prev_boundary_carrier", None)
+        self._prev_boundary_carrier = carrier
+
         def run():
             try:
-                if trained_table is not None:
+                if prev_carrier is not None:
+                    # the previous boundary's departing-slice push must land
+                    # before this boundary's decay (a late push would
+                    # overwrite decayed rows with un-decayed values)
+                    prev_carrier.join_push()
+                if trained_table is not None and carrier is None:
                     ws.writeback(np.asarray(trained_table))
+                    if prev_carrier is not None and not prev_carrier.flushed:
+                        # the full classic writeback covers everything a
+                        # still-pending carrier owed (carried keys are this
+                        # pass's rows; its departures just joined) — a later
+                        # splice or drain of it would resurrect stale values
+                        prev_carrier.supersede()
                 dropped = table.decay_and_shrink() if shrink else 0
                 saved = table.save_delta(delta_dir) if need_save_delta else 0
                 # enforce the host-RAM cap: evict cold rows to the disk tier

@@ -1,0 +1,161 @@
+"""Device-carried pass table: keep trained rows in HBM across passes.
+
+The classic pass boundary is symmetric and expensive on a bandwidth-limited
+host<->TPU transport: EndPass fetches the WHOLE trained table to the host
+(writeback), and the next finalize uploads the WHOLE new table back — yet in
+CTR streams consecutive passes share most of their keys (the reference keeps
+its HBM cache warm across passes for exactly this reason, EndPass
+box_wrapper.cc:627-651). The carrier exploits the overlap:
+
+- at ``end_pass`` the trained DEVICE array is retained (no D2H);
+- at the next finalize, rows whose keys survive into the new working set are
+  SPLICED device-to-device into the new pass table (with the boundary's
+  show/clk decay applied on device), rows whose keys leave are fetched and
+  pushed to the host store (D2H of only the departing slice), and only
+  genuinely new keys pull host rows and upload (H2D of only the new slice);
+- the host store lags by at most the carried rows; every save/export path
+  drains pending carriers first (``HostSparseTable.drain_pending``), so
+  anything durable still sees the trained values.
+
+Semantic deltas vs the classic boundary, both bounded and documented:
+- shrink: a carried key is exempt from the boundary's cold-key drop while it
+  stays carried (it is by definition active in the next pass; the host row
+  it would have been judged by is stale anyway). With shrink_threshold=0 the
+  paths are bit-equivalent.
+- durability: between boundary and flush, the host store holds pre-pass
+  values for carried keys. ``flush`` (directly, or via drain_pending from
+  any save) restores full host fidelity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class TableCarrier:
+    """One pass's trained device table, pending splice-or-flush.
+
+    Built at ``end_pass`` (no transfer), consumed by the next finalize
+    (splice) and/or ``flush`` (full writeback). The carrier stays alive
+    after the splice so a mid-pass save can still flush everything the host
+    is owed (= this table's values; the NEXT pass's training is on its own
+    live array and is owed nothing until its own end_pass).
+    """
+
+    def __init__(self, dev_flat, ws, layout, decay: Optional[float] = None):
+        # dev_flat: jax [rows, width], the single-device trained table
+        self.dev_flat = dev_flat
+        self.ws = ws
+        self.layout = layout
+        # accumulated show/clk decay owed to carried rows: each host-side
+        # decay_and_shrink that runs while this carrier is pending calls
+        # note_decay (HostSparseTable.decay_and_shrink does it under the
+        # maintenance lock, so a carrier can never miss or double-count a
+        # boundary). An eval pass keeping a carrier alive across TWO
+        # boundaries accumulates two decays, exactly like its host rows
+        # would have.
+        self._decay_accum = 1.0 if decay is None else float(decay)
+        self._flushed = False
+        self._push_fut = None  # in-flight background departure push
+        # ws-order positions already handed back to the host (departures):
+        # flush must not re-push them — once a key departs, the host row is
+        # live again (later passes may train it) and a re-push of this
+        # carrier's older value would overwrite that
+        self._departed: Optional[np.ndarray] = None
+
+    @property
+    def flushed(self) -> bool:
+        return self._flushed
+
+    def note_decay(self, rate: float) -> None:
+        """Record one boundary's show/clk decay (applied at splice/flush)."""
+        self._decay_accum *= float(rate)
+
+    def supersede(self) -> None:
+        """A newer full writeback (classic end_pass or a successor carrier)
+        covers every value this carrier owed: join the in-flight departure
+        push, release the HBM reference, and go inert."""
+        self.join_push()
+        self._flushed = True
+        self.dev_flat = None
+
+    def _decay_mult(self) -> Optional[np.ndarray]:
+        if self._decay_accum == 1.0:
+            return None
+        lay = self.layout
+        mult = np.ones(lay.width, dtype=np.float32)
+        mult[lay.SHOW] = self._decay_accum
+        mult[lay.CLK] = self._decay_accum
+        return mult
+
+    def rows_for(self, positions: np.ndarray):
+        """Device rows (decayed) for ws-order key positions [k] — stays on
+        device; the caller splices it into the next pass table."""
+        import jax.numpy as jnp
+
+        vals = self.dev_flat[self.ws.row_of_sorted[positions]]
+        mult = self._decay_mult()
+        if mult is not None:
+            vals = vals * jnp.asarray(mult)[None, :]
+        return vals
+
+    def fetch_for(self, positions: np.ndarray) -> np.ndarray:
+        """Host copy (decayed) of ws-order key positions — the departing
+        slice's D2H."""
+        vals = self.rows_for(positions)
+        return np.asarray(vals)
+
+    def push_departures_async(self, table, keys: np.ndarray, positions) -> None:
+        """Push the departing slice on a background thread: the D2H (the
+        expensive part on a tunneled transport) overlaps the next pass's
+        load/train instead of stalling the boundary. The device gather
+        dispatches NOW (so it reads this table's values, not anything
+        later); only the host fetch + push run on the worker. Joined by
+        flush(), and by the next end_pass before host decay (a late push
+        landing after a decay would un-decay those rows)."""
+        import threading
+        from concurrent.futures import Future
+
+        vals_dev = self.rows_for(positions)  # async dispatch, decayed
+        pos = np.asarray(positions)
+        self._departed = (
+            pos if self._departed is None else np.union1d(self._departed, pos)
+        )
+        fut: Future = Future()
+
+        def work():
+            try:
+                table.push(keys, np.asarray(vals_dev))
+                fut.set_result(len(keys))
+            except BaseException as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=work, daemon=False).start()
+        self._push_fut = fut
+
+    def join_push(self) -> None:
+        """Wait for an in-flight departure push (idempotent)."""
+        fut, self._push_fut = self._push_fut, None
+        if fut is not None:
+            fut.result()
+
+    def flush(self, table) -> int:
+        """Push every carried key's (decayed) value to the host store.
+
+        Idempotent; returns keys written. Called by drain_pending from any
+        save/export path, by rollback arming, and at close/day boundaries."""
+        self.join_push()
+        if self._flushed or self.ws is None or self.ws.n_keys == 0:
+            self._flushed = True
+            self.dev_flat = None
+            return 0
+        pos = np.arange(self.ws.n_keys)
+        if self._departed is not None:
+            pos = np.setdiff1d(pos, self._departed, assume_unique=True)
+        if len(pos):
+            table.push(self.ws.sorted_keys[pos], self.fetch_for(pos))
+        self._flushed = True
+        self.dev_flat = None  # release the HBM reference
+        return len(pos)

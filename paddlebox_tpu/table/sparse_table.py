@@ -138,6 +138,33 @@ class HostSparseTable:
         self._rng = np.random.default_rng(seed)
         self._size = 0
         self._size_lock = threading.Lock()
+        # device-carried pass tables owing this store a writeback (see
+        # table/carrier.py); every durable read path drains them first.
+        # _maintenance_lock orders carrier flushes against decay_and_shrink
+        # so a carried row's show/clk decay is applied exactly once per
+        # boundary no matter when a save drains.
+        self._pending_carriers: List = []
+        self._maintenance_lock = threading.Lock()
+
+    def add_pending_carrier(self, carrier) -> None:
+        """Register a TableCarrier whose values the host store is owed."""
+        with self._maintenance_lock:
+            self._pending_carriers = [
+                c for c in self._pending_carriers if not c.flushed
+            ]
+            self._pending_carriers.append(carrier)
+
+    def drain_pending(self) -> int:
+        """Flush every registered carrier (idempotent); returns keys written.
+
+        Called by save/export paths so durable artifacts always include
+        device-carried training."""
+        with self._maintenance_lock:
+            carriers, self._pending_carriers = self._pending_carriers, []
+            n = 0
+            for c in carriers:
+                n += c.flush(self)
+        return n
 
     @property
     def native(self) -> bool:
@@ -275,7 +302,21 @@ class HostSparseTable:
         Returns number of keys dropped. (pslib show_click_decay_rate + shrink
         threshold semantics; reference surfaces this as table shrink,
         fleet_wrapper.h:258-310.)
-        """
+
+        Pending device-carried tables (whose rows this decay cannot reach)
+        get the boundary's decay NOTED instead — they apply it at
+        splice/flush time. Held under the maintenance lock so a concurrent
+        drain either lands fully before (then its pushed rows decay here,
+        classic push-then-decay order) or fully after (then the flush
+        carries the noted decay) — never half."""
+        with self._maintenance_lock:
+            live = [c for c in self._pending_carriers if not c.flushed]
+            for c in live:
+                c.note_decay(self.opt.show_clk_decay)
+            self._pending_carriers = live
+            return self._decay_and_shrink_locked()
+
+    def _decay_and_shrink_locked(self) -> int:
         lay, opt = self.layout, self.opt
         if self._native is not None:
             return self._native.decay_and_shrink(
@@ -343,6 +384,7 @@ class HostSparseTable:
         return keys, vals
 
     def save_base(self, path: str) -> None:
+        self.drain_pending()
         os.makedirs(path, exist_ok=True)
         meta = {
             "n_shards": self.n_shards,
@@ -358,6 +400,7 @@ class HostSparseTable:
 
     def save_delta(self, path: str) -> int:
         """Write only keys touched since the last save; returns count."""
+        self.drain_pending()
         os.makedirs(path, exist_ok=True)
         total = 0
         for s in range(self.n_shards):
@@ -399,6 +442,7 @@ class HostSparseTable:
     def _filtered_save(self, path: str, mask_fn, meta: dict) -> int:
         """Shared filtered snapshot-to-dir writer (cache/whitelist saves).
         One snapshot per shard, streamed — nothing table-sized is held."""
+        self.drain_pending()
         os.makedirs(path, exist_ok=True)
         total = 0
         for s in range(self.n_shards):
@@ -480,13 +524,21 @@ class PassWorkingSet:
                 self._key_chunks.append(np.unique(keys.astype(np.uint64)))
 
     def finalize(
-        self, table: HostSparseTable, round_to: int = 512
+        self, table: HostSparseTable, round_to: int = 512, carrier=None
     ) -> np.ndarray:
         """Dedup keys, pull host rows, lay out [n_mesh_shards, cap, width].
 
         The returned array is what gets device_put with a mesh sharding on
         axis 0. Row (s, cap-1) of every shard is the reserved padding row.
-        """
+
+        With ``carrier`` (the previous pass's TableCarrier), the boundary
+        goes delta-only: keys present in both passes splice device-to-device
+        from the carried trained table (one decay applied on device), keys
+        that left the stream are fetched and pushed to the host store (D2H
+        of the departing slice only), and only NEW keys pull host rows and
+        upload. Returns a jax array in that case. The reference keeps its
+        HBM cache warm across passes the same way (EndPass
+        box_wrapper.cc:627-651)."""
         with self._lock:
             if self._key_chunks:
                 all_keys = np.unique(np.concatenate(self._key_chunks))
@@ -514,15 +566,61 @@ class PassWorkingSet:
 
         self.sorted_keys = all_keys  # np.unique output is sorted
         self.row_of_sorted = global_rows
+        self._finalized = True
+        self._table = table
 
+        if carrier is not None and not carrier.flushed and carrier.ws.n_keys:
+            return self._finalize_spliced(
+                table, carrier, all_keys, global_rows, ns, cap
+            )
         rows = table.pull_or_create(all_keys) if len(all_keys) else np.zeros(
             (0, table.layout.width), dtype=np.float32
         )
         dev = np.zeros((ns, cap, table.layout.width), dtype=np.float32)
         dev.reshape(ns * cap, -1)[global_rows] = rows
-        self._finalized = True
-        self._table = table
         return dev
+
+    def _finalize_spliced(
+        self, table, carrier, all_keys, global_rows, ns, cap
+    ):
+        """Delta boundary: splice carried rows on device, push departures,
+        upload only new keys. Returns the [ns, cap, width] jax array."""
+        import jax.numpy as jnp
+
+        old_keys = carrier.ws.sorted_keys
+        # both sides sorted: positions of the intersection in each
+        pos_in_old = np.searchsorted(old_keys, all_keys)
+        pos_in_old = np.minimum(pos_in_old, len(old_keys) - 1)
+        common = old_keys[pos_in_old] == all_keys  # mask over all_keys
+        common_old = pos_in_old[common]
+        # departing = old keys NOT in the new set
+        in_new = np.zeros(len(old_keys), dtype=bool)
+        in_new[common_old] = True
+        leave_pos = np.nonzero(~in_new)[0]
+        if len(leave_pos):
+            # departing slice: D2H + host push overlap the next pass
+            # (joined before any decay or durable read)
+            carrier.push_departures_async(
+                table, old_keys[leave_pos], leave_pos
+            )
+        new_mask = ~common
+        new_keys = all_keys[new_mask]
+        W = table.layout.width
+        new_vals = (
+            table.pull_or_create(new_keys)
+            if len(new_keys)
+            else np.zeros((0, W), dtype=np.float32)
+        )
+        dev = jnp.zeros((ns * cap, W), dtype=jnp.float32)
+        if len(new_keys):
+            dev = dev.at[jnp.asarray(global_rows[new_mask])].set(
+                jnp.asarray(new_vals)
+            )
+        if common.any():
+            dev = dev.at[jnp.asarray(global_rows[common])].set(
+                carrier.rows_for(common_old)
+            )
+        return dev.reshape(ns, cap, W)
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Batch keys -> global row ids (int32). Keys must be in the pass."""

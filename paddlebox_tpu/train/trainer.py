@@ -1198,8 +1198,32 @@ class CTRTrainer:
 
             join_tr.train_pass(ds); join_tr.handoff_table(ds)
             upd_tr.train_pass(ds);  ds.end_pass(upd_tr.trained_table())
+
+        Single-process the handoff stays ON DEVICE (no D2H/H2D round trip
+        between phases); only the multi-host path goes through host memory
+        (its writeback layout is per-host anyway).
         """
-        t = self.trained_table()
+        if self.plan is not None and jax.process_count() > 1:
+            t = self.trained_table()
+        else:
+            if self._state is None:
+                raise RuntimeError("no trained pass")
+            t = self._state.table
         if t.ndim == 2:  # single-device flat layout -> ws shard layout
             t = t.reshape(-1, dataset.ws.capacity, t.shape[-1])
         dataset.device_table = t
+
+    def trained_table_device(self):
+        """The live trained DEVICE table (no transfer): hand this to
+        ``end_pass`` to opt into the device-carried boundary
+        (table/carrier.py) — the next pass's finalize then splices
+        surviving rows on device and fetches only the departing slice.
+        Single-process only; multi-host writeback uses trained_table()."""
+        if self._state is None:
+            raise RuntimeError("no trained pass")
+        if self.plan is not None and jax.process_count() > 1:
+            raise NotImplementedError(
+                "device-carried boundary is single-process; multi-host "
+                "passes write back via trained_table()"
+            )
+        return self._state.table

@@ -1,0 +1,296 @@
+"""Device-carried pass boundary (table/carrier.py): the trained table stays
+in HBM across passes; the next finalize splices surviving rows on device and
+the host store is owed only the departing slice (+ drain on any save).
+
+Equality contract: with shrink_threshold=0 (no cold-key drops) the carried
+boundary produces bit-for-bit the same host table and training trajectory as
+the classic full writeback + full re-upload.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+
+S, B = 4, 8
+
+
+def _schema():
+    return SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(S)],
+        label_slot="label",
+    )
+
+
+def _write_pass(path, seed, lo, hi, n=48):
+    """Records whose keys come from [lo, hi): consecutive passes overlap."""
+    rng = np.random.default_rng(seed)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        for _ in range(n):
+            parts = [f"1 {float(rng.integers(0, 2))}"]
+            for _s in range(S):
+                k = int(rng.integers(1, 3))
+                vals = rng.integers(lo, hi, k)
+                parts.append(f"{k} " + " ".join(str(v) for v in vals))
+            f.write(" ".join(parts) + "\n")
+    return str(path)
+
+
+def _opt():
+    return SparseOptimizerConfig(
+        embedx_threshold=0.0, show_clk_decay=0.95, shrink_threshold=0.0
+    )
+
+
+def _run_two_passes(tmp_path, carried: bool):
+    """Train two overlapping passes; return (host table snapshot fn output,
+    per-pass losses)."""
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1 if carried else 0)
+    try:
+        layout = ValueLayout(embedx_dim=4)
+        table = HostSparseTable(layout, _opt(), n_shards=2, seed=0)
+        ds = BoxPSDataset(_schema(), table, batch_size=B, shuffle_mode="none")
+        model = DeepFM(
+            num_slots=S, feat_width=layout.pull_width, embedx_dim=4, hidden=(8,)
+        )
+        cfg = TrainStepConfig(
+            num_slots=S, batch_size=B, layout=layout, sparse_opt=_opt(),
+            auc_buckets=100,
+        )
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+        tr.init_params(jax.random.PRNGKey(0))
+        losses = []
+        # pass key ranges overlap heavily: [1, 200) then [100, 300)
+        for i, (lo, hi) in enumerate([(1, 200), (100, 300)]):
+            f = _write_pass(tmp_path / f"p{i}.txt", seed=i, lo=lo, hi=hi)
+            ds.set_filelist([f])
+            ds.load_into_memory()
+            ds.begin_pass(round_to=8)
+            out = tr.train_pass(ds)
+            losses.append(out["loss"])
+            ds.end_pass(
+                tr.trained_table_device() if carried else tr.trained_table()
+            )
+        table.drain_pending()  # final flush so the host view is complete
+        keys = np.sort(table.keys())
+        vals = table.pull_or_create(keys)
+        return keys, vals, losses
+    finally:
+        config.set_flag("enable_carried_table", prev)
+
+
+def test_carried_boundary_matches_classic(tmp_path):
+    k_c, v_c, l_c = _run_two_passes(tmp_path / "classic", carried=False)
+    k_d, v_d, l_d = _run_two_passes(tmp_path / "carried", carried=True)
+    np.testing.assert_array_equal(k_d, k_c)
+    # identical training trajectory: pass-2 initial rows must match, so
+    # losses and the final host table agree to float tolerance
+    np.testing.assert_allclose(l_d, l_c, atol=1e-6)
+    np.testing.assert_allclose(v_d, v_c, atol=1e-5)
+
+
+def test_save_drains_carried_values(tmp_path):
+    """A save while values are device-carried must include them."""
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1)
+    try:
+        layout = ValueLayout(embedx_dim=4)
+        table = HostSparseTable(layout, _opt(), n_shards=2, seed=0)
+        ds = BoxPSDataset(_schema(), table, batch_size=B, shuffle_mode="none")
+        f = _write_pass(tmp_path / "p0.txt", seed=0, lo=1, hi=200)
+        ds.set_filelist([f])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)
+        model = DeepFM(
+            num_slots=S, feat_width=layout.pull_width, embedx_dim=4, hidden=(8,)
+        )
+        cfg = TrainStepConfig(
+            num_slots=S, batch_size=B, layout=layout, sparse_opt=_opt(),
+            auc_buckets=100,
+        )
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+        tr.init_params(jax.random.PRNGKey(0))
+        tr.train_pass(ds)
+        dev_vals = np.asarray(tr.trained_table_device())
+        ws = ds.ws
+        ds.end_pass(tr.trained_table_device())  # carried: host not written yet
+        # save must drain: saved rows == decayed trained device rows
+        table.save_base(str(tmp_path / "base"))
+        fresh = HostSparseTable(layout, _opt(), n_shards=2, seed=1)
+        fresh.load(str(tmp_path / "base"))
+        got = fresh.pull_or_create(ws.sorted_keys)
+        want = dev_vals[ws.row_of_sorted]
+        want[:, layout.SHOW] *= 0.95
+        want[:, layout.CLK] *= 0.95
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    finally:
+        config.set_flag("enable_carried_table", prev)
+
+
+def _mk(tmp_path, seed=0, lo=1, hi=200):
+    layout = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(layout, _opt(), n_shards=2, seed=0)
+    ds = BoxPSDataset(_schema(), table, batch_size=B, shuffle_mode="none")
+    model = DeepFM(
+        num_slots=S, feat_width=layout.pull_width, embedx_dim=4, hidden=(8,)
+    )
+    cfg = TrainStepConfig(
+        num_slots=S, batch_size=B, layout=layout, sparse_opt=_opt(),
+        auc_buckets=100,
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    f = _write_pass(tmp_path / f"p{seed}.txt", seed=seed, lo=lo, hi=hi)
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=8)
+    return layout, table, ds, tr
+
+
+def test_classic_writeback_supersedes_stale_carrier(tmp_path):
+    """Pass 1 carried, pass 2 ends with a CLASSIC (numpy) writeback: the
+    stale carrier must go inert — a later save must not resurrect pass-1
+    values over pass-2 training."""
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1)
+    try:
+        layout, table, ds, tr = _mk(tmp_path, seed=0)
+        tr.train_pass(ds)
+        ds.end_pass(tr.trained_table_device())  # carried
+        f1 = _write_pass(tmp_path / "p1.txt", seed=1, lo=100, hi=300)
+        ds.set_filelist([f1])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)  # splices the carrier
+        tr.train_pass(ds)
+        keys2 = ds.ws.sorted_keys.copy()
+        classic = tr.trained_table()  # numpy -> classic writeback
+        rows2 = classic.reshape(-1, layout.width)[ds.ws.row_of_sorted].copy()
+        ds.end_pass(classic)
+        # drain must be a no-op now: host rows == pass-2 trained (+decay)
+        table.drain_pending()
+        got = table.pull_or_create(keys2)
+        rows2[:, layout.SHOW] *= 0.95
+        rows2[:, layout.CLK] *= 0.95
+        np.testing.assert_allclose(got, rows2, atol=1e-5)
+    finally:
+        config.set_flag("enable_carried_table", prev)
+
+
+def test_decay_accumulates_across_kept_boundaries(tmp_path):
+    """A carrier kept pending across TWO decaying boundaries (an eval pass
+    writes nothing back) owes two decays at flush, like host rows would."""
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1)
+    try:
+        layout, table, ds, tr = _mk(tmp_path, seed=0)
+        tr.train_pass(ds)
+        dev = np.asarray(tr.trained_table_device())
+        ws1 = ds.ws
+        ds.end_pass(tr.trained_table_device())  # boundary 1: decay noted
+        # boundary 2: an eval-ish pass over fresh DISJOINT keys ends with
+        # nothing to write back; the carrier stays pending and its keys are
+        # NOT in this pass (disjoint), so no splice supersedes them
+        f1 = _write_pass(tmp_path / "p1.txt", seed=1, lo=1000, hi=1200)
+        ds.set_filelist([f1])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)
+        ds.end_pass(None)  # boundary 2: decay noted again
+        table.drain_pending()
+        got = table.pull_or_create(ws1.sorted_keys)
+        want = dev[ws1.row_of_sorted]
+        want[:, layout.SHOW] *= 0.95 * 0.95
+        want[:, layout.CLK] *= 0.95 * 0.95
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    finally:
+        config.set_flag("enable_carried_table", prev)
+
+
+def test_eager_flush_frees_carrier(tmp_path):
+    """carried_eager_flush=1: the splice is followed by a background full
+    flush, so the carrier goes inert without any explicit drain."""
+    prev = config.get_flag("enable_carried_table")
+    prev_e = config.get_flag("carried_eager_flush")
+    config.set_flag("enable_carried_table", 1)
+    config.set_flag("carried_eager_flush", 1)
+    try:
+        layout, table, ds, tr = _mk(tmp_path, seed=0)
+        tr.train_pass(ds)
+        dev = np.asarray(tr.trained_table_device())
+        ws1 = ds.ws
+        ds.end_pass(tr.trained_table_device())
+        carrier = ds._carrier
+        f1 = _write_pass(tmp_path / "p1.txt", seed=1, lo=100, hi=300)
+        ds.set_filelist([f1])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)  # splice + background flush
+        import time
+
+        for _ in range(100):
+            if carrier.flushed:
+                break
+            time.sleep(0.05)
+        assert carrier.flushed and carrier.dev_flat is None
+        got = table.pull_or_create(ws1.sorted_keys)
+        want = dev[ws1.row_of_sorted]
+        want[:, layout.SHOW] *= 0.95
+        want[:, layout.CLK] *= 0.95
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        ds.end_pass(None)
+    finally:
+        config.set_flag("enable_carried_table", prev)
+        config.set_flag("carried_eager_flush", prev_e)
+
+
+def test_revert_after_carried_boundary(tmp_path):
+    """begin_pass(enable_revert=True) drains the carrier first so the
+    snapshot (and a revert) sees true pre-pass values."""
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1)
+    try:
+        layout = ValueLayout(embedx_dim=4)
+        table = HostSparseTable(layout, _opt(), n_shards=2, seed=0)
+        ds = BoxPSDataset(_schema(), table, batch_size=B, shuffle_mode="none")
+        model = DeepFM(
+            num_slots=S, feat_width=layout.pull_width, embedx_dim=4, hidden=(8,)
+        )
+        cfg = TrainStepConfig(
+            num_slots=S, batch_size=B, layout=layout, sparse_opt=_opt(),
+            auc_buckets=100,
+        )
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+        tr.init_params(jax.random.PRNGKey(0))
+        f0 = _write_pass(tmp_path / "p0.txt", seed=0, lo=1, hi=200)
+        ds.set_filelist([f0])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)
+        tr.train_pass(ds)
+        ds.end_pass(tr.trained_table_device())  # carried
+        # pass 2 armed for revert: carrier must flush before the snapshot
+        f1 = _write_pass(tmp_path / "p1.txt", seed=1, lo=100, hi=300)
+        ds.set_filelist([f1])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8, enable_revert=True, trainer=tr)
+        keys2 = ds.ws.sorted_keys.copy()
+        pre = table.pull_or_create(keys2).copy()
+        tr.train_pass(ds)
+        ds.revert_pass()
+        post = table.pull_or_create(keys2)
+        np.testing.assert_allclose(post, pre, atol=0)
+    finally:
+        config.set_flag("enable_carried_table", prev)
