@@ -283,6 +283,12 @@ def main():
         # packer scratch.
         from paddlebox_tpu import config as _config
 
+        # bf16 boundary wire: halves the departing-slice D2H and new-key
+        # H2D at the carried boundary (AUC in the output guards quality)
+        _config.set_flag(
+            "wire_dtype", os.environ.get("PBOX_WIRE_DTYPE", "bf16")
+        )
+
         trainer.prepare_pass(ds, n_batches=TRAIN_BATCHES)
         warm = max(4, int(_config.get_flag("resident_scan_batches")))
         trainer.train_pass(ds, n_batches=warm)

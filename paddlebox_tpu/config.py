@@ -74,6 +74,22 @@ define_flag("enable_ins_parser_file", False, "allow per-file parser plugin")
 define_flag("enable_native_parser", True, "use the C++ slot parser fast path when eligible")
 define_flag("sample_rate", 1.0, "line sampling rate on read (BufferedLineFileReader parity)")
 
+# --- wire formats (ops/wire_quant.py; defined here so consumers can read
+# them without importing that module first) ---
+define_flag(
+    "wire_dtype",
+    "fp32",
+    "value format on the host<->device boundary wire (carrier splice "
+    "uploads, departing-slice fetch, flush, classic device writeback): "
+    "fp32 | bf16 | int8 (int8 = per-row-scaled embed block + bf16 rest)",
+)
+define_flag(
+    "ici_wire_dtype",
+    "fp32",
+    "value format of the sharded pull/push all_to_all payloads over ICI: "
+    "fp32 | bf16",
+)
+
 # --- sparse table ---
 define_flag("sparse_table_shard_bits", 6, "log2 host shards in the tiered store")
 define_flag("enable_pullpush_dedup_keys", True, "dedup keys across slots before pull (reference flags.cc:603)")

@@ -869,7 +869,19 @@ class BoxPSDataset:
                     # overwrite decayed rows with un-decayed values)
                     prev_carrier.join_push()
                 if trained_table is not None and carrier is None:
-                    ws.writeback(np.asarray(trained_table))
+                    arr = trained_table
+                    if not isinstance(arr, np.ndarray):
+                        # device array taking the classic path (mesh, or
+                        # carry gated off): honor the boundary wire format
+                        from paddlebox_tpu.ops.wire_quant import fetch_rows
+
+                        shape = arr.shape
+                        arr = fetch_rows(
+                            arr.reshape(-1, shape[-1]),
+                            table.layout,
+                            str(config.get_flag("wire_dtype")),
+                        ).reshape(shape)
+                    ws.writeback(np.asarray(arr))
                     if prev_carrier is not None and not prev_carrier.flushed:
                         # the full classic writeback covers everything a
                         # still-pending carrier owed (carried keys are this

@@ -1,0 +1,154 @@
+"""Quantized wire formats for host<->device and inter-chip value traffic.
+
+The reference ships compressed pull records over its wires — the
+Quant/ShowClk pull-value family (FeaturePullValueGpuQuant dispatch,
+box_wrapper.cc:419-437) packs embeddings as int16 with a scale, because the
+PS lives on the host and every batch's values cross PCIe. This framework's
+architecture removed the per-batch value wire entirely (the pass table lives
+in HBM; per-batch feed is index-only), so quantization applies where values
+still move:
+
+- the pass-boundary wire (table/carrier.py: new-key upload, departing-slice
+  fetch, flush, classic device writeback) over a bandwidth-limited
+  host<->TPU transport — full TABLE ROWS, handled by the layout-aware
+  ``send_rows_*``/``fetch_rows_*`` API below;
+- the ICI all_to_all payloads of the sharded pull/push
+  (parallel/sharded_pullpush.py) on multi-chip meshes — handled inline by a
+  bf16 cast at the collective.
+
+Formats (``wire_dtype`` / ``ici_wire_dtype`` flags, defined in config.py so
+they exist before this module loads; default fp32 = exact):
+- ``bf16``: drop 16 mantissa bits; ~3 significant digits — comfortably
+  inside CTR embedding noise, exactly half the bytes.
+- ``int8`` (row wire only): the EMBED VALUE block (embed_w + embedx +
+  expand — contiguous columns [embed_w_col, embed_g2_col)) is int8 with a
+  per-row max-abs scale, like the reference's int16 quant pull; the
+  heterogeneous remainder (show/clk counters, conv/pcoc extras, adagrad g2)
+  rides bf16 — a shared row scale would let a show=1000 counter zero out
+  0.01-magnitude embeddings.
+
+Host-side casts use ml_dtypes (numpy bf16 support ships with jax).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import ml_dtypes
+
+from paddlebox_tpu import config  # flags wire_dtype / ici_wire_dtype live there
+
+BF16 = ml_dtypes.bfloat16
+
+_MODES = ("fp32", "bf16", "int8")
+
+
+def _check(mode: str) -> str:
+    if mode not in _MODES:
+        raise ValueError(f"wire dtype {mode!r} not in {_MODES}")
+    return mode
+
+
+def _embed_span(layout) -> Tuple[int, int]:
+    """[start, stop) of the contiguous embed-value block in a table row."""
+    return layout.embed_w_col, layout.embed_g2_col
+
+
+# ---- table-row wire (boundary transfers) ------------------------------------
+#
+# A "wire handle" is a dict of arrays (device or host) that crosses the wire
+# as-is; the matching finish/receive call reassembles fp32 rows on the other
+# side. Splitting start/finish lets an async sender dispatch the device-side
+# casts immediately (so they read current values) while the blocking
+# transfer happens on a worker thread.
+
+
+def fetch_rows_start(arr, layout, mode: str):
+    """Device fp32 [n, width] -> wire handle of device arrays (D2H side).
+
+    Dispatches the quantizing casts now; nothing blocks until
+    ``fetch_rows_finish`` pulls the handle to the host."""
+    import jax.numpy as jnp
+
+    mode = _check(mode)
+    if mode == "fp32":
+        return {"mode": mode, "raw": arr}
+    if mode == "bf16":
+        return {"mode": mode, "raw": arr.astype(jnp.bfloat16)}
+    a, b = _embed_span(layout)
+    emb = arr[:, a:b]
+    scale = jnp.maximum(jnp.abs(emb).max(axis=1), 1e-12) / 127.0
+    q = jnp.clip(jnp.rint(emb / scale[:, None]), -127, 127).astype(jnp.int8)
+    return {
+        "mode": mode,
+        "q": q,
+        "scale": scale.astype(jnp.float32),
+        "head": arr[:, :a].astype(jnp.bfloat16),
+        "tail": arr[:, b:].astype(jnp.bfloat16),
+    }
+
+
+def fetch_rows_finish(handle, layout) -> np.ndarray:
+    """Blocking D2H of a wire handle -> host fp32 [n, width]."""
+    mode = handle["mode"]
+    if mode == "fp32":
+        return np.asarray(handle["raw"])
+    if mode == "bf16":
+        return np.asarray(handle["raw"]).astype(np.float32)
+    a, b = _embed_span(layout)
+    q = np.asarray(handle["q"]).astype(np.float32)
+    scale = np.asarray(handle["scale"])
+    head = np.asarray(handle["head"]).astype(np.float32)
+    tail = np.asarray(handle["tail"]).astype(np.float32)
+    out = np.empty((q.shape[0], layout.width), dtype=np.float32)
+    out[:, :a] = head
+    out[:, a:b] = q * scale[:, None]
+    out[:, b:] = tail
+    return out
+
+
+def fetch_rows(arr, layout, mode: str) -> np.ndarray:
+    """One-shot device fp32 rows -> host fp32 rows over the quantized wire."""
+    return fetch_rows_finish(fetch_rows_start(arr, layout, mode), layout)
+
+
+def send_rows(arr: np.ndarray, layout, mode: str):
+    """Host fp32 [n, width] -> device fp32 [n, width] over the quantized
+    wire (H2D side: casts happen host-side so only the small payload
+    crosses; the device reassembles)."""
+    import jax.numpy as jnp
+
+    mode = _check(mode)
+    if mode == "fp32":
+        return jnp.asarray(arr)
+    if mode == "bf16":
+        return jnp.asarray(arr.astype(BF16)).astype(jnp.float32)
+    a, b = _embed_span(layout)
+    emb = arr[:, a:b]
+    scale = np.maximum(np.abs(emb).max(axis=1), 1e-12) / 127.0
+    q = np.clip(np.rint(emb / scale[:, None]), -127, 127).astype(np.int8)
+    out = jnp.empty((arr.shape[0], layout.width), dtype=jnp.float32)
+    out = out.at[:, :a].set(
+        jnp.asarray(arr[:, :a].astype(BF16)).astype(jnp.float32)
+    )
+    out = out.at[:, a:b].set(
+        jnp.asarray(q).astype(jnp.float32)
+        * jnp.asarray(scale.astype(np.float32))[:, None]
+    )
+    out = out.at[:, b:].set(
+        jnp.asarray(arr[:, b:].astype(BF16)).astype(jnp.float32)
+    )
+    return out
+
+
+def row_wire_nbytes(n: int, layout, mode: str) -> int:
+    """Bytes crossing the wire for n table rows under a mode."""
+    w = layout.width
+    if mode == "fp32":
+        return n * w * 4
+    if mode == "bf16":
+        return n * w * 2
+    a, b = _embed_span(layout)
+    return n * ((b - a) + (w - (b - a)) * 2 + 4)  # int8 + bf16 rest + scale

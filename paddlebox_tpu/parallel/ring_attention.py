@@ -131,13 +131,61 @@ def ulysses_attention(
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qf, kf, vf = seq_to_head(q), seq_to_head(k), seq_to_head(v)
-    s = _block_scores(qf, kf, scale)  # [B, H/n, S*n, S*n]
-    if causal:
-        Sg = S * n
-        allowed = _causal_mask(jnp.arange(Sg), jnp.arange(Sg))
-        s = jnp.where(allowed[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)  # f32 (scores accumulate in f32)
-    of = jnp.einsum(
-        "bhqk,bkhd->bqhd", p, vf, preferred_element_type=jnp.float32
-    )  # [B, S*n, H/n, D]
+    # flash-style chunked local attention: the naive route materializes
+    # [B, H/n, S*n, S*n] scores — O(S²) memory that defeats sequence
+    # parallelism at exactly the lengths it exists for. Stream key chunks
+    # through the same running log-sum-exp the ring body uses; memory is
+    # O(S*n · chunk).
+    of = _flash_local(qf, kf, vf, scale, causal)  # [B, S*n, H/n, D]
     return head_to_seq(of.astype(q.dtype))
+
+
+def _flash_local(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, H, D]
+    v: jnp.ndarray,
+    scale: float,
+    causal: bool,
+    kv_chunk: int = 512,
+) -> jnp.ndarray:
+    """Exact single-device attention, keys streamed in chunks (flash-style
+    online softmax). Returns [B, Sq, H, D] in f32 accumulation. Positions
+    are global 0..S (q and k share the origin), so the causal mask matches
+    the unchunked computation bit-for-bit in masking decisions."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    # largest divisor of Sk that fits the target chunk (shapes are static
+    # at trace time, so this is plain Python)
+    chunk = min(kv_chunk, Sk)
+    while Sk % chunk:
+        chunk -= 1
+    n_chunks = Sk // chunk
+    q_pos = jnp.arange(Sq)
+
+    def body(carry, t):
+        o, m, l = carry
+        kt = lax.dynamic_slice_in_dim(k, t * chunk, chunk, axis=1)
+        vt = lax.dynamic_slice_in_dim(v, t * chunk, chunk, axis=1)
+        s = _block_scores(q, kt, scale)  # [B, H, Sq, chunk]
+        if causal:
+            k_pos = t * chunk + jnp.arange(chunk)
+            allowed = _causal_mask(q_pos, k_pos)
+            s = jnp.where(allowed[None, None], s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(allowed[None, None], p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vt, preferred_element_type=jnp.float32
+        )
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    (o, m, l), _ = lax.scan(body, (o0, m0, l0), jnp.arange(n_chunks))
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", o)

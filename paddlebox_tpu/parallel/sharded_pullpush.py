@@ -66,9 +66,17 @@ def sharded_pull(
         resp = pull_sparse_rows(
             table_local, req_recv.reshape(-1), layout, embedx_threshold, scale
         ).reshape(n, K, -1)
-    # route value buckets back: row s = bucket answered by shard s
+    # route value buckets back: row s = bucket answered by shard s.
+    # ici_wire_dtype=bf16 halves the ICI payload (the quant pull-value
+    # family of box_wrapper.cc:419-437, applied to the only wire this
+    # architecture still ships values over per batch); flag read at trace
+    # time, so the cast compiles into the fixed collective.
+    from paddlebox_tpu import config as _config
+
+    if str(_config.get_flag("ici_wire_dtype")) == "bf16":
+        resp = resp.astype(jnp.bfloat16)
     resp_back = lax.all_to_all(resp, axis_name, 0, 0, tiled=True)
-    return resp_back.reshape(n * K, -1)
+    return resp_back.reshape(n * K, -1).astype(jnp.float32)
 
 
 def sharded_push(
@@ -94,7 +102,14 @@ def sharded_push(
     recs = jnp.concatenate(
         [show_bucket[:, None], clk_bucket[:, None], grads_bucket], axis=1
     ).reshape(n, K, gw + 2)
+    # push grads in bf16 over ICI when flagged (show/clk counts are small
+    # integers, exact in bf16 up to 256 per bucket slot)
+    from paddlebox_tpu import config as _config
+
+    if str(_config.get_flag("ici_wire_dtype")) == "bf16":
+        recs = recs.astype(jnp.bfloat16)
     recs_recv = lax.all_to_all(recs, axis_name, 0, 0, tiled=True)  # [n, K, gw+2]
+    recs_recv = recs_recv.astype(jnp.float32)
     ranks_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
 
     M = n * K

@@ -103,9 +103,17 @@ class TableCarrier:
 
     def fetch_for(self, positions: np.ndarray) -> np.ndarray:
         """Host copy (decayed) of ws-order key positions — the departing
-        slice's D2H."""
-        vals = self.rows_for(positions)
-        return np.asarray(vals)
+        slice's D2H. Honors the ``wire_dtype`` flag: bf16/int8 shrinks the
+        bytes on the transport (Quant pull-value parity,
+        box_wrapper.cc:419-437)."""
+        from paddlebox_tpu import config
+        from paddlebox_tpu.ops.wire_quant import fetch_rows
+
+        return fetch_rows(
+            self.rows_for(positions),
+            self.layout,
+            str(config.get_flag("wire_dtype")),
+        )
 
     def push_departures_async(self, table, keys: np.ndarray, positions) -> None:
         """Push the departing slice on a background thread: the D2H (the
@@ -118,7 +126,16 @@ class TableCarrier:
         import threading
         from concurrent.futures import Future
 
-        vals_dev = self.rows_for(positions)  # async dispatch, decayed
+        from paddlebox_tpu import config
+        from paddlebox_tpu.ops.wire_quant import (
+            fetch_rows_finish,
+            fetch_rows_start,
+        )
+
+        mode = str(config.get_flag("wire_dtype"))
+        # quantizing casts dispatch NOW (they must read this table's
+        # values); only the blocking D2H + push run on the worker
+        handle = fetch_rows_start(self.rows_for(positions), self.layout, mode)
         pos = np.asarray(positions)
         self._departed = (
             pos if self._departed is None else np.union1d(self._departed, pos)
@@ -127,7 +144,7 @@ class TableCarrier:
 
         def work():
             try:
-                table.push(keys, np.asarray(vals_dev))
+                table.push(keys, fetch_rows_finish(handle, self.layout))
                 fut.set_result(len(keys))
             except BaseException as e:
                 fut.set_exception(e)

@@ -613,9 +613,13 @@ class PassWorkingSet:
         )
         dev = jnp.zeros((ns * cap, W), dtype=jnp.float32)
         if len(new_keys):
-            dev = dev.at[jnp.asarray(global_rows[new_mask])].set(
-                jnp.asarray(new_vals)
+            from paddlebox_tpu import config as _config
+            from paddlebox_tpu.ops.wire_quant import send_rows
+
+            up = send_rows(
+                new_vals, table.layout, str(_config.get_flag("wire_dtype"))
             )
+            dev = dev.at[jnp.asarray(global_rows[new_mask])].set(up)
         if common.any():
             dev = dev.at[jnp.asarray(global_rows[common])].set(
                 carrier.rows_for(common_old)

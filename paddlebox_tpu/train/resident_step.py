@@ -82,10 +82,24 @@ class ResidentPass:
             raise ValueError("pass too large for resident feed (>=2^31 keys)")
         self._host_rows = rows
         self._key_counts = store.key_counts()
-        # absolute per-(record, slot) offsets into the flat row stream
-        off = store.u64_base[:, None] + store.u64_offsets.astype(np.int64)
         self.rows = jnp.asarray(rows.astype(np.int32))
-        self.off = jnp.asarray(off.astype(np.int32))  # [N, S+1]
+        # per-(record, slot) offsets into the flat row stream. Wire-compact
+        # form: per-slot COUNTS fit uint8 (CTR slots hold a handful of
+        # feasigns), so the upload ships [N, S] bytes + an [N] int32 base
+        # instead of [N, S+1] int32 — ~4x less than the offset matrix, the
+        # bulk of the resident upload after `rows`. Offsets rebuild on
+        # device as a per-batch cumsum (batch_offsets). Falls back to the
+        # full matrix when any slot exceeds 255 keys.
+        slot_counts = np.diff(store.u64_offsets.astype(np.int64), axis=1)
+        if slot_counts.size and slot_counts.max() <= 255:
+            self.base = jnp.asarray(store.u64_base.astype(np.int32))
+            self.counts = jnp.asarray(slot_counts.astype(np.uint8))
+            self.off = None
+        else:
+            off = store.u64_base[:, None] + store.u64_offsets.astype(np.int64)
+            self.base = None
+            self.counts = None
+            self.off = jnp.asarray(off.astype(np.int32))  # [N, S+1]
         label_name = label_slot or schema.label_slot
         if label_name is not None:
             li = schema.float_slot_index(label_name)
@@ -131,20 +145,30 @@ class ResidentPass:
 
 
 
+def _batch_offsets(arrs: Dict[str, jnp.ndarray], idx: jnp.ndarray) -> jnp.ndarray:
+    """[B, S+1] absolute flat-stream offsets for a batch, from whichever
+    resident representation was uploaded (full matrix, or base+uint8
+    counts rebuilt by cumsum on device)."""
+    if arrs.get("off") is not None:
+        return arrs["off"][idx]
+    c = arrs["counts"][idx].astype(jnp.int32)  # [B, S]
+    cum = jnp.cumsum(c, axis=1)
+    zero = jnp.zeros((cum.shape[0], 1), jnp.int32)
+    return arrs["base"][idx][:, None] + jnp.concatenate([zero, cum], axis=1)
+
+
 def _ragged_rows(
     rows_res: jnp.ndarray,
-    off_res: jnp.ndarray,
-    idx: jnp.ndarray,  # [B] record indices
+    off_b: jnp.ndarray,  # [B, S+1] this batch's absolute offsets
     S: int,
     B: int,
     L_pad: int,
     pad_value,
 ):
-    """Shared ragged gather: record indices -> (rows_flat, segments, valid)
+    """Shared ragged gather: batch offsets -> (rows_flat, segments, valid)
     in slot-major flat order. ``pad_value`` fills invalid tail rows (the
     single-device tier pads with the real padding row; the mesh tier with
     an out-of-range sentinel its sort treats as +inf)."""
-    off_b = off_res[idx]  # [B, S+1]
     lens_b = off_b[:, 1:] - off_b[:, :-1]
     starts_b = off_b[:, :-1]
     lens_flat = lens_b.T.reshape(-1)  # [S*B] slot-major
@@ -174,8 +198,11 @@ def build_device_batch(
     """
     S, B = cfg.num_slots, cfg.batch_size
     L_pad, U_pad = rp.L_pad, rp.U_pad
+    off_b = _batch_offsets(
+        {"off": rp.off, "base": rp.base, "counts": rp.counts}, idx
+    )
     rows_flat, segments, valid = _ragged_rows(
-        rp.rows, rp.off, idx, S, B, L_pad, rp.pad_row
+        rp.rows, off_b, S, B, L_pad, rp.pad_row
     )
     # cross-slot dedup on device: sort rows, first-occurrence scan
     INF = jnp.int32(rp.n_table_rows)
@@ -341,18 +368,12 @@ def make_resident_pv_mesh_superstep(
     local_step = make_local_mesh_step(model_apply, dense_opt, cfg, plan, eval_mode)
     ns, cap = rp.ws.n_mesh_shards, rp.ws.capacity
     L_pad, K = rp.L_pad, rp.K_pad
-    has_dense = rp.dense is not None
+    rp_arrays = _resident_arrays(rp)
 
-    def superstep_local(
-        state, pos_block, rows, off, labels, dense, pv_idx, pv_ro, pv_w
-    ):
-        rp_arrays = {"rows": rows, "off": off, "labels": labels}
-        if has_dense:
-            rp_arrays["dense"] = dense
-
+    def superstep_local(state, pos_block, arrs, pv_idx, pv_ro, pv_w):
         def body(st, pos):
             batch = build_mesh_device_batch(
-                rp_arrays, cfg, pv_idx[pos, 0], L_pad, K, ns, cap
+                arrs, cfg, pv_idx[pos, 0], L_pad, K, ns, cap
             )
             batch = {k: v[None] for k, v in batch.items()}
             batch["ins_weight"] = pv_w[pos]  # [1, b] local block
@@ -376,7 +397,7 @@ def make_resident_pv_mesh_superstep(
             in_specs=(
                 state_specs,
                 rep,  # batch positions: replicated
-                rep, rep, rep, rep,  # resident flat arrays: replicated
+                {k: P() for k in rp_arrays},  # resident arrays replicated
                 P(None, ax, None),  # pv_idx [n_b, n_dev, b]
                 P(None, ax, None, None),  # pv_ro
                 P(None, ax, None),  # pv_w
@@ -384,11 +405,7 @@ def make_resident_pv_mesh_superstep(
             out_specs=(state_specs, metric_specs),
             check_vma=False,
         )
-        dense = rp.dense if has_dense else jnp.zeros((1, 1), jnp.float32)
-        return mapped(
-            state, pos_block, rp.rows, rp.off, rp.labels, dense,
-            feed.idx, feed.ro, feed.w,
-        )
+        return mapped(state, pos_block, rp_arrays, feed.idx, feed.ro, feed.w)
 
     return _jax.jit(superstep, donate_argnums=(0,))
 
@@ -448,11 +465,10 @@ def build_mesh_device_batch(
     static-shape XLA ops (sort groups rows by owner shard for free since
     global row ids are shard-major: row = shard*cap + rank)."""
     S, b = cfg.num_slots, cfg.batch_size
-    rows_res, off_res, labels_res = (
-        rp_arrays["rows"], rp_arrays["off"], rp_arrays["labels"],
-    )
+    rows_res, labels_res = rp_arrays["rows"], rp_arrays["labels"]
+    off_b = _batch_offsets(rp_arrays, idx_dev)
     rows_flat, segments, valid = _ragged_rows(
-        rows_res, off_res, idx_dev, S, b, L_pad, jnp.int32(ns * cap)
+        rows_res, off_b, S, b, L_pad, jnp.int32(ns * cap)
     )
 
     # route: sort by global row id (== by owner shard), first-occurrence
@@ -531,16 +547,12 @@ def make_resident_mesh_superstep(
     ns, cap = rp.ws.n_mesh_shards, rp.ws.capacity
     L_pad, K = rp.L_pad, rp.K_pad
 
-    has_dense = rp.dense is not None
+    rp_arrays = _resident_arrays(rp)
 
-    def superstep_local(state, idx_block, rows, off, labels, dense):
-        rp_arrays = {"rows": rows, "off": off, "labels": labels}
-        if has_dense:
-            rp_arrays["dense"] = dense
-
+    def superstep_local(state, idx_block, arrs):
         def body(st, idx):  # idx [1, b] (this device's slice)
             batch = build_mesh_device_batch(
-                rp_arrays, cfg, idx[0], L_pad, K, ns, cap
+                arrs, cfg, idx[0], L_pad, K, ns, cap
             )
             batch = {k: v[None] for k, v in batch.items()}
             return local_step(st, batch)
@@ -556,7 +568,6 @@ def make_resident_mesh_superstep(
     metric_specs = {
         k: (P(None, *s) if s else P()) for k, s in per_step.items()
     }
-    rep = P()
 
     def superstep(state, idx_block):
         mapped = _jax.shard_map(
@@ -565,12 +576,26 @@ def make_resident_mesh_superstep(
             in_specs=(
                 state_specs,
                 P(None, plan.axis),  # scan axis whole, device axis split
-                rep, rep, rep, rep,
+                {k: P() for k in rp_arrays},  # resident arrays replicated
             ),
             out_specs=(state_specs, metric_specs),
             check_vma=False,
         )
-        dense = rp.dense if has_dense else jnp.zeros((1, 1), jnp.float32)
-        return mapped(state, idx_block, rp.rows, rp.off, rp.labels, dense)
+        return mapped(state, idx_block, rp_arrays)
 
     return _jax.jit(superstep, donate_argnums=(0,))
+
+
+def _resident_arrays(rp: ResidentPass) -> Dict[str, jnp.ndarray]:
+    """The resident arrays a mesh superstep threads through shard_map —
+    only the representation that was actually uploaded (off matrix, or
+    base+counts), plus optional dense features."""
+    arrs = {"rows": rp.rows, "labels": rp.labels}
+    if rp.off is not None:
+        arrs["off"] = rp.off
+    else:
+        arrs["base"] = rp.base
+        arrs["counts"] = rp.counts
+    if rp.dense is not None:
+        arrs["dense"] = rp.dense
+    return arrs
