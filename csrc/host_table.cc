@@ -74,6 +74,12 @@ struct Shard {
   std::string spill_path;
   int64_t n_disk = 0;
   int64_t n_disk_touched = 0;
+  // records in the spill file no longer referenced by any hash entry
+  // (promotes and lazy shrinks leave their bytes behind — the file is
+  // append-only between compactions). When dead outnumber live, the
+  // shard's file is rewritten (compact_spill) so a many-pass run's spill
+  // stays bounded by its LIVE cold set, not its history.
+  int64_t dead_disk = 0;
 
   std::mutex mtx;
 
@@ -208,6 +214,7 @@ int64_t promote(Table* t, Shard* s, uint64_t j, bool seek_end = true) {
     buf[t->clk_col] *= d;
   }
   s->n_disk--;
+  s->dead_disk++;  // the on-disk bytes at `off` are now garbage
   if (rec.touched) s->n_disk_touched--;
   if (missed > 0 && buf[t->show_col] < t->last_threshold) {
     // lazily shrunk: delete the entry entirely
@@ -279,6 +286,54 @@ int for_shards(const Table* t, const uint64_t* keys, int64_t n, Fn fn) {
   for (int w = 0; w < (int)rc.size(); ++w)
     if (rc[w] != 0) return rc[w];
   return 0;
+}
+
+// Rewrite one shard's spill file with only the LIVE records (hash entries
+// in kDisk state). Caller holds the shard lock. Failure-safe: hash offsets
+// are staged in a side vector and applied only after the tmp file is fully
+// flushed and renamed over the old one — any IO error (short read, ENOSPC
+// at write or flush time, failed rename) leaves the shard exactly as it
+// was, old file and offsets intact. Live records are read in OFFSET order
+// (sequential IO, same trick as the batched-promote path). Returns live
+// records kept, or negative on IO error.
+int64_t compact_spill(Table* t, Shard* s) {
+  if (!s->spill) return 0;
+  std::vector<std::pair<int64_t, uint64_t>> live;  // (old offset, hash slot)
+  for (uint64_t j = 0; j <= s->mask && s->mask; ++j)
+    if (s->hstate[j] == kDisk) live.push_back({s->hval[j], j});
+  std::sort(live.begin(), live.end());
+  std::string tmp = s->spill_path + ".tmp";
+  FILE* nf = fopen(tmp.c_str(), "w+b");
+  if (!nf) return -2;
+  std::vector<float> buf(t->width);
+  std::vector<int64_t> new_off(live.size());
+  auto fail = [&]() {
+    fclose(nf);
+    remove(tmp.c_str());
+    fseeko(s->spill, 0, SEEK_END);
+    return (int64_t)-2;
+  };
+  for (size_t i = 0; i < live.size(); ++i) {
+    SpillRec rec;
+    fseeko(s->spill, live[i].first, SEEK_SET);
+    if (fread(&rec, sizeof(rec), 1, s->spill) != 1 ||
+        fread(buf.data(), sizeof(float), t->width, s->spill) !=
+            (size_t)t->width)
+      return fail();
+    new_off[i] = ftello(nf);
+    if (fwrite(&rec, sizeof(rec), 1, nf) != 1 ||
+        fwrite(buf.data(), sizeof(float), t->width, nf) != (size_t)t->width)
+      return fail();
+  }
+  if (fflush(nf) != 0) return fail();
+  if (rename(tmp.c_str(), s->spill_path.c_str()) != 0) return fail();
+  fclose(s->spill);
+  s->spill = nf;  // nf refers to the renamed (now canonical) file on POSIX
+  fseeko(s->spill, 0, SEEK_END);
+  for (size_t i = 0; i < live.size(); ++i)
+    s->hval[live[i].second] = new_off[i];
+  s->dead_disk = 0;
+  return (int64_t)live.size();
 }
 
 }  // namespace
@@ -436,6 +491,7 @@ int pbx_table_push(void* h, const uint64_t* keys, const float* rows,
         fseeko(s->spill, 0, SEEK_END);
         if (rec.touched) s->n_disk_touched--;
         s->n_disk--;
+        s->dead_disk++;  // the superseded on-disk record is garbage now
         row = shard_new_row(t, s, key);
         s->hval[j] = row;
         s->hstate[j] = kMem;
@@ -596,8 +652,58 @@ int64_t pbx_table_spill_cold(void* h, int64_t max_mem_rows) {
     s->n_rows = keep;
     need -= victims.size();
     spilled_total += victims.size();
+    // opportunistic space reclaim: once dead records outnumber live ones
+    // the file is mostly garbage — rewrite it now, while we already hold
+    // the shard lock at a pass boundary
+    if (s->dead_disk > s->n_disk && s->dead_disk >= 1024) {
+      if (compact_spill(t, s) < 0) return -2;
+    }
   }
   return spilled_total;
+}
+
+// Force-compact every shard's spill file that holds any dead records.
+// Returns live records kept across all shards, or negative on IO error.
+int64_t pbx_table_compact_spill(void* h) {
+  Table* t = (Table*)h;
+  if (t->spill_dir.empty()) return -1;
+  int64_t live = 0;
+  for (int si = 0; si < t->n_shards; ++si) {
+    Shard* s = &t->shards[si];
+    std::lock_guard<std::mutex> g(s->mtx);
+    if (!s->spill || s->dead_disk == 0) {
+      live += s->n_disk;
+      continue;
+    }
+    int64_t r = compact_spill(t, s);
+    if (r < 0) return r;
+    live += r;
+  }
+  return live;
+}
+
+// Spill-tier occupancy: live records, dead (reclaimable) records, and the
+// total on-disk bytes across shard files.
+void pbx_table_spill_stats(void* h, int64_t* live, int64_t* dead,
+                           int64_t* bytes) {
+  Table* t = (Table*)h;
+  int64_t l = 0, d = 0, b = 0;
+  for (int si = 0; si < t->n_shards; ++si) {
+    Shard* s = &t->shards[si];
+    std::lock_guard<std::mutex> g(s->mtx);
+    l += s->n_disk;
+    d += s->dead_disk;
+    if (s->spill) {
+      fflush(s->spill);
+      off_t cur = ftello(s->spill);
+      fseeko(s->spill, 0, SEEK_END);
+      b += (int64_t)ftello(s->spill);
+      fseeko(s->spill, cur, SEEK_SET);
+    }
+  }
+  *live = l;
+  *dead = d;
+  *bytes = b;
 }
 
 // Export only the SHOW column of one shard (cache-threshold scans): at

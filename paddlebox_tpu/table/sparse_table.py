@@ -190,6 +190,22 @@ class HostSparseTable:
             return 0
         return self._native.spill_cold(self.mem_cap_rows)
 
+    def compact_spill(self) -> int:
+        """Reclaim dead spill-file space (records superseded by promotes).
+
+        spill_cold compacts a shard automatically once dead records
+        outnumber live ones; this forces it everywhere — call at day
+        boundaries. Returns live records kept."""
+        if self._native is None:
+            return 0
+        return self._native.compact_spill()
+
+    def spill_stats(self) -> tuple:
+        """(live_records, dead_records, file_bytes) of the disk tier."""
+        if self._native is None:
+            return (0, 0, 0)
+        return self._native.spill_stats()
+
     def __len__(self) -> int:
         if self._native is not None:
             return len(self._native)

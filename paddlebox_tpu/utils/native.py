@@ -144,6 +144,12 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.pbx_table_spill_cold.restype = ctypes.c_int64
         lib.pbx_table_spill_cold.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pbx_table_compact_spill.restype = ctypes.c_int64
+        lib.pbx_table_compact_spill.argtypes = [ctypes.c_void_p]
+        lib.pbx_table_spill_stats.restype = None
+        lib.pbx_table_spill_stats.argtypes = [
+            ctypes.c_void_p, _i64p, _i64p, _i64p,
+        ]
         lib.pbx_table_clear_touched.restype = None
         lib.pbx_table_clear_touched.argtypes = [ctypes.c_void_p]
         lib.pbx_table_shard_shows.restype = ctypes.c_int64
@@ -322,6 +328,25 @@ class NativeHostStore:
 
     def decay_and_shrink(self, decay: float, threshold: float) -> int:
         return int(self._lib.pbx_table_decay_shrink(self._h, decay, threshold))
+
+    def compact_spill(self) -> int:
+        """Rewrite shard spill files keeping only live records; returns the
+        live count, or raises on IO error. (spill_cold also compacts a
+        shard opportunistically once dead records outnumber live.)"""
+        n = int(self._lib.pbx_table_compact_spill(self._h))
+        if n < -1:
+            raise IOError(f"spill compaction failed rc={n}")
+        return max(n, 0)
+
+    def spill_stats(self) -> tuple:
+        """(live_records, dead_records, file_bytes) of the disk tier."""
+        live = ctypes.c_int64()
+        dead = ctypes.c_int64()
+        nbytes = ctypes.c_int64()
+        self._lib.pbx_table_spill_stats(
+            self._h, ctypes.byref(live), ctypes.byref(dead), ctypes.byref(nbytes)
+        )
+        return int(live.value), int(dead.value), int(nbytes.value)
 
     def spill_cold(self, max_mem_rows: int) -> int:
         n = int(self._lib.pbx_table_spill_cold(self._h, max_mem_rows))
