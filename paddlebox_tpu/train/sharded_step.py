@@ -114,12 +114,13 @@ def make_local_mesh_step(
             f"cfg.axis_name {cfg.axis_name!r} != mesh axis {plan.axis!r}; the "
             "sharded step always runs its collectives over the plan's axis"
         )
-    if cfg.dense_sync_mode == "async":
-        raise NotImplementedError(
-            "dense_sync_mode='async' (host AsyncDenseTable) is a "
-            "single-device worker mode; on a mesh use 'step' or 'kstep'"
-        )
+    is_async = cfg.dense_sync_mode == "async"
     is_zero = isinstance(dense_opt, Zero1Optimizer)
+    if is_async and is_zero:
+        raise ValueError(
+            "dense_sync_mode='async' hands the dense optimizer to the host "
+            "AsyncDenseTable — ZeRO state sharding has nothing to shard"
+        )
     if is_zero and cfg.dense_sync_mode == "kstep":
         raise ValueError(
             "ZeRO state sharding needs identical (replicated) grads each "
@@ -272,16 +273,24 @@ def make_local_mesh_step(
         else:
             gparams = jax.lax.pmean(gparams, ax)
             loss = jax.lax.pmean(loss, ax)
-        if is_zero:
+        if is_async:
+            # the host AsyncDenseTable owns the dense optimizer
+            # (boxps_worker.cc:35-237 runs the same split under the full
+            # multi-GPU trainer): the device never updates dense params —
+            # the globally-reduced grads ride back in metrics and the
+            # trainer's worker loop pushes them / pulls fresh params
+            new_params, new_opt_state = state.params, state.opt_state
+        elif is_zero:
             # each device updates its 1/n chunk, all_gather rebuilds the
             # full update (sharding meta-optimizer parity)
             updates, new_opt_state = dense_opt.update_local(
                 gparams, opt_state, params
             )
             new_opt_state = jax.tree.map(lambda x: x[None], new_opt_state)
+            new_params = optax.apply_updates(params, updates)
         else:
             updates, new_opt_state = dense_opt.update(gparams, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+            new_params = optax.apply_updates(params, updates)
         if kstep:
             # average params across the mesh every K steps (SyncParam scale
             # 1/(dev*node) parity) — the step counter is replicated, so the
@@ -329,6 +338,8 @@ def make_local_mesh_step(
         }
         if finite is not None:
             metrics["nan_skipped"] = (~finite).astype(jnp.int32)
+        if is_async:
+            metrics["gparams"] = gparams  # globally reduced, replicated
         new_state = TrainState(
             table=new_table[None],
             params=new_params,
@@ -360,6 +371,9 @@ def mesh_metric_specs(cfg: TrainStepConfig, plan: MeshPlan, eval_mode: bool) -> 
     metric_specs = {"loss": rep, "step": rep, "preds": dp, "labels": dp}
     if cfg.check_nan and not eval_mode:
         metric_specs["nan_skipped"] = rep  # psum'd -> uniform
+    if cfg.dense_sync_mode == "async" and not eval_mode:
+        # a pytree rides under one replicated spec (pytree-prefix rule)
+        metric_specs["gparams"] = rep
     return metric_specs
 
 

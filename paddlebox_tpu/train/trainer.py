@@ -30,7 +30,12 @@ from paddlebox_tpu.data.device_pack import BatchPacker, pack_batch, pack_batch_s
 from paddlebox_tpu.data.pipeline import prefetch
 from paddlebox_tpu.metrics.auc import auc_compute, auc_init
 from paddlebox_tpu.metrics.registry import MetricRegistry
-from paddlebox_tpu.parallel.mesh import MeshPlan, local_slice, put_sharded
+from paddlebox_tpu.parallel.mesh import (
+    MeshPlan,
+    local_slice,
+    put_replicated,
+    put_sharded,
+)
 from paddlebox_tpu.train.sharded_step import (
     init_sharded_train_state,
     kstep_sync_params,
@@ -96,10 +101,13 @@ class CTRTrainer:
                     "dense_sync_mode='async' needs an AsyncDenseTable (else "
                     "dense params would silently never update)"
                 )
-            if plan is not None:
+            if plan is not None and jax.process_count() > 1:
+                # each process would push globally-reduced grads into its
+                # own host table: consistent only under bit-identical update
+                # rules AND lossless comms — not a guarantee worth making
                 raise NotImplementedError(
-                    "async dense mode is single-device; use 'step'/'kstep' "
-                    "on a mesh"
+                    "async dense mode spans one process (single-device or "
+                    "single-host mesh); multi-host meshes use 'step'/'kstep'"
                 )
         self.dense_slot = dense_slot
         self.dense_dim = dense_dim
@@ -619,9 +627,12 @@ class CTRTrainer:
             finally:
                 t_feed.pause()  # idempotent
             if is_async:  # PullDense / PushDense worker loop (B6)
-                holder["state"] = holder["state"]._replace(
-                    params=jax.device_put(self.async_dense.pull_dense())
-                )
+                fresh = self.async_dense.pull_dense()
+                if self.plan is not None:
+                    fresh = put_replicated(self.plan, fresh)
+                else:
+                    fresh = jax.device_put(fresh)
+                holder["state"] = holder["state"]._replace(params=fresh)
             t_disp.start()
             with PROFILER.record_event("train_step_dispatch", "pass"):
                 holder["state"], m = step_fn(holder["state"], feed)
