@@ -114,6 +114,48 @@ def put_sharded(plan: MeshPlan, x: Any) -> jax.Array:
     return jax.tree.map(place, x)
 
 
+def put_per_device_copies(plan: MeshPlan, arr: np.ndarray) -> jax.Array:
+    """THIS process's host array, copied onto each of its local devices, as
+    a global ``[n_devices, *arr.shape]`` array sharded on the device axis.
+
+    The multi-host resident feed's placement: each host's pass arrays
+    (row stream, counts, labels) differ, so they cannot be replicated —
+    instead every device carries its own host's copy and shard_map hands
+    each device a ``[1, ...]`` block. All processes must pass arrays of
+    the SAME (padded/locksteped) shape."""
+    arr = np.ascontiguousarray(arr)
+    sh = NamedSharding(plan.mesh, P(plan.axis, *([None] * arr.ndim)))
+    pid = jax.process_index()
+    local = [d for d in plan.mesh.devices.flat if d.process_index == pid]
+    shards = [jax.device_put(arr[None], d) for d in local]
+    return jax.make_array_from_single_device_arrays(
+        (plan.n_devices,) + arr.shape, sh, shards
+    )
+
+
+def put_axis1_blocks(plan: MeshPlan, local: np.ndarray) -> jax.Array:
+    """Local ``[K, n_local_dev, ...]`` blocks -> global ``[K, n_dev, ...]``
+    sharded on axis 1 (the resident feed's per-chunk index blocks: the
+    scan axis stays whole, devices split)."""
+    sh = NamedSharding(
+        plan.mesh, P(None, plan.axis, *([None] * (local.ndim - 2)))
+    )
+    if jax.process_count() == 1:
+        return jax.device_put(local, sh)
+    n = plan.n_devices
+    per = n // jax.process_count()
+    if local.shape[1] != per:
+        raise ValueError(
+            f"put_axis1_blocks: axis-1 dim {local.shape[1]} != this host's "
+            f"local device count {per}"
+        )
+    return jax.make_array_from_process_local_data(
+        sh,
+        np.ascontiguousarray(local),
+        (local.shape[0], n) + local.shape[2:],
+    )
+
+
 def put_replicated(plan: MeshPlan, tree: Any) -> Any:
     """Replicate a pytree (dense params, opt state) on every device.
 

@@ -70,6 +70,8 @@ class ResidentPass:
         dense_dim: int = 0,
         label_slot: Optional[str] = None,
         bucket: Optional[int] = None,
+        plan=None,  # MeshPlan; needed only multi-host
+        transport=None,  # host plane; multi-host placement + lockstep
     ):
         self.store = store
         self.ws = ws
@@ -82,7 +84,39 @@ class ResidentPass:
             raise ValueError("pass too large for resident feed (>=2^31 keys)")
         self._host_rows = rows
         self._key_counts = store.key_counts()
-        self.rows = jnp.asarray(rows.astype(np.int32))
+        self.transport = transport
+        # multi-host: every host holds a DIFFERENT pass (its local records),
+        # so the resident arrays can't replicate — each device carries its
+        # own host's copy ([n_dev, ...] device-axis sharded, sizes
+        # allreduce-max-padded so every host builds the same global shape)
+        self.per_device = (
+            plan is not None
+            and transport is not None
+            and transport.n_ranks > 1
+        )
+
+        def _pad(a, n, fill=0):
+            if a.shape[0] == n:
+                return a
+            out = np.full((n,) + a.shape[1:], fill, dtype=a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        if self.per_device:
+            self._seq = 0
+            L_max = transport.allreduce_max(len(rows), "res-L-size")
+            N_max = transport.allreduce_max(len(store), "res-N-size")
+        else:
+            L_max, N_max = len(rows), len(store)
+
+        def place(a):
+            if self.per_device:
+                from paddlebox_tpu.parallel.mesh import put_per_device_copies
+
+                return put_per_device_copies(plan, a)
+            return jnp.asarray(a)
+
+        self.rows = place(_pad(rows.astype(np.int32), L_max))
         # per-(record, slot) offsets into the flat row stream. Wire-compact
         # form: per-slot COUNTS fit uint8 (CTR slots hold a handful of
         # feasigns), so the upload ships [N, S] bytes + an [N] int32 base
@@ -91,26 +125,35 @@ class ResidentPass:
         # device as a per-batch cumsum (batch_offsets). Falls back to the
         # full matrix when any slot exceeds 255 keys.
         slot_counts = np.diff(store.u64_offsets.astype(np.int64), axis=1)
-        if slot_counts.size and slot_counts.max() <= 255:
-            self.base = jnp.asarray(store.u64_base.astype(np.int32))
-            self.counts = jnp.asarray(slot_counts.astype(np.uint8))
+        compact = slot_counts.size and slot_counts.max() <= 255
+        if self.per_device:
+            # lockstep the representation: one host falling back to the
+            # offset matrix while another compresses would desync shapes
+            compact = transport.allreduce_max(0 if compact else 1, "res-rep") == 0
+        if compact:
+            self.base = place(_pad(store.u64_base.astype(np.int32), N_max))
+            self.counts = place(_pad(slot_counts.astype(np.uint8), N_max))
             self.off = None
         else:
             off = store.u64_base[:, None] + store.u64_offsets.astype(np.int64)
             self.base = None
             self.counts = None
-            self.off = jnp.asarray(off.astype(np.int32))  # [N, S+1]
+            self.off = place(_pad(off.astype(np.int32), N_max))  # [N, S+1]
         label_name = label_slot or schema.label_slot
         if label_name is not None:
             li = schema.float_slot_index(label_name)
             labels = store.float_slot_matrix(li, 1)[:, 0]
         else:
             labels = np.zeros(len(store), np.float32)
-        self.labels = jnp.asarray(labels.astype(np.float32))
+        self.labels = place(_pad(labels.astype(np.float32), N_max))
         self.dense = None
         if dense_slot is not None and dense_dim:
             di = schema.float_slot_index(dense_slot)
-            self.dense = jnp.asarray(store.float_slot_matrix(di, dense_dim))
+            self.dense = place(
+                _pad(
+                    np.asarray(store.float_slot_matrix(di, dense_dim)), N_max
+                )
+            )
         self.L_pad = 0
         self.U_pad = 0
         self.K_pad = 0  # mesh tier: per-(device, shard) request bucket
@@ -416,7 +459,10 @@ def make_resident_pv_mesh_superstep(
 def ensure_sharded(rp: ResidentPass, batch_indices, n_devices: int) -> None:
     """Freeze/grow the mesh pads: per-DEVICE L_pad and the per-(device,
     shard) request bucket K_pad (exact scan, cached per index block — the
-    resident analog of BatchPacker.freeze_shapes' lockstep branch)."""
+    resident analog of BatchPacker.freeze_shapes' lockstep branch).
+    ``n_devices`` is the count THIS process packs for (local on a
+    multi-host mesh); with a multi-rank transport on the ResidentPass the
+    pads are allreduce-max'd so every host compiles the same program."""
     cap, ns = rp.ws.capacity, rp.ws.n_mesh_shards
     max_L, max_bucket = 1, 0
     for idx in batch_indices:
@@ -447,8 +493,18 @@ def ensure_sharded(rp: ResidentPass, batch_indices, n_devices: int) -> None:
                 cached = rp._mesh_cache[fp] = (L, bmax)
             max_L = max(max_L, cached[0])
             max_bucket = max(max_bucket, cached[1])
-    rp.L_pad = max(rp.L_pad, _round_bucket(max_L, rp.bucket))
-    rp.K_pad = max(rp.K_pad, _round_bucket(max_bucket + 1, rp.bucket))
+    L = _round_bucket(max_L, rp.bucket)
+    K = _round_bucket(max_bucket + 1, rp.bucket)
+    tp = rp.transport
+    if tp is not None and tp.n_ranks > 1:
+        # lockstep: every host enters these collectives the same number of
+        # times (the stepper/prepare call sequence is uniform), tagged by a
+        # per-ResidentPass counter
+        rp._seq += 1
+        L = tp.allreduce_max(L, f"res-L:{rp._seq}")
+        K = tp.allreduce_max(K, f"res-K:{rp._seq}")
+    rp.L_pad = max(rp.L_pad, L)
+    rp.K_pad = max(rp.K_pad, K)
 
 
 def build_mesh_device_batch(
@@ -538,18 +594,22 @@ def make_resident_mesh_superstep(
         mesh_state_specs,
     )
 
-    if _jax.process_count() > 1:
-        raise NotImplementedError(
-            "resident mesh feed is single-host (replicated resident arrays); "
-            "multi-host meshes use the transport-locksteped host packer"
+    if _jax.process_count() > 1 and not rp.per_device:
+        raise RuntimeError(
+            "multi-host resident feed needs per-device pass arrays — build "
+            "the ResidentPass with plan= and a multi-rank transport="
         )
     local_step = make_local_mesh_step(model_apply, dense_opt, cfg, plan, eval_mode)
     ns, cap = rp.ws.n_mesh_shards, rp.ws.capacity
     L_pad, K = rp.L_pad, rp.K_pad
 
     rp_arrays = _resident_arrays(rp)
+    per_device = rp.per_device
 
     def superstep_local(state, idx_block, arrs):
+        if per_device:  # each device carries its host's copy: strip [1,...]
+            arrs = {k: v[0] for k, v in arrs.items()}
+
         def body(st, idx):  # idx [1, b] (this device's slice)
             batch = build_mesh_device_batch(
                 arrs, cfg, idx[0], L_pad, K, ns, cap
@@ -569,21 +629,32 @@ def make_resident_mesh_superstep(
         k: (P(None, *s) if s else P()) for k, s in per_step.items()
     }
 
-    def superstep(state, idx_block):
+    arr_specs = {
+        k: (P(plan.axis) if per_device else P()) for k in rp_arrays
+    }
+
+    def superstep(state, idx_block, arrs):
         mapped = _jax.shard_map(
             superstep_local,
             mesh=plan.mesh,
             in_specs=(
                 state_specs,
                 P(None, plan.axis),  # scan axis whole, device axis split
-                {k: P() for k in rp_arrays},  # resident arrays replicated
+                arr_specs,  # replicated, or per-device host copies
             ),
             out_specs=(state_specs, metric_specs),
             check_vma=False,
         )
-        return mapped(state, idx_block, rp_arrays)
+        return mapped(state, idx_block, arrs)
 
-    return _jax.jit(superstep, donate_argnums=(0,))
+    jitted = _jax.jit(superstep, donate_argnums=(0,))
+
+    def call(state, idx_block):
+        # multi-host arrays span non-addressable devices: they must enter
+        # the jit as ARGUMENTS, not closure constants
+        return jitted(state, idx_block, rp_arrays)
+
+    return call
 
 
 def _resident_arrays(rp: ResidentPass) -> Dict[str, jnp.ndarray]:

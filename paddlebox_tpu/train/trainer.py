@@ -672,6 +672,8 @@ class CTRTrainer:
             dense_slot=self.dense_slot,
             dense_dim=self.dense_dim,
             bucket=self.pack_bucket,
+            plan=self.plan,
+            transport=dataset.transport,
         )
         self._resident_cache = (dataset.store, dataset.ws, rp)
         return rp
@@ -692,7 +694,7 @@ class CTRTrainer:
         if self.plan is None:
             rp.ensure(plan.idx)
         else:
-            ensure_sharded(rp, plan.idx, self.plan.n_devices)
+            ensure_sharded(rp, plan.idx, self._n_pack_devices)
         c = getattr(self, "_pv_feed_cache", None)
         if c is None or c[0] is not plan or c[1] is not rp:
             feed = ResidentPvFeed(plan, mesh_plan=self.plan)
@@ -778,7 +780,7 @@ class CTRTrainer:
                 else:
                     from paddlebox_tpu.train.resident_step import ensure_sharded
 
-                    ensure_sharded(rp, blocks, self.plan.n_devices)
+                    ensure_sharded(rp, blocks, self._n_pack_devices)
                 sstep = self._resident_superstep(rp, eval_mode)
         t_feed.pause()
         # profiling wants per-batch device attribution: drop to one batch
@@ -814,17 +816,18 @@ class CTRTrainer:
                     # the batches live on device already — feed POSITIONS
                     idx_dev = jnp.arange(c0, c0 + len(chunk), dtype=jnp.int32)
                 elif self.plan is not None:
-                    # [K, B_global] -> [K, n_dev, b]: record r -> device
+                    # [K, B_local] -> [K, n_local, b]: record r -> device
                     # r // b, the same ins // b mapping the sharded packer
-                    # uses; the scan axis stays whole, devices split
-                    from jax.sharding import NamedSharding
-                    from jax.sharding import PartitionSpec as P
+                    # uses; the scan axis stays whole, devices split (on a
+                    # multi-host mesh each process contributes its local
+                    # devices' blocks of LOCAL store indices)
+                    from paddlebox_tpu.parallel.mesh import put_axis1_blocks
 
-                    idx_dev = jax.device_put(
+                    idx_dev = put_axis1_blocks(
+                        self.plan,
                         np.stack(chunk).reshape(
-                            len(chunk), self.plan.n_devices, -1
+                            len(chunk), self._n_pack_devices, -1
                         ),
-                        NamedSharding(self.plan.mesh, P(None, self.plan.axis)),
                     )
                 else:
                     idx_dev = jnp.asarray(np.stack(chunk))
@@ -865,20 +868,34 @@ class CTRTrainer:
         train_pass and prepare_pass so the warm-start hook can never
         pre-freeze a different feed path than training will take.
 
-        Covers the single-device step and SINGLE-HOST meshes (resident
-        arrays replicate across local devices); multi-host meshes keep the
-        transport-locksteped host packer. Join phases (use_pv) ride the
-        resident tier too, via the pass-deterministic PvPlan — the feed
+        Covers the single-device step, single-host meshes (resident arrays
+        replicate across local devices), and — for the FLAT tier —
+        multi-host meshes (each device carries its host's pass arrays,
+        pads transport-locksteped). Join phases (use_pv) ride the resident
+        tier single-process, via the pass-deterministic PvPlan — the feed
         becomes batch POSITIONS into resident idx/rank_offset/ins_weight
-        stacks; a model that takes rank_offset is only excluded from the
-        FLAT tier (no rank matrix exists there to feed it)."""
+        stacks; multi-host join phases keep the plan-driven host packer.
+        A model that takes rank_offset is only excluded from the FLAT tier
+        (no rank matrix exists there to feed it)."""
+        multi_host = self.plan is not None and jax.process_count() > 1
         ok = (
             bool(config.get_flag("enable_resident_feed"))
-            and (self.plan is None or jax.process_count() == 1)
             and not is_async
             and dataset.store is not None
             and len(dataset.store.u64_values) < (1 << 31)
+            and not (multi_host and dataset.transport is None)
         )
+        if multi_host and dataset.transport is not None:
+            # the per-host inputs (store size, store presence) can differ —
+            # a split decision would send the hosts into DIFFERENT lockstep
+            # collectives (packer freeze vs resident allreduces) and
+            # deadlock. All hosts take the resident tier only unanimously.
+            # Calls are uniform across hosts (prepare/train/eval sequence),
+            # so the FIFO tag needs no per-call uniqueifier.
+            ok = (
+                dataset.transport.allreduce_max(0 if ok else 1, "res-gate")
+                == 0
+            )
         if not ok:
             # cheap gates first: a multi-host join phase must NOT build the
             # min_batches=0 plan here (its _pv_feed_iter needs the
@@ -886,6 +903,8 @@ class CTRTrainer:
             # one would be a wasted full pack sweep)
             return False
         if use_pv:
+            if multi_host:
+                return False
             # the plan (and with it every record's store index) must exist;
             # building it here is free for train_pass, which needs it next
             return (
@@ -926,7 +945,7 @@ class CTRTrainer:
             else:
                 from paddlebox_tpu.train.resident_step import ensure_sharded
 
-                ensure_sharded(rp, blocks, self.plan.n_devices)
+                ensure_sharded(rp, blocks, self._n_pack_devices)
         else:
             self._get_packer(dataset).freeze_shapes(
                 dataset.batch_indices(n_batches),

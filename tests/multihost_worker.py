@@ -134,6 +134,11 @@ def main():
         batches_run=np.array([out["batches"]]),
         auc=np.array([out["auc"]]),
         loss=np.array([out["loss"]]),
+        # which feed tier actually ran (the resident cache only builds when
+        # the resident path executes)
+        used_resident=np.array(
+            [int(getattr(trainer, "_resident_cache", None) is not None)]
+        ),
     )
     if conf["parse_ins_id"]:
         ins = sorted(r.ins_id for r in ds.records)

@@ -65,7 +65,10 @@ def _write_files(tmp_path, sizes, with_ins_id=False):
     return files
 
 
-def _run_cluster(tmp_path, mode, files, local_batch, parse_ins_id, round_to=32):
+def _run_cluster(
+    tmp_path, mode, files, local_batch, parse_ins_id, round_to=32,
+    extra_env=None,
+):
     coord, tp0, tp1 = _free_ports(3)
     conf = dict(
         coord_port=coord,
@@ -80,6 +83,8 @@ def _run_cluster(tmp_path, mode, files, local_batch, parse_ins_id, round_to=32):
     with open(tmp_path / "conf.json", "w") as f:
         json.dump(conf, f)
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     env["JAX_PLATFORMS"] = "cpu"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -192,11 +197,7 @@ def _single_process_reference(files, local_batch):
     )
 
 
-def test_two_process_training_matches_single_process(tmp_path):
-    files = _write_files(tmp_path, [64, 64, 64, 64])
-    dumps = _run_cluster(tmp_path, "train", files, GLOBAL_BATCH // 2, False)
-    ref = _single_process_reference(files, GLOBAL_BATCH // 2)
-
+def _check_train_matches_reference(dumps, ref):
     # pass layout identical: capacity + every referenced key's global row
     assert dumps[0]["capacity"][0] == dumps[1]["capacity"][0] == ref["ws"].capacity
     for d in dumps:
@@ -224,6 +225,31 @@ def test_two_process_training_matches_single_process(tmp_path):
     # online AUC agrees (same batches, f32 bucket-edge tolerance)
     assert abs(dumps[0]["auc"][0] - ref["auc"]) < 5e-3
     assert abs(dumps[0]["auc"][0] - dumps[1]["auc"][0]) < 1e-9
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    """Default path — now the multi-host RESIDENT feed (per-device host
+    copies of the pass arrays, transport-locksteped pads, position feed)."""
+    files = _write_files(tmp_path, [64, 64, 64, 64])
+    dumps = _run_cluster(tmp_path, "train", files, GLOBAL_BATCH // 2, False)
+    for d in dumps:
+        assert d["used_resident"][0] == 1  # the fast tier actually ran
+    ref = _single_process_reference(files, GLOBAL_BATCH // 2)
+    _check_train_matches_reference(dumps, ref)
+
+
+def test_two_process_training_host_packed(tmp_path):
+    """The transport-locksteped host packer (resident disabled) stays
+    correct — same reference equality."""
+    files = _write_files(tmp_path, [64, 64, 64, 64])
+    dumps = _run_cluster(
+        tmp_path, "train", files, GLOBAL_BATCH // 2, False,
+        extra_env={"PBOX_ENABLE_RESIDENT_FEED": "0"},
+    )
+    for d in dumps:
+        assert d["used_resident"][0] == 0
+    ref = _single_process_reference(files, GLOBAL_BATCH // 2)
+    _check_train_matches_reference(dumps, ref)
 
 
 def test_global_shuffle_and_lockstep_unequal_records(tmp_path):
