@@ -75,6 +75,44 @@ def make_mesh(
     return MeshPlan(mesh=mesh, axis=axis)
 
 
+def make_mesh_2d(
+    n_pp: int,
+    n_dp: int,
+    axes: Sequence[str] = ("pp", "dp"),
+    devices: Optional[Sequence[Any]] = None,
+) -> MeshPlan:
+    """A 2-D (pipeline x data) mesh: pipeline stages along ``axes[0]``,
+    data-parallel replicas of each stage along ``axes[1]``.
+
+    The returned plan's ``axis`` is the dp axis (batch/table machinery
+    keys off it); the pipeline step takes the pp axis via its spec. The
+    reference composes pipeline sections with data parallelism the same
+    way (PipelineTrainer sections x fleet DP ranks)."""
+    if n_pp < 1 or n_dp < 1:
+        raise ValueError(f"mesh needs n_pp >= 1 and n_dp >= 1, got ({n_pp}, {n_dp})")
+    explicit = devices is not None
+    if devices is None:
+        devices = jax.devices()
+    need = n_pp * n_dp
+    if need > len(devices):
+        raise ValueError(f"asked for {need} devices, have {len(devices)}")
+    grid = None
+    if not explicit and need == len(devices):
+        # ICI-aware layout: on real hardware the ppermute hops of the pp
+        # axis should ride nearest-neighbor links, which a raw enumeration
+        # reshape does not guarantee
+        try:
+            from jax.experimental import mesh_utils
+
+            grid = mesh_utils.create_device_mesh((n_pp, n_dp), devices=devices)
+        except Exception:
+            grid = None
+    if grid is None:
+        grid = np.asarray(devices[:need]).reshape(n_pp, n_dp)
+    mesh = Mesh(grid, tuple(axes))
+    return MeshPlan(mesh=mesh, axis=axes[1])
+
+
 def put_sharded(plan: MeshPlan, x: Any) -> jax.Array:
     """Host array -> device array sharded on axis 0 over the mesh.
 

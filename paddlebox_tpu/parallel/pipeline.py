@@ -105,6 +105,7 @@ def make_pipeline_train_step(
     dense_opt: optax.GradientTransformation,
     spec: PipelineSpec,
     plan: MeshPlan,
+    dp_axis: Optional[str] = None,
 ) -> Callable:
     """Jitted ``step((params, opt_state), x_micro, targets) ->
     ((params, opt_state), loss)``.
@@ -112,12 +113,23 @@ def make_pipeline_train_step(
     ``params``/``opt_state`` are stacked [n_stages, ...] pytrees sharded over
     the pp axis; ``x_micro`` [n_micro, mb, H] and ``targets`` [n_micro, mb, ...]
     are replicated (only stage 0 / the loss actually read them).
+
+    ``dp_axis``: pipeline x data composition on a 2-D mesh
+    (``make_mesh_2d``) — each pipeline replica trains its dp-shard of every
+    microbatch (x_micro/targets split on the mb axis over dp), and stage
+    grads pmean over dp before the local update, exactly the reference's
+    PipelineTrainer-sections x fleet-DP-ranks layering.
     """
     if spec.axis_name not in plan.mesh.axis_names:
         raise ValueError(
             f"PipelineSpec.axis_name {spec.axis_name!r} not a mesh axis "
             f"{plan.mesh.axis_names}; build the mesh with "
             f"make_mesh(n, axis={spec.axis_name!r})"
+        )
+    if dp_axis is not None and dp_axis not in plan.mesh.axis_names:
+        raise ValueError(
+            f"dp_axis {dp_axis!r} not a mesh axis {plan.mesh.axis_names}; "
+            "build a 2-D mesh with make_mesh_2d(n_pp, n_dp)"
         )
     fwd = pipeline_forward(stage_apply, spec, broadcast=False)
     ax = spec.axis_name
@@ -141,6 +153,12 @@ def make_pipeline_train_step(
 
         loss_local, grads = jax.value_and_grad(batch_loss)(p_local)
         loss = lax.psum(loss_local, ax)  # reporting only, outside the grad
+        if dp_axis is not None:
+            # data-parallel replicas of this stage average their grads
+            # (the NCCL allreduce between pipeline replicas); loss reports
+            # the dp-mean too
+            grads = jax.tree.map(lambda g: lax.pmean(g, dp_axis), grads)
+            loss = lax.pmean(loss, dp_axis)
         # grads arrive on the stage that owns each parameter (autodiff of
         # ppermute routes them); the update pass is purely local —
         # SectionWorker's kOptimize-on-microbatch-0 parity
@@ -154,6 +172,8 @@ def make_pipeline_train_step(
 
     pp = P(ax)
     rep = P()
+    # microbatches split their mb axis over dp when composed
+    data = rep if dp_axis is None else P(None, dp_axis)
 
     def step(state, x_micro, targets):
         params, opt_state = state
@@ -164,7 +184,7 @@ def make_pipeline_train_step(
         mapped = jax.shard_map(
             local_step,
             mesh=plan.mesh,
-            in_specs=(specs_state, rep, rep),
+            in_specs=(specs_state, data, data),
             out_specs=(specs_state, rep),
             check_vma=False,
         )
@@ -177,14 +197,23 @@ def init_pipeline_state(
     plan: MeshPlan,
     stage_params: Sequence[Any],  # one pytree per stage, identical structure
     dense_opt: optax.GradientTransformation,
+    axis: Optional[str] = None,
 ) -> Tuple[Any, Any]:
-    """Stack per-stage params along a leading pp-sharded axis + opt state."""
-    n = plan.n_devices
+    """Stack per-stage params along a leading pp-sharded axis + opt state.
+
+    ``axis`` names the pipeline axis; defaults to the plan's axis (the 1-D
+    pipeline mesh). On a 2-D pp x dp mesh pass the pp axis explicitly —
+    stages shard over it and replicate over dp."""
+    axis = axis or plan.axis
+    n = int(plan.mesh.shape[axis])
     if len(stage_params) != n:
-        raise ValueError(f"{len(stage_params)} stages for a {n}-device mesh")
+        raise ValueError(
+            f"{len(stage_params)} stages for a {n}-stage {axis!r} axis"
+        )
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
     opt0 = jax.vmap(dense_opt.init)(stacked)
-    put = lambda t: jax.device_put(t, plan.batch_sharding)
+    sh = plan.sharded(axis)
+    put = lambda t: jax.device_put(t, sh)
     return jax.tree.map(put, stacked), jax.tree.map(put, opt0)
 
 

@@ -50,6 +50,9 @@ def main():
                 "PBOX_RESIDENT_SCAN_BATCHES": scan_k,
                 "PBOX_MAX_INFLIGHT_STEPS": inflight,
                 "PBOX_BENCH_INIT_TIMEOUT": 120,
+                # one probe per combo: tune runs on a healthy chip; the
+                # multi-probe budget is bench.py's own wedge protocol
+                "PBOX_BENCH_INIT_RETRIES": 1,
             }
         )
         if out is None or out.get("platform") != "tpu":
@@ -59,7 +62,8 @@ def main():
         results.append((out["value"], scan_k, inflight, out))
         print(f"scan={scan_k:3d} inflight={inflight}: "
               f"{out['value']:>9.1f} sps  train={out['train_pass_s']:.2f}s "
-              f"fin={out['finalize_s']:.2f}s wb={out['writeback_s']:.2f}s")
+              f"fin={out['finalize_s']:.2f}s wb={out['writeback_s']:.2f}s "
+              f"bnd={out.get('boundary_s', float('nan')):.2f}s")
     if not results:
         print("no TPU results (backend unhealthy?)")
         sys.exit(1)
@@ -67,6 +71,50 @@ def main():
     best = results[0]
     print(f"\nbest: scan={best[1]} inflight={best[2]} -> {best[0]:.1f} sps "
           f"({best[3]['vs_baseline']}x baseline)")
+    def show(label, out):
+        if out is None or out.get("platform") != "tpu":
+            detail = (
+                "timeout"
+                if out is None
+                else out.get("tpu_error", out.get("platform"))
+            )
+            print(f"{label}: FAILED ({detail})")
+            return
+        print(f"{label}: {out['value']:>9.1f} sps  "
+              f"boundary={out.get('boundary_s', float('nan')):.2f}s "
+              f"(wb={out['writeback_s']:.2f} "
+              f"fin2={out.get('finalize2_s', float('nan')):.2f}) "
+              f"auc={out['auc']}")
+
+    # wire-format ablation at the best combo: the sweep already measured
+    # the bf16 default (bench.py's PBOX_WIRE_DTYPE default), so only fp32
+    # needs a fresh run
+    show("wire=bf16 (from sweep)", best[3])
+    show(
+        "wire=fp32",
+        run_bench(
+            {
+                "PBOX_RESIDENT_SCAN_BATCHES": best[1],
+                "PBOX_MAX_INFLIGHT_STEPS": best[2],
+                "PBOX_WIRE_DTYPE": "fp32",
+                "PBOX_BENCH_INIT_TIMEOUT": 120,
+                "PBOX_BENCH_INIT_RETRIES": 1,
+            }
+        ),
+    )
+    # carried-table ablation: classic full writeback + re-upload boundary
+    show(
+        "carried=off",
+        run_bench(
+            {
+                "PBOX_RESIDENT_SCAN_BATCHES": best[1],
+                "PBOX_MAX_INFLIGHT_STEPS": best[2],
+                "PBOX_ENABLE_CARRIED_TABLE": 0,
+                "PBOX_BENCH_INIT_TIMEOUT": 120,
+                "PBOX_BENCH_INIT_RETRIES": 1,
+            }
+        ),
+    )
 
 
 if __name__ == "__main__":
