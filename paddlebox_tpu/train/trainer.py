@@ -8,7 +8,9 @@ every device's work) and walks a BoxPSDataset pass by pass:
     trainer = CTRTrainer(model, cfg, plan=...)
     dataset.load_into_memory(); dataset.begin_pass()
     metrics = trainer.train_pass(dataset)
-    dataset.end_pass(trainer.trained_table(), need_save_delta=...)
+    # single-process: hand the DEVICE table over — the boundary then goes
+    # delta-only (table/carrier.py); multi-host uses trained_table()
+    dataset.end_pass(trainer.trained_table_device(), need_save_delta=...)
 
 Dense params/optimizer state persist across passes on device; the sparse
 working-set table is rebuilt per pass (pass-scoped HBM staging parity).

@@ -257,6 +257,94 @@ def test_eager_flush_frees_carrier(tmp_path):
         config.set_flag("carried_eager_flush", prev_e)
 
 
+def test_two_phase_passes_across_carried_boundaries(tmp_path):
+    """Round-4 features composed: consecutive TWO-PHASE passes (join on the
+    resident pv tier -> device handoff -> update on the resident flat
+    tier) across CARRIED boundaries must equal the classic-writeback run."""
+    from paddlebox_tpu.data import SlotInfo, SlotSchema
+    from tests.test_pv_phase import RankDeepFM, _logkey
+
+    def schema():
+        return SlotSchema(
+            [SlotInfo("label", type="float", dense=True, dim=1)]
+            + [SlotInfo(f"s{i}") for i in range(S)],
+            label_slot="label",
+            parse_logkey=True,
+        )
+
+    def write_pv(path, seed, lo, hi):
+        rng = np.random.default_rng(seed)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            for q in range(1, 25):
+                for r in range(1, int(rng.integers(1, 3)) + 1):
+                    keys = rng.integers(lo, hi, S)
+                    lab = 1.0 if (keys % 5 == 0).any() else 0.0
+                    f.write(
+                        " ".join(
+                            [f"1 {_logkey(q, 222, r)}", f"1 {lab}"]
+                            + [f"1 {k}" for k in keys]
+                        )
+                        + "\n"
+                    )
+        return str(path)
+
+    def run(carried):
+        prev = config.get_flag("enable_carried_table")
+        config.set_flag("enable_carried_table", 1 if carried else 0)
+        try:
+            layout = ValueLayout(embedx_dim=4)
+            table = HostSparseTable(layout, _opt(), n_shards=2, seed=0)
+            ds = BoxPSDataset(schema(), table, batch_size=B, shuffle_mode="none")
+            join_model = RankDeepFM(S, layout.pull_width, layout.embedx_dim)
+            cfg_j = TrainStepConfig(
+                num_slots=S, batch_size=B, layout=layout, sparse_opt=_opt(),
+                auc_buckets=100, model_takes_rank_offset=True,
+            )
+            tr_j = CTRTrainer(join_model, cfg_j, dense_opt=optax.adam(1e-2))
+            tr_j.init_params(jax.random.PRNGKey(0))
+            upd_model = DeepFM(
+                num_slots=S, feat_width=layout.pull_width, embedx_dim=4,
+                hidden=(8,),
+            )
+            cfg_u = TrainStepConfig(
+                num_slots=S, batch_size=B, layout=layout, sparse_opt=_opt(),
+                auc_buckets=100,
+            )
+            tr_u = CTRTrainer(upd_model, cfg_u, dense_opt=optax.adam(1e-2))
+            tr_u.init_params(jax.random.PRNGKey(1))
+            losses = []
+            for i, (lo, hi) in enumerate([(1, 150), (80, 230)]):
+                f = write_pv(tmp_path / f"c{carried}" / f"p{i}.txt", i, lo, hi)
+                ds.set_filelist([f])
+                ds.load_into_memory()
+                ds.begin_pass(round_to=8)
+                ds.set_current_phase(1)
+                ds.preprocess_instance()
+                mj = tr_j.train_pass(ds)
+                tr_j.handoff_table(ds)
+                ds.set_current_phase(0)
+                ds.postprocess_instance()
+                mu = tr_u.train_pass(ds)
+                losses += [mj["loss"], mu["loss"]]
+                ds.end_pass(
+                    tr_u.trained_table_device()
+                    if carried
+                    else tr_u.trained_table()
+                )
+            table.drain_pending()
+            keys = np.sort(table.keys())
+            return losses, keys, table.pull_or_create(keys)
+        finally:
+            config.set_flag("enable_carried_table", prev)
+
+    l_c, k_c, v_c = run(False)
+    l_d, k_d, v_d = run(True)
+    np.testing.assert_array_equal(k_d, k_c)
+    np.testing.assert_allclose(l_d, l_c, atol=1e-5)
+    np.testing.assert_allclose(v_d, v_c, atol=1e-4)
+
+
 def test_revert_after_carried_boundary(tmp_path):
     """begin_pass(enable_revert=True) drains the carrier first so the
     snapshot (and a revert) sees true pre-pass values."""

@@ -144,6 +144,86 @@ def main():
 
     timed_loop("ragged expand (cumsum+searchsorted L)", ragged, (lens, jnp.float32(0)))
 
+    if "--scatter-sweep" in sys.argv:
+        scatter_sweep(rng)
+
+
+def scatter_sweep(rng):
+    """Candidate strategies against the ~16 ms scatter-add floor at
+    U=131k/W=21 (VERDICT r4 item 5; box_wrapper.cu:31-456 PushCopy is the
+    reference's hand-written answer to the same problem). Run on a HEALTHY
+    chip; each row prints device ms/op. Interpretation notes inline."""
+    print("\n--- scatter strategy sweep (U=131k unique rows) ---")
+    rows_np = np.sort(rng.choice(ROWS, U, replace=False).astype(np.int32))
+    rows_s = jnp.asarray(rows_np)
+
+    # width variants: the known non-monotonicity (W=8 fast, W=21 slow,
+    # W=128 medium). A padded-width TABLE trades HBM for scatter speed.
+    for w in (8, 16, 21, 24, 32, 64, 128):
+        t = jnp.zeros((ROWS, w), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((U, w)).astype(np.float32))
+        timed_loop(
+            f"scatter-add uniq sorted W={w:<3d}",
+            lambda c, i: (c[0].at[rows_s].add(c[1] * 1e-6), c[1]),
+            (t, g),
+        )
+
+    # sorted + hint combos at W=21 (hints measured no-op before; re-check)
+    t21 = jnp.zeros((ROWS, W), jnp.float32)
+    g21 = jnp.asarray(rng.standard_normal((U, W)).astype(np.float32))
+    timed_loop(
+        "scatter-add W=21 hints(sorted+unique)",
+        lambda c, i: (
+            c[0].at[rows_s].add(
+                c[1] * 1e-6, indices_are_sorted=True, unique_indices=True
+            ),
+            c[1],
+        ),
+        (t21, g21),
+    )
+
+    # gather-modify-SET (unique rows): scatter with set semantics instead
+    # of add — different lowering, sometimes different cost
+    timed_loop(
+        "gather+set W=21 (set semantics)",
+        lambda c, i: (
+            c[0].at[rows_s].set(jnp.take(c[0], rows_s, axis=0) + c[1] * 1e-6),
+            c[1],
+        ),
+        (t21, g21),
+    )
+
+    # bf16 update payload into an f32 table (half the update bytes; the
+    # read-modify-write of the table itself is unchanged)
+    timed_loop(
+        "scatter-add W=21 bf16 updates",
+        lambda c, i: (
+            c[0].at[rows_s].add((c[1] * 1e-6).astype(jnp.bfloat16).astype(jnp.float32)),
+            c[1],
+        ),
+        (t21, g21),
+    )
+
+    # Pallas per-row DMA set on a lane-aligned (W=128) table: the write
+    # path the flag-gated kernel family already implements — viable only
+    # if the padded table's HBM cost is acceptable
+    try:
+        from paddlebox_tpu.ops.pallas_kernels import (
+            backend_is_tpu,
+            write_rows_pallas,
+        )
+
+        if backend_is_tpu():
+            t128 = jnp.zeros((ROWS, 128), jnp.float32)
+            g128 = jnp.asarray(rng.standard_normal((U, 128)).astype(np.float32))
+            timed_loop(
+                "pallas write_rows W=128 (set)",
+                lambda c, i: (write_rows_pallas(c[0], rows_s, c[1]), c[1]),
+                (t128, g128),
+            )
+    except Exception as e:  # pragma: no cover
+        print(f"pallas W=128 probe skipped: {e}")
+
 
 if __name__ == "__main__":
     main()
