@@ -818,7 +818,7 @@ class BoxPSDataset:
         if (
             trained_table is not None
             and not isinstance(trained_table, np.ndarray)
-            and getattr(trained_table, "ndim", 0) == 2
+            and getattr(trained_table, "ndim", 0) in (2, 3)
             and bool(config.get_flag("enable_carried_table"))
             and type(ws).__name__ == "PassWorkingSet"
             and guard is None
@@ -833,7 +833,12 @@ class BoxPSDataset:
 
                 # decay is NOT pre-set: the worker's decay_and_shrink notes
                 # it on every pending carrier under the maintenance lock,
-                # so a concurrent drain can neither miss nor double it
+                # so a concurrent drain can neither miss nor double it.
+                # 3-D = single-host MESH table [ns, cap, W] (device-axis
+                # sharded): rows stay in-shard across passes (key shard is
+                # stable), so the splice's gathers/scatters are legal on
+                # the sharded array — any reshard rides ICI, never the
+                # host link
                 carrier = TableCarrier(trained_table, ws, table.layout)
                 table.add_pending_carrier(carrier)
                 # the PREVIOUS boundary's carrier (if any) is superseded:

@@ -45,7 +45,11 @@ class TableCarrier:
     """
 
     def __init__(self, dev_flat, ws, layout, decay: Optional[float] = None):
-        # dev_flat: jax [rows, width], the single-device trained table
+        # dev_flat: jax [rows, width] — the single-device trained table, or
+        # a single-host mesh table [ns, cap, W] flattened (stays sharded;
+        # global row ids = shard*cap + rank index it directly)
+        if dev_flat.ndim == 3:
+            dev_flat = dev_flat.reshape(-1, dev_flat.shape[-1])
         self.dev_flat = dev_flat
         self.ws = ws
         self.layout = layout

@@ -257,6 +257,60 @@ def test_eager_flush_frees_carrier(tmp_path):
         config.set_flag("carried_eager_flush", prev_e)
 
 
+def test_carried_boundary_on_single_host_mesh(tmp_path):
+    """The carrier accepts the single-host MESH table (3-D, device-axis
+    sharded): rows stay in-shard across passes, the splice runs on the
+    sharded array, and two carried passes equal the classic mesh run."""
+    from paddlebox_tpu.parallel import make_mesh
+
+    N_DEV = 4
+
+    def run(carried):
+        prev = config.get_flag("enable_carried_table")
+        config.set_flag("enable_carried_table", 1 if carried else 0)
+        try:
+            layout = ValueLayout(embedx_dim=4)
+            table = HostSparseTable(layout, _opt(), n_shards=N_DEV, seed=0)
+            plan = make_mesh(N_DEV)
+            ds = BoxPSDataset(
+                _schema(), table, batch_size=B, shuffle_mode="none",
+                n_mesh_shards=N_DEV,
+            )
+            model = DeepFM(
+                num_slots=S, feat_width=layout.pull_width, embedx_dim=4,
+                hidden=(8,),
+            )
+            cfg = TrainStepConfig(
+                num_slots=S, batch_size=B // N_DEV, layout=layout,
+                sparse_opt=_opt(), auc_buckets=100, axis_name=plan.axis,
+            )
+            tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan)
+            tr.init_params(jax.random.PRNGKey(0))
+            losses = []
+            for i, (lo, hi) in enumerate([(1, 200), (100, 300)]):
+                f = _write_pass(tmp_path / f"m{carried}" / f"p{i}.txt",
+                                seed=i, lo=lo, hi=hi)
+                ds.set_filelist([f])
+                ds.load_into_memory()
+                ds.begin_pass(round_to=8)
+                out = tr.train_pass(ds)
+                losses.append(out["loss"])
+                ds.end_pass(
+                    tr.trained_table_device() if carried else tr.trained_table()
+                )
+            table.drain_pending()
+            keys = np.sort(table.keys())
+            return losses, keys, table.pull_or_create(keys)
+        finally:
+            config.set_flag("enable_carried_table", prev)
+
+    l_c, k_c, v_c = run(False)
+    l_d, k_d, v_d = run(True)
+    np.testing.assert_array_equal(k_d, k_c)
+    np.testing.assert_allclose(l_d, l_c, atol=1e-6)
+    np.testing.assert_allclose(v_d, v_c, atol=1e-5)
+
+
 def test_two_phase_passes_across_carried_boundaries(tmp_path):
     """Round-4 features composed: consecutive TWO-PHASE passes (join on the
     resident pv tier -> device handoff -> update on the resident flat
