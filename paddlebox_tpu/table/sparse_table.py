@@ -145,6 +145,13 @@ class HostSparseTable:
         # boundary no matter when a save drains.
         self._pending_carriers: List = []
         self._maintenance_lock = threading.Lock()
+        # pass-boundary decay counter, stamped into every save's meta: a
+        # key untouched since its last save still DECAYS at later
+        # boundaries, so a resume must catch those rows up (load applies
+        # rate**(file_epoch - table_epoch) to existing rows before each
+        # delta lands) — else resumed counters run high and everything
+        # show-gated (embedx unlock, shrink, cache thresholds) drifts
+        self.decay_epochs = 0
 
     def add_pending_carrier(self, carrier) -> None:
         """Register a TableCarrier whose values the host store is owed."""
@@ -330,14 +337,17 @@ class HostSparseTable:
             for c in live:
                 c.note_decay(self.opt.show_clk_decay)
             self._pending_carriers = live
+            self.decay_epochs += 1
             return self._decay_and_shrink_locked()
 
-    def _decay_and_shrink_locked(self) -> int:
+    def _decay_and_shrink_locked(
+        self, decay: Optional[float] = None, threshold: Optional[float] = None
+    ) -> int:
         lay, opt = self.layout, self.opt
+        decay = opt.show_clk_decay if decay is None else decay
+        threshold = opt.shrink_threshold if threshold is None else threshold
         if self._native is not None:
-            return self._native.decay_and_shrink(
-                opt.show_clk_decay, opt.shrink_threshold
-            )
+            return self._native.decay_and_shrink(decay, threshold)
         dropped = 0
         for shard in self._shards:
             with shard.lock:
@@ -345,9 +355,9 @@ class HostSparseTable:
                 if n == 0:
                     continue
                 vals = shard.values[:n]
-                vals[:, lay.SHOW] *= opt.show_clk_decay
-                vals[:, lay.CLK] *= opt.show_clk_decay
-                keep = vals[:, lay.SHOW] >= opt.shrink_threshold
+                vals[:, lay.SHOW] *= decay
+                vals[:, lay.CLK] *= decay
+                keep = vals[:, lay.SHOW] >= threshold
                 if keep.all():
                     continue
                 keys_arr = np.empty(n, dtype=np.uint64)
@@ -402,29 +412,49 @@ class HostSparseTable:
     def save_base(self, path: str) -> None:
         self.drain_pending()
         os.makedirs(path, exist_ok=True)
-        meta = {
-            "n_shards": self.n_shards,
-            "width": self.layout.width,
-            "embedx_dim": self.layout.embedx_dim,
-            "kind": "base",
-        }
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f)
-        for s in range(self.n_shards):
-            keys, vals = self._snapshot_shard(s, only_touched=False)
-            np.savez_compressed(os.path.join(path, f"shard-{s:05d}.npz"), keys=keys, values=vals)
+        # the epoch stamp and the row snapshots must agree: hold the
+        # maintenance lock across both so an overlapped end_pass_async
+        # worker's decay_and_shrink lands entirely before or after this
+        # save, never between stamp and snapshot
+        with self._maintenance_lock:
+            meta = {
+                "n_shards": self.n_shards,
+                "width": self.layout.width,
+                "embedx_dim": self.layout.embedx_dim,
+                "kind": "base",
+                "decay_epoch": self.decay_epochs,
+            }
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            for s in range(self.n_shards):
+                keys, vals = self._snapshot_shard(s, only_touched=False)
+                np.savez_compressed(
+                    os.path.join(path, f"shard-{s:05d}.npz"),
+                    keys=keys, values=vals,
+                )
 
     def save_delta(self, path: str) -> int:
         """Write only keys touched since the last save; returns count."""
         self.drain_pending()
         os.makedirs(path, exist_ok=True)
         total = 0
-        for s in range(self.n_shards):
-            keys, vals = self._snapshot_shard(s, only_touched=True)
-            total += len(keys)
-            np.savez_compressed(os.path.join(path, f"shard-{s:05d}.npz"), keys=keys, values=vals)
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump({"n_shards": self.n_shards, "kind": "delta"}, f)
+        with self._maintenance_lock:  # stamp/snapshot atomicity (see save_base)
+            for s in range(self.n_shards):
+                keys, vals = self._snapshot_shard(s, only_touched=True)
+                total += len(keys)
+                np.savez_compressed(
+                    os.path.join(path, f"shard-{s:05d}.npz"),
+                    keys=keys, values=vals,
+                )
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(
+                    {
+                        "n_shards": self.n_shards,
+                        "kind": "delta",
+                        "decay_epoch": self.decay_epochs,
+                    },
+                    f,
+                )
         return total
 
     def cache_threshold(self, cache_rate: float = 0.1) -> float:
@@ -459,6 +489,7 @@ class HostSparseTable:
         """Shared filtered snapshot-to-dir writer (cache/whitelist saves).
         One snapshot per shard, streamed — nothing table-sized is held."""
         self.drain_pending()
+        meta = {**meta, "decay_epoch": self.decay_epochs}
         os.makedirs(path, exist_ok=True)
         total = 0
         for s in range(self.n_shards):
@@ -494,11 +525,37 @@ class HostSparseTable:
         )
 
     def load(self, path: str) -> None:
-        """Load a base dir, then optionally apply deltas via ``apply_delta``."""
+        """Load a base dir, then optionally apply deltas via ``apply_delta``.
+
+        Epoch catch-up: each file is stamped with the table's decay epoch
+        at save time; when a file from a LATER epoch lands, the rows
+        already in the table first receive the decays they lived through
+        (``rate**(file_epoch - table_epoch)``) — exactly the history a key
+        untouched since an earlier save experienced. Files without the
+        stamp (older checkpoints) load as before."""
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         if meta["n_shards"] != self.n_shards:
             raise ValueError("shard count mismatch on load")
+        file_epoch = int(meta.get("decay_epoch", self.decay_epochs))
+        if meta.get("kind", "base") == "base":
+            # a base load STARTS a lineage: epochs are only comparable
+            # within one save lineage, so the table adopts the base's stamp
+            # outright (catching 'up' across unrelated lineages would
+            # crush or inflate counters arbitrarily)
+            self.decay_epochs = file_epoch
+        elif file_epoch > self.decay_epochs:
+            if len(self):
+                d = float(self.opt.show_clk_decay) ** (
+                    file_epoch - self.decay_epochs
+                )
+                if d < 1.0:
+                    # threshold 0: pure decay, no drops. (The native spill
+                    # tier's per-record catch-up uses the last rate seen; a
+                    # load into a table with live spill files is atypical.)
+                    with self._maintenance_lock:
+                        self._decay_and_shrink_locked(d, 0.0)
+            self.decay_epochs = file_epoch
         for s in range(self.n_shards):
             data = np.load(os.path.join(path, f"shard-{s:05d}.npz"))
             keys, vals = data["keys"], data["values"]

@@ -1,0 +1,186 @@
+"""Day-loop integration soak: many passes x carried boundaries x delta
+saves x day-level resume — the operational flow a production deployment
+runs, with every round-4 fast path active.
+
+The carrier defers host writeback; delta/base saves must drain it
+(HostSparseTable.drain_pending) so published checkpoints always contain
+device-carried training. This pins the whole interplay: N passes of
+carried boundaries, a delta save per pass, base save at day start, then a
+fresh-process resume that must reproduce the live state exactly and keep
+training.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train import CheckpointManager, CTRTrainer, TrainStepConfig
+
+S, B = 4, 16
+OPT = SparseOptimizerConfig(
+    embedx_threshold=0.0, show_clk_decay=0.97, shrink_threshold=0.0
+)
+
+
+def _schema():
+    return SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(S)],
+        label_slot="label",
+    )
+
+
+def _write(path, seed, lo, hi, n=64):
+    rng = np.random.default_rng(seed)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        for _ in range(n):
+            parts = [f"1 {float(rng.integers(0, 2))}"]
+            for _s in range(S):
+                k = int(rng.integers(1, 3))
+                parts.append(
+                    f"{k} " + " ".join(str(v) for v in rng.integers(lo, hi, k))
+                )
+            f.write(" ".join(parts) + "\n")
+    return str(path)
+
+
+def _build(layout):
+    table = HostSparseTable(layout, OPT, n_shards=2, seed=0)
+    ds = BoxPSDataset(_schema(), table, batch_size=B, shuffle_mode="none")
+    model = DeepFM(
+        num_slots=S, feat_width=layout.pull_width, embedx_dim=4, hidden=(8,)
+    )
+    cfg = TrainStepConfig(
+        num_slots=S, batch_size=B, layout=layout, sparse_opt=OPT,
+        auc_buckets=100,
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    return table, ds, tr
+
+
+def _run_days(tmp_path, carried: bool):
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1 if carried else 0)
+    try:
+        layout = ValueLayout(embedx_dim=4)
+        table, ds, tr = _build(layout)
+        root = str(tmp_path / f"ckpt{int(carried)}")
+        cm = CheckpointManager(root)
+        losses = []
+        seed = 0
+        for day_i, date in enumerate(["20260101", "20260102"]):
+            for p in range(3):
+                # overlapping key windows slide across passes
+                lo = 1 + 40 * (day_i * 3 + p)
+                f = _write(
+                    tmp_path / f"c{int(carried)}" / f"{date}-{p}.txt",
+                    seed, lo, lo + 160,
+                )
+                seed += 1
+                ds.set_date(date)
+                ds.set_filelist([f])
+                ds.load_into_memory()
+                ds.begin_pass(round_to=8)
+                out = tr.train_pass(ds)
+                losses.append(out["loss"])
+                ds.end_pass(
+                    tr.trained_table_device() if carried else tr.trained_table()
+                )
+                if p == 0:
+                    cm.save_base(date, table, tr)  # drains via save paths
+                else:
+                    cm.save_delta(date, table, tr)
+        table.drain_pending()
+        keys = np.sort(table.keys())
+        return root, table, tr, keys, table.pull_or_create(keys), losses
+    finally:
+        config.set_flag("enable_carried_table", prev)
+
+
+def test_day_loop_carried_equals_classic(tmp_path):
+    _, _, _, k_c, v_c, l_c = _run_days(tmp_path / "classic", carried=False)
+    _, _, _, k_d, v_d, l_d = _run_days(tmp_path / "carried", carried=True)
+    np.testing.assert_array_equal(k_d, k_c)
+    np.testing.assert_allclose(l_d, l_c, atol=1e-5)
+    np.testing.assert_allclose(v_d, v_c, atol=1e-4)
+
+
+def test_decay_epoch_lineage(tmp_path):
+    """Checkpoint decay-epoch semantics: a base load ADOPTS the file's
+    lineage; later deltas catch existing rows up by exactly the decays
+    they lived through; stale/foreign stamps never crush counters."""
+    layout = ValueLayout(embedx_dim=2)
+    t = HostSparseTable(layout, OPT, n_shards=2, seed=0)
+    keys = np.arange(1, 101, dtype=np.uint64)
+    vals = np.ones((100, layout.width), np.float32)
+    vals[:, layout.SHOW] = 10.0
+    t.push(keys, vals)
+    t.decay_and_shrink()  # epoch 1
+    base = str(tmp_path / "base")
+    t.save_base(base)
+    # two more boundaries decay every host row; a delta then publishes
+    # only a TOUCHED subset
+    t.decay_and_shrink()
+    t.decay_and_shrink()  # epoch 3
+    sub = keys[:20]
+    sv = t.pull_or_create(sub)
+    t.push(sub, sv)
+    delta = str(tmp_path / "delta")
+    t.save_delta(delta)
+
+    fresh = HostSparseTable(layout, OPT, n_shards=2, seed=1)
+    fresh.load(base)
+    assert fresh.decay_epochs == 1  # adopted the base lineage
+    fresh.apply_delta(delta)
+    assert fresh.decay_epochs == 3
+    got = fresh.pull_or_create(keys)
+    want = t.pull_or_create(keys)
+    # every row — including the 80 untouched since the base — matches the
+    # live table (catch-up applied the two inter-save decays)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_day_loop_resume_after_carried_saves(tmp_path):
+    """A fresh process resuming from checkpoints published DURING carried
+    passes sees the drained (complete) state and keeps training."""
+    root, table, tr, keys, vals, _ = _run_days(tmp_path, carried=True)
+    layout = ValueLayout(embedx_dim=4)
+    table2, ds2, tr2 = _build(layout)
+    cur = CheckpointManager(root).resume(table2, tr2)
+    assert cur is not None and cur["date"] == "20260102"
+    np.testing.assert_allclose(
+        table2.pull_or_create(keys), vals, rtol=1e-6, atol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(tr2.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
+    # the resumed stack trains a further carried pass
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1)
+    try:
+        f = _write(tmp_path / "next.txt", 99, 1, 200)
+        ds2.set_date("20260103")
+        ds2.set_filelist([f])
+        ds2.load_into_memory()
+        ds2.begin_pass(round_to=8)
+        out = tr2.train_pass(ds2)
+        assert np.isfinite(out["loss"])
+        ds2.end_pass(tr2.trained_table_device())
+        table2.drain_pending()
+    finally:
+        config.set_flag("enable_carried_table", prev)
