@@ -57,12 +57,20 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    remat: bool = True,
 ) -> jnp.ndarray:
     """Exact attention over the full (sharded) sequence. [B, S_local, H, D].
 
     Sequence layout: device i holds global positions
     [i*S_local, (i+1)*S_local); with ``causal`` the mask applies to global
     positions, so fully-masked future blocks contribute exactly zero.
+
+    ``remat`` checkpoints each ring step's body so the backward replays
+    blocks instead of saving every step's [Sq, Sk] probability residual.
+    The scan still saves each step's incoming (k, v) carry — residuals are
+    O(S_global * D) per device with remat vs O(S_local * S_global +
+    S_global * D) without; remat removes the quadratic term (the
+    blockwise-parallel paper's recompute trade), not the kv carries.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -101,6 +109,12 @@ def ring_attention(
     o0 = jnp.zeros((B, H, S, D), jnp.float32)
     m0 = jnp.full((B, H, S), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, S), jnp.float32)
+    if remat:
+        # backward replays each ring step (block math AND its ppermute —
+        # extra ICI traffic, the blockwise-parallel recompute trade) in
+        # exchange for O(S_local) residual memory; all devices replay the
+        # same schedule, so the re-run collectives stay matched
+        body = jax.checkpoint(body)
     (_, o, m, l), _ = lax.scan(body, ((k, v), o0, m0, l0), jnp.arange(n))
     # l == 0 can only happen for rows with NO allowed keys; causal layouts
     # always allow self-attention, so guard only against degenerate inputs
@@ -115,6 +129,7 @@ def ulysses_attention(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    remat: bool = True,
 ) -> jnp.ndarray:
     """DeepSpeed-Ulysses style: all_to_all to [full seq, H/n heads], exact
     attention, all_to_all back. Requires H % axis_size == 0."""
@@ -136,7 +151,7 @@ def ulysses_attention(
     # parallelism at exactly the lengths it exists for. Stream key chunks
     # through the same running log-sum-exp the ring body uses; memory is
     # O(S*n · chunk).
-    of = _flash_local(qf, kf, vf, scale, causal)  # [B, S*n, H/n, D]
+    of = _flash_local(qf, kf, vf, scale, causal, remat=remat)  # [B, S*n, H/n, D]
     return head_to_seq(of.astype(q.dtype))
 
 
@@ -147,11 +162,18 @@ def _flash_local(
     scale: float,
     causal: bool,
     kv_chunk: int = 512,
+    remat: bool = True,
 ) -> jnp.ndarray:
     """Exact single-device attention, keys streamed in chunks (flash-style
     online softmax). Returns [B, Sq, H, D] in f32 accumulation. Positions
     are global 0..S (q and k share the origin), so the causal mask matches
-    the unchunked computation bit-for-bit in masking decisions."""
+    the unchunked computation bit-for-bit in masking decisions.
+
+    ``remat`` checkpoints each chunk's body: without it, autodiff of the
+    scan saves every chunk's [B, H, Sq, chunk] probability block — O(S²)
+    residual memory, the exact wall chunking exists to avoid. With it the
+    backward replays each chunk (flash-attention's standard trade); the
+    full k/v (O(S*D)) remain resident either way."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     # largest divisor of Sk that fits the target chunk (shapes are static
@@ -183,6 +205,8 @@ def _flash_local(
         )
         return (o_new, m_new, l_new), None
 
+    if remat:
+        body = jax.checkpoint(body)
     o0 = jnp.zeros((B, H, Sq, D), jnp.float32)
     m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Sq), jnp.float32)

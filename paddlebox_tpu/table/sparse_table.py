@@ -413,9 +413,11 @@ class HostSparseTable:
         self.drain_pending()
         os.makedirs(path, exist_ok=True)
         # the epoch stamp and the row snapshots must agree: hold the
-        # maintenance lock across both so an overlapped end_pass_async
-        # worker's decay_and_shrink lands entirely before or after this
-        # save, never between stamp and snapshot
+        # maintenance lock across stamp + snapshots so an overlapped
+        # end_pass_async worker's decay_and_shrink lands entirely before
+        # or after this save. Compression/IO happens OUTSIDE the lock —
+        # a minutes-long compressed write must not stall pass-boundary
+        # maintenance (the transient snapshot copy is the price).
         with self._maintenance_lock:
             meta = {
                 "n_shards": self.n_shards,
@@ -424,14 +426,17 @@ class HostSparseTable:
                 "kind": "base",
                 "decay_epoch": self.decay_epochs,
             }
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(meta, f)
-            for s in range(self.n_shards):
-                keys, vals = self._snapshot_shard(s, only_touched=False)
-                np.savez_compressed(
-                    os.path.join(path, f"shard-{s:05d}.npz"),
-                    keys=keys, values=vals,
-                )
+            snaps = [
+                self._snapshot_shard(s, only_touched=False)
+                for s in range(self.n_shards)
+            ]
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        for s, (keys, vals) in enumerate(snaps):
+            np.savez_compressed(
+                os.path.join(path, f"shard-{s:05d}.npz"),
+                keys=keys, values=vals,
+            )
 
     def save_delta(self, path: str) -> int:
         """Write only keys touched since the last save; returns count."""
@@ -439,22 +444,26 @@ class HostSparseTable:
         os.makedirs(path, exist_ok=True)
         total = 0
         with self._maintenance_lock:  # stamp/snapshot atomicity (see save_base)
-            for s in range(self.n_shards):
-                keys, vals = self._snapshot_shard(s, only_touched=True)
-                total += len(keys)
-                np.savez_compressed(
-                    os.path.join(path, f"shard-{s:05d}.npz"),
-                    keys=keys, values=vals,
-                )
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(
-                    {
-                        "n_shards": self.n_shards,
-                        "kind": "delta",
-                        "decay_epoch": self.decay_epochs,
-                    },
-                    f,
-                )
+            epoch = self.decay_epochs
+            snaps = [
+                self._snapshot_shard(s, only_touched=True)
+                for s in range(self.n_shards)
+            ]
+        for s, (keys, vals) in enumerate(snaps):
+            total += len(keys)
+            np.savez_compressed(
+                os.path.join(path, f"shard-{s:05d}.npz"),
+                keys=keys, values=vals,
+            )
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(
+                {
+                    "n_shards": self.n_shards,
+                    "kind": "delta",
+                    "decay_epoch": epoch,
+                },
+                f,
+            )
         return total
 
     def cache_threshold(self, cache_rate: float = 0.1) -> float:
@@ -487,13 +496,18 @@ class HostSparseTable:
 
     def _filtered_save(self, path: str, mask_fn, meta: dict) -> int:
         """Shared filtered snapshot-to-dir writer (cache/whitelist saves).
-        One snapshot per shard, streamed — nothing table-sized is held."""
+        Stamp + snapshots are atomic under the maintenance lock (same
+        discipline as save_base); filtering/compression run outside it."""
         self.drain_pending()
-        meta = {**meta, "decay_epoch": self.decay_epochs}
         os.makedirs(path, exist_ok=True)
+        with self._maintenance_lock:
+            meta = {**meta, "decay_epoch": self.decay_epochs}
+            snaps = [
+                self._snapshot_shard(s, only_touched=False, clear_touched=False)
+                for s in range(self.n_shards)
+            ]
         total = 0
-        for s in range(self.n_shards):
-            keys, vals = self._snapshot_shard(s, only_touched=False, clear_touched=False)
+        for s, (keys, vals) in enumerate(snaps):
             keep = mask_fn(keys, vals)
             keys, vals = keys[keep], vals[keep]
             total += len(keys)

@@ -119,6 +119,47 @@ def test_day_loop_carried_equals_classic(tmp_path):
     np.testing.assert_allclose(v_d, v_c, atol=1e-4)
 
 
+def test_save_concurrent_with_async_end_pass(tmp_path):
+    """A save racing an in-flight end_pass_async worker (carried pass:
+    drain + decay + epoch stamp all in play) must produce a checkpoint
+    whose rows and epoch stamp AGREE — resuming it equals a quiesced
+    save's result up to the decays the stamp declares."""
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1)
+    try:
+        layout = ValueLayout(embedx_dim=4)
+        table, ds, tr = _build(layout)
+        for trial in range(3):
+            f = _write(tmp_path / f"p{trial}.txt", trial, 1 + 30 * trial, 300)
+            ds.set_date("20260101")
+            ds.set_filelist([f])
+            ds.load_into_memory()
+            ds.begin_pass(round_to=8)
+            tr.train_pass(ds)
+            ds.end_pass_async(tr.trained_table_device())
+            # immediately save while the worker may still be draining or
+            # decaying — the maintenance lock must serialize them
+            base = str(tmp_path / f"base{trial}")
+            table.save_base(base)
+            ds.wait_end_pass()
+            fresh = HostSparseTable(layout, OPT, n_shards=2, seed=7)
+            fresh.load(base)
+            keys = np.sort(fresh.keys())
+            got = fresh.pull_or_create(keys)
+            # reference: live table now (post-worker), un-decayed back to
+            # the save's stamp
+            live = table.pull_or_create(keys)
+            missed = table.decay_epochs - fresh.decay_epochs
+            assert missed in (0, 1)  # the save landed before or after decay
+            ref = live.copy()
+            if missed:
+                ref[:, layout.SHOW] /= OPT.show_clk_decay
+                ref[:, layout.CLK] /= OPT.show_clk_decay
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    finally:
+        config.set_flag("enable_carried_table", prev)
+
+
 def test_decay_epoch_lineage(tmp_path):
     """Checkpoint decay-epoch semantics: a base load ADOPTS the file's
     lineage; later deltas catch existing rows up by exactly the decays
