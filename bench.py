@@ -113,9 +113,11 @@ def probe_backend_with_retries(timeout_s: float):
     maximizes the chance of recording a CPU fallback on a chip that would
     have come back mid-run. Budget is controlled by env:
       PBOX_BENCH_INIT_RETRIES  number of probes (default 6)
-      PBOX_BENCH_INIT_TIMEOUT  per-probe subprocess watchdog (default 150s)
+      PBOX_BENCH_INIT_TIMEOUT  per-probe subprocess watchdog (default 120s)
       PBOX_BENCH_INIT_BACKOFF  first sleep between probes, doubled each
-                               time and capped at 240s (default 30s)
+                               time and capped at 120s (default 30s)
+    Worst case with defaults ~20 min before the CPU fallback runs — inside
+    a plausible driver timeout, with per-probe stderr progress throughout.
     Returns (info, probe_log); info is None if every probe failed. Each
     probe_log entry is {"ts", "elapsed_s", "ok", "detail"} — the multi-probe
     wedge evidence recorded into the output JSON when TPU never comes up.
@@ -141,7 +143,7 @@ def probe_backend_with_retries(timeout_s: float):
         if err is None:
             return info, probe_log
         if attempt + 1 < retries:
-            time.sleep(min(backoff, 240.0))
+            time.sleep(min(backoff, 120.0))
             backoff *= 2
     return None, probe_log
 
@@ -206,7 +208,7 @@ def fail_fast(reason: str) -> None:
 
 def main():
     profile = "--profile" in sys.argv
-    timeout_s = float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "150"))
+    timeout_s = float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "120"))
     info, probe_log = probe_backend_with_retries(timeout_s)
     tpu_error = None
     if info is None:

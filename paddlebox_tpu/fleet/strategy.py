@@ -132,7 +132,13 @@ class DistributedStrategy:
         return cfg, dense_opt, model_apply
 
     def pipeline_spec(self, axis_name: str = "pp"):
-        """PipelineSpec from pipeline_configs, for make_pipeline_train_step."""
+        """PipelineSpec from pipeline_configs, for make_pipeline_train_step.
+
+        ``pipeline_configs['dp_degree'] > 1`` selects the pipeline x data
+        composition: build the mesh with ``make_mesh_2d(n_pp, dp_degree)``
+        and pass ``dp_axis='dp'`` to make_pipeline_train_step (the
+        reference layers PipelineTrainer sections under fleet DP ranks the
+        same way)."""
         from paddlebox_tpu.parallel.pipeline import PipelineSpec
 
         if not self.pipeline:
@@ -141,3 +147,8 @@ class DistributedStrategy:
             n_micro=self.pipeline_configs.get("micro_batch", 4),
             axis_name=axis_name,
         )
+
+    @property
+    def pipeline_dp_degree(self) -> int:
+        """Data-parallel replicas per pipeline stage (1 = pure pipeline)."""
+        return int(self.pipeline_configs.get("dp_degree", 1))
