@@ -1,0 +1,49 @@
+"""tools/config_bench.py smoke: all five BASELINE configs run end to end
+through the trainer machinery and emit valid JSON."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytest.importorskip("jax")
+
+
+def test_all_five_configs_run(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PBOX_BENCH_INIT_RETRIES="1",
+        PBOX_BENCH_INIT_TIMEOUT="5",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "tools", "config_bench.py"),
+            "--rows", "4096",
+            "--batches", "3",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        cwd=repo,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    assert len(lines) == 5
+    names = [l["config"] for l in lines]
+    assert names == [
+        "1-lr-criteo",
+        "2-widedeep",
+        "3-deepfm-small",
+        "4-dcn-multislot",
+        "5-mmoe",
+    ]
+    for l in lines:
+        assert "error" not in l, l
+        assert l["samples_per_sec"] > 0
+        assert 0.0 <= l["auc"] <= 1.0

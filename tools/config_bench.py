@@ -1,0 +1,172 @@
+"""All five BASELINE.json configs, one command: per-config end-to-end
+training throughput + AUC on synthetic data at each config's shape.
+
+bench.py is the headline artifact (config 3, DeepFM, full shape);
+this harness proves the other configurations RUN end to end on the same
+machinery and tracks their relative throughput:
+
+  1. LR on Criteo-shaped slots (single-device, plain logistic regression)
+  2. Wide&Deep (wide linear arm + deep tower)
+  3. DeepFM (reduced shape here; bench.py measures the full one)
+  4. DNN+DCN multi-slot (108 sparse slots, cross network)
+  5. MMoE multi-task bottom (shared experts, CTR head)
+
+Prints one JSON line per config. Usage:
+  python tools/config_bench.py [--rows N] [--batches N]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bench import probe_backend_with_retries  # noqa: E402
+
+
+def write_files(tmpdir, rng, n_rows, n_slots, key_space):
+    path = os.path.join(tmpdir, "part-000.txt")
+    hot = rng.integers(1, 1 << 10, (n_rows, n_slots))
+    cold = rng.integers(1, key_space, (n_rows, n_slots))
+    keys = np.where(rng.random((n_rows, n_slots)) < 0.3, hot, cold)
+    labels = (rng.random(n_rows) < 0.2).astype(np.int32)
+    with open(path, "w") as f:
+        for i in range(n_rows):
+            f.write(
+                f"1 {labels[i]}.0 "
+                + " ".join(f"1 {k}" for k in keys[i])
+                + "\n"
+            )
+    return [path]
+
+
+def run_config(name, model_fn, n_slots, batch, embedx, rows, batches, key_space):
+    import jax
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+
+    rng = np.random.default_rng(0)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(n_slots)],
+        label_slot="label",
+    )
+    layout = ValueLayout(embedx_dim=embedx)
+    opt_cfg = SparseOptimizerConfig(embedx_threshold=0.0)
+    table = HostSparseTable(layout, opt_cfg, n_shards=8, seed=0)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        files = write_files(tmpdir, rng, rows, n_slots, key_space)
+        ds = BoxPSDataset(schema, table, batch_size=batch, shuffle_mode="local", seed=0)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        ds.begin_pass(round_to=256)
+        model = model_fn(layout)
+        cfg = TrainStepConfig(
+            num_slots=n_slots, batch_size=batch, layout=layout,
+            sparse_opt=opt_cfg, auc_buckets=10_000,
+        )
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-3))
+        tr.init_params(jax.random.PRNGKey(0))
+        tr.prepare_pass(ds, n_batches=batches)
+        tr.train_pass(ds, n_batches=min(8, batches))  # warm
+        t0 = time.perf_counter()
+        out = tr.train_pass(ds, n_batches=batches)
+        dt = time.perf_counter() - t0
+        ds.end_pass(tr.trained_table_device())
+        table.drain_pending()
+    return {
+        "config": name,
+        "slots": n_slots,
+        "batch": batch,
+        "samples_per_sec": round(batches * batch / dt, 1),
+        "auc": round(out["auc_cumulative"], 4),
+        "loss": round(out["loss"], 4),
+    }
+
+
+def main():
+    rows = 65_536
+    batches = 24
+    for i, a in enumerate(sys.argv):
+        if a == "--rows":
+            rows = int(sys.argv[i + 1])
+        if a == "--batches":
+            batches = int(sys.argv[i + 1])
+    info, _ = probe_backend_with_retries(
+        float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "120"))
+    )
+    import jax
+
+    if info is None:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+
+    from paddlebox_tpu.models import (
+        DCN,
+        DeepFM,
+        LogisticRegression,
+        MMoE,
+        WideDeep,
+        task_head,
+    )
+
+    configs = [
+        (
+            "1-lr-criteo",
+            lambda lay: LogisticRegression(39, lay.pull_width),
+            39, 1024, 8,
+        ),
+        (
+            "2-widedeep",
+            lambda lay: WideDeep(39, lay.pull_width, hidden=(64, 32)),
+            39, 1024, 8,
+        ),
+        (
+            "3-deepfm-small",
+            lambda lay: DeepFM(
+                num_slots=39, feat_width=lay.pull_width, embedx_dim=8,
+                hidden=(64, 32),
+            ),
+            39, 1024, 8,
+        ),
+        (
+            "4-dcn-multislot",
+            lambda lay: DCN(108, lay.pull_width, n_cross=3, hidden=(64, 32)),
+            108, 512, 8,
+        ),
+        (
+            "5-mmoe",
+            lambda lay: task_head(
+                MMoE(39, lay.pull_width, n_experts=4, expert_hidden=(32,)),
+                task=0,
+            ),
+            39, 1024, 8,
+        ),
+    ]
+    for name, fn, n_slots, batch, embedx in configs:
+        try:
+            r = run_config(
+                name, fn, n_slots, batch, embedx, rows, batches,
+                key_space=1 << 20,
+            )
+            r["platform"] = platform
+            print(json.dumps(r), flush=True)
+        except Exception as e:  # one config failing must not hide the rest
+            print(json.dumps({"config": name, "error": repr(e)[:300]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
