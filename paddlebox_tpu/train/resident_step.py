@@ -330,22 +330,24 @@ class ResidentPvFeed:
             self.ro = jnp.asarray(ro)  # [n_b, B, R]
             self.w = jnp.asarray(w)  # [n_b, B]
         else:
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
+            from paddlebox_tpu.parallel.mesh import put_axis1_blocks
 
-            nd = mesh_plan.n_devices
-            if plan.n_devices != nd:
+            nd_local = mesh_plan.n_devices // jax.process_count()
+            if plan.n_devices != nd_local:
                 raise ValueError(
-                    f"PvPlan built for {plan.n_devices} devices, mesh has {nd}"
+                    f"PvPlan built for {plan.n_devices} devices, this "
+                    f"process packs for {nd_local}"
                 )
             n_b, B = idx.shape
-            b = B // nd
+            b = B // nd_local
 
             def shard(a, *trail):
-                a = a.reshape(n_b, nd, b, *trail)
-                spec = P(None, mesh_plan.axis, *([None] * (1 + len(trail))))
-                return jax.device_put(
-                    a, NamedSharding(mesh_plan.mesh, spec)
+                # [n_b, n_local, b, ...] local blocks -> global
+                # [n_b, n_dev, b, ...] sharded on the device axis
+                # (single- and multi-host; hosts contribute their own
+                # plans' blocks, n_b locksteped via min_batches)
+                return put_axis1_blocks(
+                    mesh_plan, a.reshape(n_b, nd_local, b, *trail)
                 )
 
             self.idx = shard(idx)  # [n_b, n_dev, b]
@@ -389,11 +391,14 @@ def make_resident_pv_mesh_superstep(
     plan,
     eval_mode: bool = False,
 ) -> Callable:
-    """Single-host mesh pv superstep: ``superstep(state, pos_block [K])``.
+    """Mesh pv superstep: ``superstep(state, pos_block [K])``.
 
-    The pv arrays are device-axis sharded (each device holds its own
-    [n_b, 1, b] block); the position feed is replicated. Per-device batch
-    assembly and step body are shared with the flat mesh tier."""
+    Single- AND multi-host: the pv arrays are device-axis sharded (each
+    device holds its own [n_b, 1, b] block — on a multi-host mesh, of its
+    own host's locksteped plan); the position feed is replicated (n_b is
+    equalized via ghost batches). Per-device batch assembly and step body
+    are shared with the flat mesh tier; multi-host additionally requires
+    per-device resident pass arrays (rp.per_device)."""
     import jax as _jax
     from jax.sharding import PartitionSpec as P
 
@@ -403,17 +408,21 @@ def make_resident_pv_mesh_superstep(
         mesh_state_specs,
     )
 
-    if _jax.process_count() > 1:
-        raise NotImplementedError(
-            "resident pv feed is single-host; multi-host join phases use "
-            "the plan-driven host packer"
+    if _jax.process_count() > 1 and not rp.per_device:
+        raise RuntimeError(
+            "multi-host resident pv feed needs per-device pass arrays — "
+            "build the ResidentPass with plan= and a multi-rank transport="
         )
     local_step = make_local_mesh_step(model_apply, dense_opt, cfg, plan, eval_mode)
     ns, cap = rp.ws.n_mesh_shards, rp.ws.capacity
     L_pad, K = rp.L_pad, rp.K_pad
     rp_arrays = _resident_arrays(rp)
+    per_device = rp.per_device
 
     def superstep_local(state, pos_block, arrs, pv_idx, pv_ro, pv_w):
+        if per_device:  # multi-host: each device carries its host's arrays
+            arrs = {k: v[0] for k, v in arrs.items()}
+
         def body(st, pos):
             batch = build_mesh_device_batch(
                 arrs, cfg, pv_idx[pos, 0], L_pad, K, ns, cap
@@ -432,15 +441,16 @@ def make_resident_pv_mesh_superstep(
     }
     rep = P()
     ax = plan.axis
+    arr_specs = {k: (P(ax) if per_device else P()) for k in rp_arrays}
 
-    def superstep(state, pos_block):
+    def superstep(state, pos_block, arrs, pv_idx, pv_ro, pv_w):
         mapped = _jax.shard_map(
             superstep_local,
             mesh=plan.mesh,
             in_specs=(
                 state_specs,
                 rep,  # batch positions: replicated
-                {k: P() for k in rp_arrays},  # resident arrays replicated
+                arr_specs,  # replicated, or per-device host copies
                 P(None, ax, None),  # pv_idx [n_b, n_dev, b]
                 P(None, ax, None, None),  # pv_ro
                 P(None, ax, None),  # pv_w
@@ -448,9 +458,15 @@ def make_resident_pv_mesh_superstep(
             out_specs=(state_specs, metric_specs),
             check_vma=False,
         )
-        return mapped(state, pos_block, rp_arrays, feed.idx, feed.ro, feed.w)
+        return mapped(state, pos_block, arrs, pv_idx, pv_ro, pv_w)
 
-    return _jax.jit(superstep, donate_argnums=(0,))
+    jitted = _jax.jit(superstep, donate_argnums=(0,))
+
+    def call(state, pos_block):
+        # multi-host arrays must be jit ARGUMENTS, not closure constants
+        return jitted(state, pos_block, rp_arrays, feed.idx, feed.ro, feed.w)
+
+    return call
 
 
 # ---- mesh (single-host) resident tier --------------------------------------

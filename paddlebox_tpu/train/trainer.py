@@ -474,12 +474,7 @@ class CTRTrainer:
         n_dev = 1 if self.plan is None else self._n_pack_devices
         multi = self.plan is not None and jax.process_count() > 1
         if dataset.store is not None:
-            min_b = (
-                dataset.num_pv_batches(n_devices=n_dev, global_count=True)
-                if multi
-                else 0
-            )
-            plan = dataset.pv_plan(n_dev, min_batches=min_b)
+            plan, _ = self._pv_locked_plan(dataset)
             if plan is not None:
                 yield from self._pv_plan_feed_iter(dataset, plan, n_batches)
                 return
@@ -680,6 +675,27 @@ class CTRTrainer:
         self._resident_cache = (dataset.store, dataset.ws, rp)
         return rp
 
+    def _pv_locked_plan(self, dataset):
+        """The pass's PvPlan with the multi-host ghost-batch count folded
+        in — THE one source all pv consumers share (gate, prepare, feed),
+        so they can never build differently-locksteped plans. The global
+        batch-count allreduce runs once per (pvs, n_dev) and is cached;
+        every host takes the cache hit at the same call, so collective
+        call counts stay symmetric."""
+        n_dev = self._n_pack_devices if self.plan is not None else 1
+        multi = self.plan is not None and jax.process_count() > 1
+        c = getattr(self, "_pv_minb_cache", None)
+        if c is not None and c[0] is dataset.pvs and c[1] == n_dev:
+            min_b = c[2]
+        else:
+            min_b = (
+                dataset.num_pv_batches(n_devices=n_dev, global_count=True)
+                if multi
+                else 0
+            )
+            self._pv_minb_cache = (dataset.pvs, n_dev, min_b)
+        return dataset.pv_plan(n_dev, min_batches=min_b), n_dev
+
     def _pv_resident_prepare(self, dataset):
         """(rp, plan, device feed) for the resident join phase: build the
         PvPlan, freeze the resident pads over ITS batches (ghost repeats
@@ -691,8 +707,7 @@ class CTRTrainer:
         )
 
         rp = self._get_resident(dataset)
-        n_dev = self._n_pack_devices if self.plan is not None else 1
-        plan = dataset.pv_plan(n_dev)
+        plan, n_dev = self._pv_locked_plan(dataset)
         if self.plan is None:
             rp.ensure(plan.idx)
         else:
@@ -871,14 +886,14 @@ class CTRTrainer:
         pre-freeze a different feed path than training will take.
 
         Covers the single-device step, single-host meshes (resident arrays
-        replicate across local devices), and — for the FLAT tier —
-        multi-host meshes (each device carries its host's pass arrays,
-        pads transport-locksteped). Join phases (use_pv) ride the resident
-        tier single-process, via the pass-deterministic PvPlan — the feed
-        becomes batch POSITIONS into resident idx/rank_offset/ins_weight
-        stacks; multi-host join phases keep the plan-driven host packer.
-        A model that takes rank_offset is only excluded from the FLAT tier
-        (no rank matrix exists there to feed it)."""
+        replicate across local devices), and multi-host meshes (each
+        device carries its host's pass arrays, pads transport-locksteped)
+        — for BOTH tiers: flat, and join-phase (use_pv) via the
+        pass-deterministic PvPlan, whose feed is batch POSITIONS into
+        resident idx/rank_offset/ins_weight stacks (ghost batches
+        equalize multi-host counts). A model that takes rank_offset is
+        only excluded from the FLAT tier (no rank matrix exists there to
+        feed it)."""
         multi_host = self.plan is not None and jax.process_count() > 1
         ok = (
             bool(config.get_flag("enable_resident_feed"))
@@ -905,14 +920,12 @@ class CTRTrainer:
             # one would be a wasted full pack sweep)
             return False
         if use_pv:
-            if multi_host:
-                return False
             # the plan (and with it every record's store index) must exist;
-            # building it here is free for train_pass, which needs it next
-            return (
-                dataset.pv_plan(self._n_pack_devices if self.plan is not None else 1)
-                is not None
-            )
+            # building it here is free for train_pass, which needs it next.
+            # Multi-host: the plan carries the locksteped ghost-batch count
+            # (store-backed hosts always have store indices — availability
+            # is uniform across hosts)
+            return self._pv_locked_plan(dataset)[0] is not None
         return not self.cfg.model_takes_rank_offset
 
     def prepare_pass(

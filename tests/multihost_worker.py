@@ -263,6 +263,7 @@ def pv_main():
     n_pvs = ds.preprocess_instance()
     local_pv_batches = ds.num_pv_batches(n_devices=2)
     out_j = join_tr.train_pass(ds)
+    join_resident = getattr(join_tr, "_resident_cache", None) is not None
 
     ds.set_current_phase(0)
     ds.postprocess_instance()
@@ -290,6 +291,7 @@ def pv_main():
         upd_batches=np.array([out_u["batches"]]),
         upd_loss=np.array([out_u["loss"]]),
         n_records=np.array([ds.memory_data_size()]),
+        join_resident=np.array([int(join_resident)]),
     )
     print(f"rank {rank}: pv ok", flush=True)
 

@@ -319,14 +319,30 @@ def _write_pv_files(tmp_path, n_even_queries, n_odd_queries):
 
 
 def test_two_process_pv_join_update_lockstep(tmp_path):
-    """Multi-host join-phase (pv) training: search_id shuffle co-locates
-    queries, batch counts + pack pads are transport-locksteped (the host
-    with fewer pvs runs all-ghost batches), rank_offset stays device-local,
-    and the update phase reuses the join-trained table. The config the
-    trainer used to reject outright."""
+    """Multi-host join-phase (pv) training — now on the RESIDENT pv tier
+    (device-sharded PvPlan stacks, ghost batches locksteped): search_id
+    shuffle co-locates queries, batch counts + pads lockstep, rank_offset
+    stays device-local, and the update phase reuses the join-trained
+    table. Asserts equality with the host-packed pv path run on the same
+    data (resident disabled via env)."""
     files, total = _write_pv_files(tmp_path, n_even_queries=30, n_odd_queries=8)
     outs = _run_cluster(tmp_path, "pv", files, GLOBAL_BATCH // 2, False)
     r0, r1 = outs
+    assert int(r0["join_resident"][0]) == 1  # the new tier actually ran
+
+    # host-packed reference on identical data: metrics must agree exactly
+    (tmp_path / "hp").mkdir()
+    hp = _run_cluster(
+        tmp_path / "hp", "pv", files, GLOBAL_BATCH // 2, False,
+        extra_env={"PBOX_ENABLE_RESIDENT_FEED": "0"},
+    )
+    assert int(hp[0]["join_resident"][0]) == 0
+    for key, tol in (
+        ("join_loss", 1e-5), ("join_auc", 1e-6), ("upd_loss", 1e-5),
+    ):
+        assert abs(float(r0[key][0]) - float(hp[0][key][0])) < tol, key
+    assert int(r0["join_batches"][0]) == int(hp[0]["join_batches"][0])
+    assert int(r0["join_ins"][0]) == int(hp[0]["join_ins"][0])
     # lockstep: both ranks ran the SAME number of join batches...
     assert int(r0["join_batches"][0]) == int(r1["join_batches"][0])
     # ...which is the max of the two local needs (ghosts on the short rank)
