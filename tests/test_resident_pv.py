@@ -148,6 +148,32 @@ def test_resident_pv_mesh_matches_host_packed(tmp_path):
     np.testing.assert_allclose(tab_r, tab_h, atol=1e-4)
 
 
+def test_resident_pv_eval_mode_is_identity(tmp_path):
+    """Join-phase EVAL (set_test_mode) on the resident pv tier: metrics
+    match the host-packed eval and state returns bit-identical."""
+    prev = config.get_flag("enable_resident_feed")
+    try:
+        outs = {}
+        for resident in (0, 1):
+            config.set_flag("enable_resident_feed", resident)
+            ds, tr = _fresh(tmp_path / f"e{resident}")
+            ds.set_current_phase(1)
+            ds.preprocess_instance()
+            tr.train_pass(ds)  # one trained epoch first
+            before = np.asarray(tr.trained_table())
+            tr.set_test_mode(True)
+            ev = tr.train_pass(ds)
+            tr.set_test_mode(False)
+            after = np.asarray(tr.trained_table())
+            np.testing.assert_array_equal(before, after)  # eval writes nothing
+            outs[resident] = ev
+        assert np.isclose(outs[1]["loss"], outs[0]["loss"], atol=1e-5)
+        assert np.isclose(outs[1]["auc"], outs[0]["auc"], atol=1e-6)
+        assert outs[1]["ins_num"] == outs[0]["ins_num"]
+    finally:
+        config.set_flag("enable_resident_feed", prev)
+
+
 def test_resident_pv_then_update_phase(tmp_path):
     """The resident join phase hands off to a resident update phase within
     one pass (two-phase lifecycle on the fast tier end-to-end)."""
