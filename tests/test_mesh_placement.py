@@ -1,0 +1,81 @@
+"""Direct units for the mesh placement helpers and the resident offset
+representations — failures localize here instead of inside a 2-process
+cluster e2e."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.parallel.mesh import (
+    make_mesh_2d,
+    put_axis1_blocks,
+    put_per_device_copies,
+)
+
+N = 4
+
+
+def test_put_per_device_copies_layout():
+    plan = make_mesh(N)
+    arr = np.arange(12, dtype=np.int32).reshape(3, 4)
+    g = put_per_device_copies(plan, arr)
+    assert g.shape == (N, 3, 4)
+    # every device's slice is this process's copy
+    got = np.asarray(g)
+    for d in range(N):
+        np.testing.assert_array_equal(got[d], arr)
+    # sharded on the device axis: each shard holds one row
+    assert len(g.sharding.device_set) == N
+
+
+def test_put_axis1_blocks_layout():
+    plan = make_mesh(N)
+    local = np.arange(2 * N * 3, dtype=np.int32).reshape(2, N, 3)
+    g = put_axis1_blocks(plan, local)
+    assert g.shape == (2, N, 3)
+    np.testing.assert_array_equal(np.asarray(g), local)
+    assert len(g.sharding.device_set) == N
+
+
+def test_put_axis1_blocks_rejects_wrong_local_count():
+    plan = make_mesh(N)
+    ok = put_axis1_blocks(plan, np.zeros((2, N, 3), np.int32))
+    assert ok.shape == (2, N, 3)
+    # single-process accepts the full array only (local == global there)
+
+
+def test_make_mesh_2d_validation():
+    with pytest.raises(ValueError, match="n_pp"):
+        make_mesh_2d(0, 2)
+    plan = make_mesh_2d(2, 2)
+    assert plan.axis == "dp"
+    assert plan.mesh.shape["pp"] == 2 and plan.mesh.shape["dp"] == 2
+
+
+def test_batch_offsets_compact_equals_full():
+    """The uint8-counts representation rebuilds the exact offset matrix."""
+    from paddlebox_tpu.train.resident_step import _batch_offsets
+
+    rng = np.random.default_rng(0)
+    n, S = 64, 7
+    counts = rng.integers(0, 5, (n, S)).astype(np.int64)
+    base = np.concatenate([[0], np.cumsum(counts.sum(axis=1))[:-1]])
+    off = base[:, None] + np.concatenate(
+        [np.zeros((n, 1), np.int64), np.cumsum(counts, axis=1)], axis=1
+    )
+    idx = jnp.asarray(rng.permutation(n)[:16].astype(np.int32))
+    full = _batch_offsets({"off": jnp.asarray(off.astype(np.int32))}, idx)
+    compact = _batch_offsets(
+        {
+            "off": None,
+            "base": jnp.asarray(base.astype(np.int32)),
+            "counts": jnp.asarray(counts.astype(np.uint8)),
+        },
+        idx,
+    )
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(compact))
