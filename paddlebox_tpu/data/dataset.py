@@ -666,6 +666,32 @@ class BoxPSDataset:
 
     # ---- pass lifecycle --------------------------------------------------
 
+    def _eager_drain(self) -> None:
+        """Background carrier flush (carried_eager_flush). A failure here
+        must be LOUD: drain_pending keeps the failed carrier registered so
+        durability is preserved, and the exception is stored and re-raised
+        at the next pass boundary instead of dying with the thread."""
+        try:
+            self.table.drain_pending()
+        except Exception as e:  # noqa: BLE001 — surfaced at the boundary
+            self._eager_flush_error = e
+
+    def _raise_pending_flush_error(self) -> None:
+        # join the in-flight drain first so the check is deterministic: an
+        # unjoined thread could fail AFTER this boundary's check and the
+        # error would surface a boundary late (or never, at process end)
+        t = getattr(self, "_eager_thread", None)
+        if t is not None and t.is_alive():
+            t.join()
+        self._eager_thread = None
+        err = getattr(self, "_eager_flush_error", None)
+        if err is not None:
+            self._eager_flush_error = None
+            raise RuntimeError(
+                "background carrier flush failed — carried values remain "
+                "owed and will be retried by the next drain_pending"
+            ) from err
+
     def begin_pass(
         self,
         round_to: int = 512,
@@ -684,6 +710,7 @@ class BoxPSDataset:
         # a pending async end_pass mutates the host table (writeback/decay/
         # spill); finalize must see its final state
         self.wait_end_pass()
+        self._raise_pending_flush_error()
         if self._in_pass:
             # either end_pass was never called, or a FAILED end_pass
             # re-opened the pass; silently starting a new one would strand
@@ -713,9 +740,10 @@ class BoxPSDataset:
                     self.table, round_to=round_to, carrier=carrier
                 )
                 if config.get_flag("carried_eager_flush"):
-                    threading.Thread(
-                        target=self.table.drain_pending, daemon=False
-                    ).start()
+                    self._eager_thread = threading.Thread(
+                        target=self._eager_drain, daemon=False
+                    )
+                    self._eager_thread.start()
             else:
                 self.device_table = self.ws.finalize(
                     self.table, round_to=round_to
@@ -805,6 +833,7 @@ class BoxPSDataset:
         Results surface from ``wait_end_pass`` (or the next begin_pass)."""
         if not self._in_pass:
             raise RuntimeError("begin_pass first")
+        self._raise_pending_flush_error()
         if need_save_delta and delta_dir is None:
             raise ValueError("need_save_delta requires delta_dir")
         ws, guard, table = self.ws, getattr(self, "_guard", None), self.table

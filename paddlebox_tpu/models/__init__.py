@@ -3,6 +3,7 @@ from paddlebox_tpu.models.lr import LogisticRegression
 from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.wide_deep import WideDeep, DCN
 from paddlebox_tpu.models.mmoe import MMoE, task_head
+from paddlebox_tpu.models.rank import RankDeepFM
 
 __all__ = [
     "mlp_init",
@@ -15,4 +16,5 @@ __all__ = [
     "DCN",
     "MMoE",
     "task_head",
+    "RankDeepFM",
 ]

@@ -102,14 +102,23 @@ def sharded_push(
     recs = jnp.concatenate(
         [show_bucket[:, None], clk_bucket[:, None], grads_bucket], axis=1
     ).reshape(n, K, gw + 2)
-    # push grads in bf16 over ICI when flagged (show/clk counts are small
-    # integers, exact in bf16 up to 256 per bucket slot)
+    # push grads in bf16 over ICI when flagged. The two show/clk count
+    # columns stay fp32: bf16 is exact only to 256, and a hot key whose
+    # per-bucket count sums past that would round — drifting everything
+    # show-gated downstream (embedx unlock, shrink, cache thresholds).
+    # 2 of gw+2 columns, so the extra bytes are negligible.
     from paddlebox_tpu import config as _config
 
     if str(_config.get_flag("ici_wire_dtype")) == "bf16":
-        recs = recs.astype(jnp.bfloat16)
-    recs_recv = lax.all_to_all(recs, axis_name, 0, 0, tiled=True)  # [n, K, gw+2]
-    recs_recv = recs_recv.astype(jnp.float32)
+        counts = lax.all_to_all(
+            recs[:, :, :2], axis_name, 0, 0, tiled=True
+        )  # fp32 [n, K, 2]
+        grads_recv = lax.all_to_all(
+            recs[:, :, 2:].astype(jnp.bfloat16), axis_name, 0, 0, tiled=True
+        ).astype(jnp.float32)
+        recs_recv = jnp.concatenate([counts, grads_recv], axis=2)
+    else:
+        recs_recv = lax.all_to_all(recs, axis_name, 0, 0, tiled=True)
     ranks_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
 
     M = n * K

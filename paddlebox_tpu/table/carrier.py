@@ -154,13 +154,28 @@ class TableCarrier:
                 fut.set_exception(e)
 
         threading.Thread(target=work, daemon=False).start()
-        self._push_fut = fut
+        self._push_fut = (fut, pos)
 
     def join_push(self) -> None:
-        """Wait for an in-flight departure push (idempotent)."""
-        fut, self._push_fut = self._push_fut, None
-        if fut is not None:
-            fut.result()
+        """Wait for an in-flight departure push (idempotent).
+
+        A FAILED push un-departs its positions: the host never received
+        those rows, so they must stay owed — a later flush() retry
+        re-pushes them (drain_pending keeps this carrier registered on
+        failure). Without this, the departed-exclusion in flush would
+        silently drop exactly the rows whose push failed."""
+        fut_pos, self._push_fut = self._push_fut, None
+        if fut_pos is not None:
+            fut, pos = fut_pos
+            try:
+                fut.result()
+            except BaseException:
+                self._departed = (
+                    np.setdiff1d(self._departed, pos)
+                    if self._departed is not None
+                    else None
+                )
+                raise
 
     def flush(self, table) -> int:
         """Push every carried key's (decayed) value to the host store.
@@ -175,8 +190,13 @@ class TableCarrier:
         pos = np.arange(self.ws.n_keys)
         if self._departed is not None:
             pos = np.setdiff1d(pos, self._departed, assume_unique=True)
-        if len(pos):
-            table.push(self.ws.sorted_keys[pos], self.fetch_for(pos))
+        # chunked: one full-table gather + host copy at once would double
+        # peak memory exactly at the save points where a snapshot copy is
+        # already resident; fixed-size chunks bound the transient
+        chunk = 2_000_000
+        for lo in range(0, len(pos), chunk):
+            p = pos[lo : lo + chunk]
+            table.push(self.ws.sorted_keys[p], self.fetch_for(p))
         self._flushed = True
         self.dev_flat = None  # release the HBM reference
         return len(pos)

@@ -31,6 +31,7 @@ back): batch padding and dropped-grad scatter both target it.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
@@ -43,6 +44,24 @@ from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
 
 _HASH_MULT = np.uint64(0x9E3779B97F4A7C15)
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_zeros_fn(rows: int, width: int, sharding):
+    """Compiled born-sharded zeros builder, cached by (shape, sharding) —
+    jit caches by function identity, so a fresh lambda per pass boundary
+    would re-trace+compile the allocation every boundary."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(
+        lambda: jnp.zeros((rows, width), dtype=jnp.float32),
+        out_shardings=sharding,
+    )
+
+
+def _sharded_zeros(rows: int, width: int, sharding):
+    return _sharded_zeros_fn(rows, width, sharding)()
 
 
 def key_to_shard(keys: np.ndarray, n_shards: int) -> np.ndarray:
@@ -165,12 +184,21 @@ class HostSparseTable:
         """Flush every registered carrier (idempotent); returns keys written.
 
         Called by save/export paths so durable artifacts always include
-        device-carried training."""
+        device-carried training. A flush that raises must NOT drop the
+        failed (or the not-yet-reached) carriers from the registry —
+        otherwise a later save_base/save_delta would silently write a
+        checkpoint missing device-carried training."""
         with self._maintenance_lock:
             carriers, self._pending_carriers = self._pending_carriers, []
             n = 0
-            for c in carriers:
-                n += c.flush(self)
+            try:
+                while carriers:
+                    c = carriers[0]
+                    n += c.flush(self)
+                    carriers.pop(0)
+            finally:
+                if carriers:  # failed + unflushed: keep them owed
+                    self._pending_carriers = carriers + self._pending_carriers
         return n
 
     @property
@@ -698,7 +726,13 @@ class PassWorkingSet:
             if len(new_keys)
             else np.zeros((0, W), dtype=np.float32)
         )
-        dev = jnp.zeros((ns * cap, W), dtype=jnp.float32)
+        # allocate the destination BORN under the carried table's sharding
+        # (jit + out_shardings): an eager zeros (even one fed to
+        # device_put) would first materialize the full next-pass table
+        # unsharded on the default device — an HBM spike of full-table
+        # size at exactly the boundary the carrier exists to slim down.
+        # On a single device this degenerates to a plain allocation.
+        dev = _sharded_zeros(ns * cap, W, carrier.dev_flat.sharding)
         if len(new_keys):
             from paddlebox_tpu import config as _config
             from paddlebox_tpu.ops.wire_quant import send_rows

@@ -436,3 +436,80 @@ def test_revert_after_carried_boundary(tmp_path):
         np.testing.assert_allclose(post, pre, atol=0)
     finally:
         config.set_flag("enable_carried_table", prev)
+
+
+def test_failed_departure_push_retried_by_flush(tmp_path):
+    """A FAILED background departure push must leave those rows owed: the
+    retry flush re-pushes them, so the host table ends identical to a run
+    where the push never failed (durability under transient IO errors)."""
+    prev = config.get_flag("enable_carried_table")
+    config.set_flag("enable_carried_table", 1)
+    try:
+        layout = ValueLayout(embedx_dim=4)
+        table = HostSparseTable(layout, _opt(), n_shards=2, seed=0)
+        ds = BoxPSDataset(_schema(), table, batch_size=B, shuffle_mode="none")
+        model = DeepFM(
+            num_slots=S, feat_width=layout.pull_width, embedx_dim=4, hidden=(8,)
+        )
+        cfg = TrainStepConfig(
+            num_slots=S, batch_size=B, layout=layout, sparse_opt=_opt(),
+            auc_buckets=100,
+        )
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+        tr.init_params(jax.random.PRNGKey(0))
+        f0 = _write_pass(tmp_path / "p0.txt", seed=0, lo=1, hi=200)
+        ds.set_filelist([f0])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)
+        tr.train_pass(ds)
+        ds.end_pass(tr.trained_table_device())  # carried
+
+        # pass 2 with a DISJOINT key range: most pass-1 keys depart and the
+        # boundary dispatches a background departure push — which we fail
+        fail = {"on": True}
+        orig_push = table.push
+
+        def flaky_push(keys, vals):
+            if fail["on"]:
+                fail["on"] = False
+                raise OSError("injected departure push failure")
+            return orig_push(keys, vals)
+
+        table.push = flaky_push
+        f1 = _write_pass(tmp_path / "p1.txt", seed=1, lo=500, hi=700)
+        ds.set_filelist([f1])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)  # splice dispatches the departure push
+        tr.train_pass(ds)
+        # the failure surfaces at the first join (drain via end_pass or an
+        # explicit drain); the carrier must survive it
+        with pytest.raises(OSError):
+            table.drain_pending()
+        assert table._pending_carriers, "failed drain dropped the carrier"
+        n = table.drain_pending()  # retry: departed rows re-pushed
+        assert n > 0
+        table.push = orig_push
+        ds.end_pass(tr.trained_table_device())
+        table.drain_pending()
+        got_keys = np.sort(table.keys())
+
+        # reference run: same two passes, no failure
+        table2 = HostSparseTable(layout, _opt(), n_shards=2, seed=0)
+        ds2 = BoxPSDataset(_schema(), table2, batch_size=B, shuffle_mode="none")
+        tr2 = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+        tr2.init_params(jax.random.PRNGKey(0))
+        for i, f in enumerate([f0, f1]):
+            ds2.set_filelist([f])
+            ds2.load_into_memory()
+            ds2.begin_pass(round_to=8)
+            tr2.train_pass(ds2)
+            ds2.end_pass(tr2.trained_table_device())
+        table2.drain_pending()
+        np.testing.assert_array_equal(got_keys, np.sort(table2.keys()))
+        np.testing.assert_allclose(
+            table.pull_or_create(got_keys),
+            table2.pull_or_create(got_keys),
+            atol=1e-5,
+        )
+    finally:
+        config.set_flag("enable_carried_table", prev)

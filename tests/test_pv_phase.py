@@ -103,29 +103,16 @@ def test_pack_pv_batches_whole_pv_and_ghosts():
         list(pack_pv_batches(big, batch_size=4))
 
 
-class RankDeepFM:
-    """DeepFM + rank_attention tower over the pv rank matrix."""
+def RankDeepFM(num_slots, feat_width, embedx_dim, max_rank=3, hidden=(16,)):
+    """Test-shaped factory over the shared join-phase model
+    (paddlebox_tpu.models.RankDeepFM)."""
+    from paddlebox_tpu.models import RankDeepFM as _Shared
 
-    def __init__(self, num_slots, feat_width, embedx_dim, max_rank=3, hidden=(16,)):
-        self.base = DeepFM(num_slots, feat_width, embedx_dim, hidden=hidden)
-        self.max_rank = max_rank
-        self.in_dim = num_slots * feat_width
-
-    def init(self, rng):
-        k1, k2 = jax.random.split(rng)
-        return {
-            "base": self.base.init(k1),
-            "rank_param": 0.01
-            * jax.random.normal(k2, (self.max_rank * self.max_rank * self.in_dim, 1)),
-        }
-
-    def apply(self, params, slot_feats, dense=None, rank_offset=None):
-        logit = self.base.apply(params["base"], slot_feats, dense)
-        if rank_offset is not None:
-            x = slot_feats.reshape(slot_feats.shape[0], -1)
-            att = rank_attention(x, rank_offset, params["rank_param"], self.max_rank)
-            logit = logit + att[:, 0]
-        return logit
+    return _Shared(
+        DeepFM(num_slots, feat_width, embedx_dim, hidden=hidden),
+        num_slots * feat_width,
+        max_rank=max_rank,
+    )
 
 
 def _logkey(search_id, cmatch, rank):

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Full TPU capture: everything the perf mandate needs from ONE healthy
+chip window, self-contained and artifact-producing.
+
+Runs, in order of value-per-minute (so even a short healthy window yields
+a usable artifact — the file is (re)written after every stage):
+
+  1. headline   bench.py at the default knobs (resident + carrier + bf16)
+  2. ablations  wire=fp32, wire=int8, carried=off, pv join phase
+  3. scatter    tools/op_probe.py --scatter-sweep (the SCATTER_NOTES
+                decision input: push floor vs padded-width candidates)
+  4. sweep      bench.py across (resident_scan_batches x max_inflight)
+
+Writes tools/last_good_tpu_capture.json after each stage and appends a
+compact line to tools/tpu_capture_history.jsonl at the end. bench.py
+embeds the capture file as "tpu_capture" in any later CPU-fallback JSON,
+so a wedged driver run still carries the measured TPU numbers.
+
+Invoked automatically by tools/tpu_probe_loop.py on the first healthy
+probe; can also be run by hand:
+
+  python tools/tpu_capture.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import CAPTURE_PATH, bench_config_id  # noqa: E402
+
+HISTORY_PATH = os.path.join(REPO, "tools", "tpu_capture_history.jsonl")
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def run_bench(env_extra: dict, timeout: float = 480):
+    """One bench.py subprocess; returns its JSON line or an error dict."""
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in env_extra.items()})
+    # the chip was probed healthy moments ago: one init probe is enough,
+    # and a wedge mid-capture should fail fast, not burn the window
+    env.setdefault("PBOX_BENCH_INIT_RETRIES", "1")
+    env.setdefault("PBOX_BENCH_INIT_TIMEOUT", "150")
+    try:
+        p = subprocess.run(
+            [sys.executable, "bench.py"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"bench timed out after {timeout:.0f}s"}
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    tail = (p.stderr or "").strip().splitlines()[-3:]
+    return {"error": f"no JSON from bench rc={p.returncode}: " + " | ".join(tail)}
+
+
+def _on_tpu(out) -> bool:
+    return isinstance(out, dict) and out.get("platform") == "tpu"
+
+
+def _save(cap: dict) -> None:
+    cap["updated_at"] = _now()
+    tmp = CAPTURE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cap, f, indent=1)
+    os.replace(tmp, CAPTURE_PATH)
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+    cap = {
+        "started_at": _now(),
+        "bench_config": bench_config_id(),
+        "quick": quick,
+    }
+
+    # -- 1. headline at default knobs ------------------------------------
+    print("[capture] headline bench...", file=sys.stderr, flush=True)
+    headline = run_bench({})
+    cap["headline"] = headline
+    if not _on_tpu(headline):
+        # chip regressed between the probe and the run: bail WITHOUT
+        # saving — a CPU-fallback stub must never overwrite a previous
+        # healthy window's full TPU artifact
+        print(f"[capture] headline not on tpu: {headline}", file=sys.stderr)
+        return 1
+    _save(cap)
+
+    # -- 2. ablations at default knobs (the VERDICT-required sub-fields
+    # first: carrier / wire / pv — each one bench run) -------------------
+    ablations = {}
+    for name, env_extra in [
+        ("carried_off", {"PBOX_ENABLE_CARRIED_TABLE": 0}),
+        ("wire_fp32", {"PBOX_WIRE_DTYPE": "fp32"}),
+        ("wire_int8", {"PBOX_WIRE_DTYPE": "int8"}),
+        ("pv_join", {"PBOX_BENCH_PV": 1}),
+    ]:
+        print(f"[capture] ablation {name}...", file=sys.stderr, flush=True)
+        # NO_CACHE: non-default-knob runs must not clobber the last-good
+        # headline cache (bench_config_id doesn't encode knobs)
+        ablations[name] = run_bench(
+            {**env_extra, "PBOX_BENCH_NO_CACHE": 1}, timeout=600
+        )
+        cap["ablations"] = ablations
+        _save(cap)
+
+    # -- 3. scatter decision sweep (SCATTER_NOTES adopt/reject input) -----
+    print("[capture] scatter sweep...", file=sys.stderr, flush=True)
+    try:
+        p = subprocess.run(
+            [sys.executable, "tools/op_probe.py", "--scatter-sweep"],
+            cwd=REPO, capture_output=True, text=True, timeout=900,
+        )
+        cap["scatter_sweep"] = {
+            "rc": p.returncode,
+            "stdout": p.stdout[-8000:],
+            "stderr": p.stderr[-2000:],
+        }
+    except subprocess.TimeoutExpired:
+        cap["scatter_sweep"] = {"error": "op_probe timed out after 900s"}
+    _save(cap)
+
+    # -- 4. knob sweep ----------------------------------------------------
+    combos = [(8, 2), (16, 2)] if quick else [(4, 2), (8, 1), (8, 2), (8, 4), (16, 2), (32, 2)]
+    sweep = []
+    for scan_k, inflight in combos:
+        out = run_bench({
+            "PBOX_RESIDENT_SCAN_BATCHES": scan_k,
+            "PBOX_MAX_INFLIGHT_STEPS": inflight,
+            "PBOX_BENCH_NO_CACHE": 1,
+        })
+        row = {"scan": scan_k, "inflight": inflight, "out": out}
+        sweep.append(row)
+        cap["sweep"] = sweep
+        _save(cap)
+        v = out.get("value") if _on_tpu(out) else out.get("error", "not-tpu")
+        print(f"[capture] sweep scan={scan_k} inflight={inflight}: {v}",
+              file=sys.stderr, flush=True)
+    good = [r for r in sweep if _on_tpu(r["out"])]
+    good.append({"scan": None, "inflight": None, "out": headline})
+    best = max(good, key=lambda r: r["out"]["value"])
+    cap["best"] = {"scan": best["scan"], "inflight": best["inflight"],
+                   "value": best["out"]["value"],
+                   "vs_baseline": best["out"]["vs_baseline"]}
+    cap["finished_at"] = _now()
+    _save(cap)
+
+    with open(HISTORY_PATH, "a") as f:
+        f.write(json.dumps({
+            "ts": cap["finished_at"],
+            "headline": headline.get("value"),
+            "vs_baseline": headline.get("vs_baseline"),
+            "best": cap.get("best"),
+            "quick": quick,
+        }) + "\n")
+    print(f"[capture] done: headline {headline.get('value')} "
+          f"({headline.get('vs_baseline')}x)", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
