@@ -466,6 +466,9 @@ def main():
         "finalize2_s": round(finalize2_s, 3),
         "boundary_s": round(writeback_s + finalize2_s, 3),
         "warmup_s": round(warmup_s, 3),
+        # pass-prepare pad sweep (native pbx_block_stats counter sweep):
+        # must stay a small fraction of train_pass_s at any pass size
+        "prepare_s": round(getattr(trainer, "last_prepare_s", -1.0), 3),
         "pass2_keys": pass2_keys,
         "pass_keys": pass1_keys,
         "native_store": native_store,

@@ -16,6 +16,7 @@
 // only mutable state). ctypes releases the GIL during calls, so packer
 // threads genuinely overlap with each other and the device step.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -116,6 +117,52 @@ void pbx_gather_f32_slot(const float* values, const int64_t* base,
     for (int64_t d = 0; d < c; ++d) out[i * dim + d] = values[a + d];
     for (int64_t d = c; d < dim; ++d) out[i * dim + d] = 0.0f;
   }
+}
+
+// Pass-prepare pad sweep: per device-block (L, max unique rows per shard)
+// for the resident feed's shape freeze (ensure_sharded). The reference
+// equalizes pass shapes with counters + one allreduce
+// (compute_thread_batch_nccl, data_set.cc:2069-2135); this is the
+// counter side — one GIL-released native sweep over the whole block
+// matrix replaces a per-(device, batch) Python unique/bincount loop.
+//
+// rows: int32 [total_keys] pass-local row per key occurrence;
+// base/counts: int64 [n_records] flat key span per record;
+// indices: int64 [n_blocks * b] record ids, row-major blocks.
+// Dedup is epoch-stamped by block id over the n_rows id space; per-shard
+// unique counters reset per block (ns is small). Returns 0, or -1 on an
+// out-of-range record/row.
+int pbx_block_stats(const int32_t* rows, const int64_t* base,
+                    const int64_t* counts, int64_t n_records,
+                    const int64_t* indices, int64_t n_blocks, int64_t b,
+                    int64_t cap, int64_t ns, int64_t n_rows,
+                    int64_t* L_out, int64_t* bmax_out) {
+  std::vector<int64_t> stamp((size_t)n_rows, -1);
+  std::vector<int64_t> scnt((size_t)ns, 0);
+  for (int64_t blk = 0; blk < n_blocks; ++blk) {
+    std::fill(scnt.begin(), scnt.end(), 0);
+    int64_t L = 0, bmax = 0;
+    const int64_t* idx = indices + blk * b;
+    for (int64_t i = 0; i < b; ++i) {
+      const int64_t r = idx[i];
+      if (r < 0 || r >= n_records) return -1;
+      const int64_t a = base[r];
+      const int64_t e = a + counts[r];
+      L += counts[r];
+      for (int64_t j = a; j < e; ++j) {
+        const int32_t row = rows[j];
+        if (row < 0 || row >= n_rows) return -1;
+        if (stamp[row] != blk) {
+          stamp[row] = blk;
+          const int64_t c = ++scnt[row / cap];
+          if (c > bmax) bmax = c;
+        }
+      }
+    }
+    L_out[blk] = L;
+    bmax_out[blk] = bmax;
+  }
+  return 0;
 }
 
 }  // extern "C"

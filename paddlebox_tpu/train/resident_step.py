@@ -165,22 +165,39 @@ class ResidentPass:
     def ensure(self, batch_indices) -> None:
         """Freeze/grow L_pad and U_pad to cover every batch in the partition
         (exact per-batch max key and unique-row counts; results cached per
-        index block so repeated passes over the same partition are free)."""
-        max_L, max_U = 1, 1
-        for idx in batch_indices:
-            idx = np.asarray(idx)
-            max_L = max(max_L, int(self._key_counts[idx].sum()))
-            fp = idx.tobytes()
-            n_uniq = self._uniq_cache.get(fp)
-            if n_uniq is None:
+        index block so repeated passes over the same partition are free).
+        Uncached blocks sweep in ONE native GIL-released call
+        (pbx_block_stats with ns=1: total uniques) — the counter side of
+        the reference's pass equalization (data_set.cc:2069-2135), keeping
+        pass prepare off the Python critical path."""
+        blocks = [np.asarray(idx) for idx in batch_indices]
+        fps = [b.tobytes() for b in blocks]
+        pending, seen = [], set()
+        for b, fp in zip(blocks, fps):
+            if fp not in self._uniq_cache and fp not in seen:
+                pending.append((fp, b))
+                seen.add(fp)
+        if pending:
+            stats = _native_pad_stats(
+                self, [b for _, b in pending], self.n_table_rows, 1
+            )
+            if stats is not None:
+                for (fp, _), U in zip(pending, stats[1]):
+                    self._uniq_cache[fp] = max(int(U), 1)
+            else:
                 from paddlebox_tpu.data.record_store import _ragged_indices
 
-                base = self.store.u64_base[idx]
-                counts = self._key_counts[idx]
-                rows = self._host_rows[_ragged_indices(base, counts)]
-                n_uniq = len(np.unique(rows)) if len(rows) else 1
-                self._uniq_cache[fp] = n_uniq
-            max_U = max(max_U, n_uniq)
+                for fp, idx in pending:
+                    base = self.store.u64_base[idx]
+                    counts = self._key_counts[idx]
+                    rows = self._host_rows[_ragged_indices(base, counts)]
+                    self._uniq_cache[fp] = (
+                        len(np.unique(rows)) if len(rows) else 1
+                    )
+        max_L, max_U = 1, 1
+        for b, fp in zip(blocks, fps):
+            max_L = max(max_L, int(self._key_counts[b].sum()))
+            max_U = max(max_U, self._uniq_cache[fp])
         self.L_pad = max(self.L_pad, _round_bucket(max_L, self.bucket))
         # +1 keeps a dedicated slot for the invalid tail even when a batch
         # is exactly at the unique maximum
@@ -472,15 +489,36 @@ def make_resident_pv_mesh_superstep(
 # ---- mesh (single-host) resident tier --------------------------------------
 
 
+def _native_pad_stats(rp: ResidentPass, slices, cap: int, ns: int):
+    """One GIL-released pbx_block_stats sweep over equal-length index
+    slices -> (L[n], bmax[n]), or None when the native tier is absent or
+    the slices are ragged (caller falls back to the per-block numpy
+    sweep)."""
+    from paddlebox_tpu.utils import native
+
+    if not native.available() or not slices:
+        return None
+    if len({len(s) for s in slices}) != 1:
+        return None
+    blocks = np.stack([np.asarray(s, dtype=np.int64) for s in slices])
+    return native.block_stats(
+        rp._host_rows, rp.store.u64_base, rp._key_counts, blocks, cap, ns
+    )
+
+
 def ensure_sharded(rp: ResidentPass, batch_indices, n_devices: int) -> None:
     """Freeze/grow the mesh pads: per-DEVICE L_pad and the per-(device,
     shard) request bucket K_pad (exact scan, cached per index block — the
     resident analog of BatchPacker.freeze_shapes' lockstep branch).
     ``n_devices`` is the count THIS process packs for (local on a
     multi-host mesh); with a multi-rank transport on the ResidentPass the
-    pads are allreduce-max'd so every host compiles the same program."""
+    pads are allreduce-max'd so every host compiles the same program.
+    Uncached device blocks sweep in ONE native call (pbx_block_stats) —
+    pass prepare is one native counter sweep + one allreduce, the
+    reference's equalization shape (data_set.cc:2069-2135)."""
     cap, ns = rp.ws.capacity, rp.ws.n_mesh_shards
-    max_L, max_bucket = 1, 0
+    work = []  # (fp, slice) per device block, cache-order
+    pending, seen = [], set()
     for idx in batch_indices:
         idx = np.asarray(idx)
         if len(idx) % n_devices:
@@ -492,10 +530,19 @@ def ensure_sharded(rp: ResidentPass, batch_indices, n_devices: int) -> None:
         for d in range(n_devices):
             sl = idx[d * b : (d + 1) * b]
             fp = (d, sl.tobytes())
-            cached = rp._mesh_cache.get(fp)
-            if cached is None:
-                from paddlebox_tpu.data.record_store import _ragged_indices
+            work.append(fp)
+            if fp not in rp._mesh_cache and fp not in seen:
+                pending.append((fp, sl))
+                seen.add(fp)
+    if pending:
+        stats = _native_pad_stats(rp, [s for _, s in pending], cap, ns)
+        if stats is not None:
+            for (fp, _), L, bm in zip(pending, stats[0], stats[1]):
+                rp._mesh_cache[fp] = (int(L), int(bm))
+        else:
+            from paddlebox_tpu.data.record_store import _ragged_indices
 
+            for fp, sl in pending:
                 counts = rp._key_counts[sl]
                 rows = rp._host_rows[
                     _ragged_indices(rp.store.u64_base[sl], counts)
@@ -506,9 +553,12 @@ def ensure_sharded(rp: ResidentPass, batch_indices, n_devices: int) -> None:
                     bmax = int(np.bincount(uniq // cap, minlength=ns).max())
                 else:
                     bmax = 0
-                cached = rp._mesh_cache[fp] = (L, bmax)
-            max_L = max(max_L, cached[0])
-            max_bucket = max(max_bucket, cached[1])
+                rp._mesh_cache[fp] = (L, bmax)
+    max_L, max_bucket = 1, 0
+    for fp in work:
+        cached = rp._mesh_cache[fp]
+        max_L = max(max_L, cached[0])
+        max_bucket = max(max_bucket, cached[1])
     L = _round_bucket(max_L, rp.bucket)
     K = _round_bucket(max_bucket + 1, rp.bucket)
     tp = rp.transport

@@ -46,15 +46,25 @@ _f32p = ctypes.POINTER(ctypes.c_float)
 
 def _build() -> bool:
     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+    # compile to a tmp path, then atomic-rename: overwriting the .so in
+    # place would scribble on pages another live process has dlopen-mapped
+    # (and a concurrent builder/loader would see a half-written file);
+    # os.replace gives every reader either the old inode or the new one
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB] + _SRCS,
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp] + _SRCS,
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _LIB)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -117,6 +127,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.pbx_gather_f32_slot.argtypes = [
             _f32p, _i64p, _u32p, ctypes.c_int, _i64p, ctypes.c_int64,
             ctypes.c_int, ctypes.c_int, _f32p,
+        ]
+        lib.pbx_block_stats.restype = ctypes.c_int
+        lib.pbx_block_stats.argtypes = [
+            _i32p, _i64p, _i64p, ctypes.c_int64, _i64p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            _i64p, _i64p,
         ]
         # --- host table store (csrc/host_table.cc) ---
         lib.pbx_table_create.restype = ctypes.c_void_p
@@ -205,6 +221,42 @@ def gather_f32_slot(
         _as_ptr(out, ctypes.c_float),
     )
     return out
+
+
+def block_stats(
+    rows: np.ndarray,
+    rec_base: np.ndarray,
+    key_counts: np.ndarray,
+    blocks: np.ndarray,  # int64 [n_blocks, b] record indices
+    cap: int,
+    ns: int,
+) -> tuple:
+    """Per-block (L, max unique rows per shard) over the resolved pass rows
+    — the resident feed's pad-freeze sweep, one GIL-released call (the
+    counter side of compute_thread_batch_nccl, data_set.cc:2069-2135)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native tier unavailable (g++ build failed?)")
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    rec_base = np.ascontiguousarray(rec_base, dtype=np.int64)
+    key_counts = np.ascontiguousarray(key_counts, dtype=np.int64)
+    blocks = np.ascontiguousarray(blocks, dtype=np.int64)
+    n_blocks, b = blocks.shape
+    L_out = np.empty(n_blocks, np.int64)
+    bmax_out = np.empty(n_blocks, np.int64)
+    rc = lib.pbx_block_stats(
+        _as_ptr(rows, ctypes.c_int32),
+        _as_ptr(rec_base, ctypes.c_int64),
+        _as_ptr(key_counts, ctypes.c_int64),
+        len(rec_base),
+        _as_ptr(blocks, ctypes.c_int64),
+        n_blocks, b, int(cap), int(ns), int(cap) * int(ns),
+        _as_ptr(L_out, ctypes.c_int64),
+        _as_ptr(bmax_out, ctypes.c_int64),
+    )
+    if rc != 0:
+        raise ValueError("block_stats: record index or row out of range")
+    return L_out, bmax_out
 
 
 class NativePacker:
