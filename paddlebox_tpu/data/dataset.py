@@ -734,8 +734,9 @@ class BoxPSDataset:
             if carrier is not None and carrier.flushed:
                 carrier = None
             if carrier is not None:
-                # only PassWorkingSet takes a carrier (the multi-host
-                # DistributedWorkingSet never has one by the carry gate)
+                # PassWorkingSet takes a TableCarrier; the multi-host
+                # DistributedWorkingSet takes a MultiHostCarrier (per-host
+                # shard-block splice) — same kwarg, same delta boundary
                 self.device_table = self.ws.finalize(
                     self.table, round_to=round_to, carrier=carrier
                 )
@@ -844,14 +845,17 @@ class BoxPSDataset:
         # single-device single-process path; a save/guard/delta in the way
         # flushes via table.drain_pending.
         carrier = None
-        if (
+        carry_ok = (
             trained_table is not None
             and not isinstance(trained_table, np.ndarray)
             and getattr(trained_table, "ndim", 0) in (2, 3)
             and bool(config.get_flag("enable_carried_table"))
-            and type(ws).__name__ == "PassWorkingSet"
             and guard is None
-        ):
+        )
+        from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+        from paddlebox_tpu.table.sparse_table import PassWorkingSet
+
+        if isinstance(ws, PassWorkingSet) and carry_ok:
             import jax as _jax
 
             if (
@@ -869,14 +873,38 @@ class BoxPSDataset:
                 # the sharded array — any reshard rides ICI, never the
                 # host link
                 carrier = TableCarrier(trained_table, ws, table.layout)
-                table.add_pending_carrier(carrier)
-                # the PREVIOUS boundary's carrier (if any) is superseded:
-                # its carried keys live on in this carrier's table, its
-                # departed keys were pushed at finalize
-                prev = getattr(self, "_carrier", None)
-                if prev is not None and not prev.flushed:
-                    prev.supersede()
-                self._carrier = carrier
+        elif isinstance(ws, DistributedWorkingSet):
+            # multi-host: lockstep the carry decision over the transport
+            # (like the resident gate) so every host takes the same
+            # boundary. The allreduce runs UNCONDITIONALLY for a DWS pass
+            # — a host that can't carry (flag off, guard armed, numpy
+            # table) must still answer, or the hosts that can would hang.
+            import jax as _jax
+
+            self._carry_seq = getattr(self, "_carry_seq", 0) + 1
+            local_ok = int(carry_ok and isinstance(trained_table, _jax.Array))
+            agree = -ws.transport.allreduce_max(
+                -local_ok, f"carry-gate:{self._carry_seq}"
+            )
+            if agree:
+                from paddlebox_tpu.table.carrier import MultiHostCarrier
+
+                # per-host carrier over this host's addressable shard
+                # blocks; splice/departures/flush stay host-local because
+                # key->shard->device pinning is pass-stable (writeback is
+                # host-local for the same reason, dist_ws.py:20-22)
+                carrier = MultiHostCarrier(
+                    trained_table, ws.owned_shard_keys, table.layout
+                )
+        if carrier is not None:
+            table.add_pending_carrier(carrier)
+            # the PREVIOUS boundary's carrier (if any) is superseded:
+            # its carried keys live on in this carrier's table, its
+            # departed keys were pushed at finalize
+            prev = getattr(self, "_carrier", None)
+            if prev is not None and not prev.flushed:
+                prev.supersede()
+            self._carrier = carrier
         # the pass state clears NOW so the next load starts immediately.
         # _guard intentionally STAYS set until the worker confirms, and a
         # worker FAILURE restores the cleared state — so a failed publish
@@ -904,6 +932,20 @@ class BoxPSDataset:
                     prev_carrier.join_push()
                 if trained_table is not None and carrier is None:
                     arr = trained_table
+                    if (
+                        not isinstance(arr, np.ndarray)
+                        and not getattr(arr, "is_fully_addressable", True)
+                    ):
+                        # multi-host global array on the classic path
+                        # (carry gated off): writeback wants this host's
+                        # local shard block only
+                        shards = sorted(
+                            arr.addressable_shards,
+                            key=lambda s: s.index[0].start or 0,
+                        )
+                        arr = np.concatenate(
+                            [np.asarray(s.data) for s in shards], axis=0
+                        )
                     if not isinstance(arr, np.ndarray):
                         # device array taking the classic path (mesh, or
                         # carry gated off): honor the boundary wire format
@@ -969,6 +1011,19 @@ class BoxPSDataset:
                 raise
             finally:
                 self._end_pass_fut = None
+        # surface an already-stored eager-flush failure HERE too: a run's
+        # final pass has no next begin_pass to raise it, and exiting 0
+        # with carried values still owed would hide the durability gap
+        # (the failed carrier stays registered; drain_pending retries it).
+        # Only a stored error raises — a still-running flush is joined at
+        # the next boundary as before, preserving the overlap.
+        err = getattr(self, "_eager_flush_error", None)
+        if err is not None:
+            self._eager_flush_error = None
+            raise RuntimeError(
+                "background carrier flush failed — carried values remain "
+                "owed and will be retried by the next drain_pending"
+            ) from err
         return getattr(self, "_end_pass_result", {})
 
     # ---- batch serving ---------------------------------------------------

@@ -200,3 +200,105 @@ class TableCarrier:
         self._flushed = True
         self.dev_flat = None  # release the HBM reference
         return len(pos)
+
+
+class _ShardView:
+    """Key->row view over ONE device's shard block of a multi-host pass
+    table: duck-types the ``ws`` surface TableCarrier reads (sorted_keys /
+    row_of_sorted / n_keys). Rows are LOCAL to the device block
+    (local_shard * cap + rank)."""
+
+    def __init__(self, keys_per_shard, cap: int):
+        ks, rows = [], []
+        for j, k in enumerate(keys_per_shard):
+            ks.append(k)
+            rows.append(j * cap + np.arange(len(k), dtype=np.int64))
+        keys = (
+            np.concatenate(ks) if ks else np.zeros(0, np.uint64)
+        )
+        lrows = (
+            np.concatenate(rows) if rows else np.zeros(0, np.int64)
+        )
+        order = np.argsort(keys)
+        self.sorted_keys = keys[order]
+        self.row_of_sorted = lrows[order]
+        self.n_keys = len(keys)
+
+
+class MultiHostCarrier:
+    """Per-host device-carried pass table over a DistributedWorkingSet.
+
+    The reference's EndPass keeps the HBM cache warm on EVERY node
+    (box_wrapper.cc:627-651); here the same holds because ownership is
+    structurally local: key -> mesh shard is a stable hash and shards pin
+    to devices, so a key that survives into the next pass lands on the
+    SAME device, and a key that departs is owed to THIS host's table slice
+    (DistributedWorkingSet writeback is host-local by construction,
+    dist_ws.py:20-22). The global trained table therefore decomposes into
+    one independent TableCarrier per local device (its addressable shard
+    block), each splicing / fetching / flushing purely locally — no
+    cross-host traffic, no collective at the boundary.
+
+    Registry-facing surface (flushed / note_decay / flush / supersede /
+    join_push) delegates to the per-device carriers, so
+    ``HostSparseTable.drain_pending`` and the decay bookkeeping treat this
+    exactly like a single-host carrier.
+    """
+
+    def __init__(self, global_table, owned_shard_keys, layout):
+        # global_table: jax [ns, cap, W] sharded on axis 0 over the mesh;
+        # only this process's addressable shard blocks are touched.
+        # owned_shard_keys: the ending pass's per-local-shard key lists
+        # (DistributedWorkingSet.owned_shard_keys) — snapshotted into
+        # per-device _ShardViews; the working set itself is NOT retained.
+        self.layout = layout
+        self.sharding = global_table.sharding
+        self.ns, self.cap, self.width = global_table.shape
+        shards = sorted(
+            global_table.addressable_shards,
+            key=lambda s: s.index[0].start or 0,
+        )
+        if not shards:
+            raise ValueError("no addressable shards on this process")
+        self.shards_per_dev = shards[0].data.shape[0]
+        self.devices = [s.data.devices().pop() for s in shards]
+        # shard j of this host's owned_shard_keys belongs to device
+        # j // shards_per_dev at block-local shard j % shards_per_dev
+        self.parts = []
+        spd = self.shards_per_dev
+        for d, s in enumerate(shards):
+            view = _ShardView(
+                owned_shard_keys[d * spd : (d + 1) * spd], self.cap
+            )
+            dev_flat = s.data.reshape(spd * self.cap, self.width)
+            self.parts.append(TableCarrier(dev_flat, view, layout))
+
+    @property
+    def flushed(self) -> bool:
+        return all(c.flushed for c in self.parts)
+
+    def note_decay(self, rate: float) -> None:
+        for c in self.parts:
+            c.note_decay(rate)
+
+    def supersede(self) -> None:
+        for c in self.parts:
+            c.supersede()
+
+    def join_push(self) -> None:
+        # join ALL in-flight pushes even if one raises, then surface the
+        # first failure (its positions are un-departed by TableCarrier)
+        err = None
+        for c in self.parts:
+            try:
+                c.join_push()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err = err or e
+        if err is not None:
+            raise err
+
+    def flush(self, table) -> int:
+        n = 0
+        for c in self.parts:
+            n += c.flush(table)
+        return n

@@ -66,11 +66,21 @@ class DistributedWorkingSet:
     def _owner_host(self, keys: np.ndarray) -> np.ndarray:
         return key_to_shard(keys, self.n_mesh_shards) // self.shards_per_host
 
-    def finalize(self, table: HostSparseTable, round_to: int = 512) -> np.ndarray:
+    def finalize(
+        self, table: HostSparseTable, round_to: int = 512, carrier=None
+    ) -> np.ndarray:
         """Two-round exchange; returns THIS host's device slice
         ``[shards_per_host, capacity, width]`` (global row of key =
         global_shard * capacity + rank, exactly the single-process layout).
-        """
+
+        With ``carrier`` (a MultiHostCarrier from the previous pass's
+        end_pass), the boundary goes delta-only PER HOST: each local
+        device splices its surviving shard rows device-locally, departures
+        D2H only their slice into the local host table, and only new keys
+        upload — then the per-device blocks reassemble into the global
+        mesh array without any cross-host traffic (every node keeps its
+        HBM cache warm, EndPass parity box_wrapper.cc:627-651). Returns a
+        global jax.Array in that case."""
         t = self.transport
         with self._lock:
             if self._key_chunks:
@@ -114,15 +124,28 @@ class DistributedWorkingSet:
             (key_to_shard(owned, self.n_mesh_shards)) * cap + rank_in_shard
         )
 
-        # build the local device slice from the local host table
-        vals = (
-            table.pull_or_create(owned)
-            if len(owned)
-            else np.zeros((0, table.layout.width), np.float32)
-        )
-        dev = np.zeros((self.shards_per_host, cap, table.layout.width), np.float32)
-        local_rows = shard_of * cap + rank_in_shard
-        dev.reshape(self.shards_per_host * cap, -1)[local_rows] = vals
+        # build the local device slice: spliced from the carried device
+        # table when one is live, else classic pull from the local host
+        # table
+        self.boundary_stats = None
+        if carrier is not None and not carrier.flushed and len(owned):
+            dev = self._finalize_spliced(table, carrier, cap)
+        else:
+            if carrier is not None:
+                # no splice possible (empty pass, or already flushed):
+                # everything the carrier owes must land before the classic
+                # pull reads host rows
+                table.drain_pending()
+            vals = (
+                table.pull_or_create(owned)
+                if len(owned)
+                else np.zeros((0, table.layout.width), np.float32)
+            )
+            dev = np.zeros(
+                (self.shards_per_host, cap, table.layout.width), np.float32
+            )
+            local_rows = shard_of * cap + rank_in_shard
+            dev.reshape(self.shards_per_host * cap, -1)[local_rows] = vals
 
         # round 2: reply global rows for each requester's keys (their order)
         rep_out = []
@@ -148,6 +171,75 @@ class DistributedWorkingSet:
         self._finalized = True
         self._table = table
         return dev
+
+    def _finalize_spliced(self, table: HostSparseTable, carrier, cap: int):
+        """Per-device delta boundary over the carried shard blocks.
+
+        Each local device splices keys surviving from the previous pass
+        out of its own carried block (decay applied on device), pushes its
+        departing slice to the LOCAL host table on a background thread,
+        and uploads only its genuinely new keys — the multi-host analog of
+        PassWorkingSet._finalize_spliced, with every step host-local by
+        the stable key->shard->device pinning."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddlebox_tpu import config as _config
+        from paddlebox_tpu.ops.wire_quant import send_rows
+
+        W = table.layout.width
+        spd = carrier.shards_per_dev
+        stats = {"common": 0, "new": 0, "departed": 0}
+        blocks = []
+        for di, (dev, part) in enumerate(zip(carrier.devices, carrier.parts)):
+            # this device's NEW keys + block-local rows
+            ks, rows = [], []
+            for j in range(spd):
+                k = self.owned_shard_keys[di * spd + j]
+                ks.append(k)
+                rows.append(j * cap + np.arange(len(k), dtype=np.int64))
+            new_keys = np.concatenate(ks) if ks else np.zeros(0, np.uint64)
+            new_rows = np.concatenate(rows) if rows else np.zeros(0, np.int64)
+
+            old_keys = part.ws.sorted_keys
+            if len(old_keys):
+                pos_in_old = np.searchsorted(old_keys, new_keys)
+                pos_in_old = np.minimum(pos_in_old, len(old_keys) - 1)
+                common = old_keys[pos_in_old] == new_keys
+            else:
+                pos_in_old = np.zeros(len(new_keys), np.int64)
+                common = np.zeros(len(new_keys), bool)
+            common_old = pos_in_old[common]
+            in_new = np.zeros(len(old_keys), dtype=bool)
+            in_new[common_old] = True
+            leave_pos = np.nonzero(~in_new)[0]
+            if len(leave_pos):
+                part.push_departures_async(
+                    table, old_keys[leave_pos], leave_pos
+                )
+            new_mask = ~common
+            stats["common"] += int(common.sum())
+            stats["new"] += int(new_mask.sum())
+            stats["departed"] += len(leave_pos)
+
+            with jax.default_device(dev):
+                block = jnp.zeros((spd * cap, W), jnp.float32)
+                if new_mask.any():
+                    up = send_rows(
+                        table.pull_or_create(new_keys[new_mask]),
+                        table.layout,
+                        str(_config.get_flag("wire_dtype")),
+                    )
+                    block = block.at[jnp.asarray(new_rows[new_mask])].set(up)
+                if common.any():
+                    block = block.at[jnp.asarray(new_rows[common])].set(
+                        part.rows_for(common_old)
+                    )
+            blocks.append(block.reshape(spd, cap, W))
+        self.boundary_stats = stats
+        return jax.make_array_from_single_device_arrays(
+            (self.n_mesh_shards, cap, W), carrier.sharding, blocks
+        )
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Batch keys -> GLOBAL row ids (int32); keys must be in the pass."""

@@ -937,7 +937,22 @@ class CTRTrainer:
         covering the partition) before a timed/measured train_pass keeps
         shape growth — and the XLA recompile it triggers — out of the
         measured region. Covers both the resident path (L_pad/U_pad) and
-        the columnar packer (freeze_shapes)."""
+        the columnar packer (freeze_shapes).
+
+        Records its own wall time as ``last_prepare_s`` (bench sub-field:
+        the pass-prepare sweep must stay off the critical path — one
+        native counter sweep + one allreduce, data_set.cc:2069-2135)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            self._prepare_pass_inner(dataset, n_batches)
+        finally:
+            self.last_prepare_s = _time.perf_counter() - t0
+
+    def _prepare_pass_inner(
+        self, dataset: BoxPSDataset, n_batches: Optional[int] = None
+    ) -> None:
         self._schema = dataset.schema
         if dataset.store is None or dataset.ws is None:
             return
@@ -1263,12 +1278,10 @@ class CTRTrainer:
         ``end_pass`` to opt into the device-carried boundary
         (table/carrier.py) — the next pass's finalize then splices
         surviving rows on device and fetches only the departing slice.
-        Single-process only; multi-host writeback uses trained_table()."""
+        Multi-host: the global sharded array; end_pass builds a per-host
+        MultiHostCarrier over its addressable shard blocks (the decision
+        is locksteped over the transport), so every node keeps its HBM
+        cache warm across the boundary (EndPass box_wrapper.cc:627-651)."""
         if self._state is None:
             raise RuntimeError("no trained pass")
-        if self.plan is not None and jax.process_count() > 1:
-            raise NotImplementedError(
-                "device-carried boundary is single-process; multi-host "
-                "passes write back via trained_table()"
-            )
         return self._state.table

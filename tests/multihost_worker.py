@@ -1,19 +1,23 @@
-"""Worker process for the 2-host localhost tests (test_multihost.py).
+"""Worker process for the multi-host localhost tests (test_multihost.py).
 
-One real process per host, the reference's own test pattern
-(test_dist_fleet_base.py:158-260): host plane over TcpTransport
-(TcpShuffleRouter global shuffle, DistributedWorkingSet key exchange,
-lockstep batch counts), device plane over a REAL cross-process jax mesh
-(jax.distributed + gloo CPU collectives) running the sharded train step.
+One real process per host (rank count from conf: 2 or 4), the reference's
+own test pattern (test_dist_fleet_base.py:158-260): host plane over
+TcpTransport (TcpShuffleRouter global shuffle, DistributedWorkingSet key
+exchange, lockstep batch counts), device plane over a REAL cross-process
+jax mesh (jax.distributed + gloo CPU collectives) running the sharded
+train step.
 
 Modes:
   train  — striped files, no shuffle, 1 trained pass on the global mesh;
            dumps layout/table/metrics for equality vs the 1-process run.
   shuffle — unequal record counts + ins_id global shuffle + lockstep
            wraparound pass on the global mesh; dumps shuffle accounting.
-  zero   — ZeRO-1 optimizer-state sharding across the 2-process mesh, TWO
+  zero   — ZeRO-1 optimizer-state sharding across the process mesh, TWO
            passes (cross-pass chunked-state carry over non-addressable
            global arrays is the regression surface).
+  carried — multi-pass day loop handing end_pass the live device table;
+           carried vs classic equality (multi-host MultiHostCarrier).
+  pv     — join(pv)->update two-phase pass, ghost-locksteped.
 """
 
 import json
@@ -29,10 +33,12 @@ def main():
 
     import jax
 
+    n_ranks = conf.get("n_ranks", 2)
+    local_dev = conf.get("local_devices", 2)
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{conf['coord_port']}",
-        num_processes=2,
+        num_processes=n_ranks,
         process_id=rank,
     )
     import numpy as np
@@ -66,9 +72,10 @@ def main():
     transport = TcpTransport(rank, eps, timeout=60.0)
     router = TcpShuffleRouter(transport)
 
-    n_global_dev = 4  # 2 hosts x 2 local CPU devices
+    n_global_dev = n_ranks * local_dev
     plan = make_mesh(n_global_dev)
-    assert len(jax.local_devices()) == 2 and jax.process_count() == 2
+    assert len(jax.local_devices()) == local_dev
+    assert jax.process_count() == n_ranks
 
     shuffle_mode = "ins_id" if mode == "shuffle" else "none"
     ds = BoxPSDataset(
@@ -77,7 +84,7 @@ def main():
         batch_size=conf["local_batch"],
         n_mesh_shards=n_global_dev,
         rank=rank,
-        nranks=2,
+        nranks=n_ranks,
         shuffle_mode=shuffle_mode,
         router=router,
         transport=transport,
@@ -92,7 +99,7 @@ def main():
     )
     cfg = TrainStepConfig(
         num_slots=NS,
-        batch_size=conf["local_batch"] // 2,  # per device
+        batch_size=conf["local_batch"] // local_dev,  # per device
         layout=layout,
         sparse_opt=opt_cfg,
         auc_buckets=1000,
@@ -155,6 +162,119 @@ def main():
 
 
 
+def carried_main():
+    """Multi-pass day loop over overlapping key streams: every boundary
+    hands end_pass the live DEVICE table (trained_table_device). With
+    PBOX_ENABLE_CARRIED_TABLE=1 the locksteped gate builds a per-host
+    MultiHostCarrier (splice + departure push + new-key upload only); with
+    0 the same call takes the classic full writeback. The test asserts the
+    two runs produce identical host tables and metrics."""
+    _, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    rank = int(rank_s)
+    with open(os.path.join(workdir, "conf.json")) as f:
+        conf = json.load(f)
+
+    import jax
+
+    n_ranks = conf.get("n_ranks", 2)
+    local_dev = conf.get("local_devices", 2)
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{conf['coord_port']}",
+        num_processes=n_ranks,
+        process_id=rank,
+    )
+    import numpy as np
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.parallel.transport import TcpTransport, TcpShuffleRouter
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+
+    NS = conf["num_slots"]
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    layout = ValueLayout(embedx_dim=conf["embedx_dim"])
+    # decay on, shrink off: exercises the carrier's accumulated-decay path
+    # while keeping carried == classic bit-equivalent (the shrink
+    # exemption for carried keys is the one documented semantic delta)
+    opt_cfg = SparseOptimizerConfig(
+        embed_lr=0.2, embedx_lr=0.2, embedx_threshold=0.0,
+        initial_range=0.01, show_clk_decay=0.95, shrink_threshold=0.0,
+    )
+    table = HostSparseTable(layout, opt_cfg, n_shards=4, seed=0)
+
+    eps = [f"127.0.0.1:{p}" for p in conf["tp_ports"]]
+    transport = TcpTransport(rank, eps, timeout=60.0)
+    router = TcpShuffleRouter(transport)
+
+    n_global_dev = n_ranks * local_dev
+    plan = make_mesh(n_global_dev)
+    ds = BoxPSDataset(
+        schema, table, batch_size=conf["local_batch"],
+        n_mesh_shards=n_global_dev, rank=rank, nranks=n_ranks,
+        shuffle_mode="none", router=router, transport=transport, seed=0,
+    )
+    model = DeepFM(
+        num_slots=NS, feat_width=layout.pull_width,
+        embedx_dim=conf["embedx_dim"], hidden=(16,),
+    )
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=conf["local_batch"] // local_dev,
+        layout=layout, sparse_opt=opt_cfg, auc_buckets=1000,
+        axis_name=plan.axis,
+    )
+    trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan)
+    trainer.init_params(jax.random.PRNGKey(0))
+
+    per_pass = conf["files_per_pass"]
+    n_passes = len(conf["files"]) // per_pass
+    losses, aucs = [], []
+    splice = {"common": 0, "new": 0, "departed": 0}
+    spliced_passes = 0
+    pass_keys = []
+    for p in range(n_passes):
+        ds.set_filelist(conf["files"][p * per_pass : (p + 1) * per_pass])
+        ds.set_date(f"202601{p + 1:02d}")
+        ds.load_into_memory()
+        ds.begin_pass(round_to=conf["round_to"])
+        bs = getattr(ds.ws, "boundary_stats", None)
+        if bs is not None:
+            spliced_passes += 1
+            for k in splice:
+                splice[k] += bs[k]
+        pass_keys.append(ds.ws.n_keys)
+        out = trainer.train_pass(ds)
+        losses.append(out["loss"])
+        aucs.append(out["auc"])
+        ds.end_pass(trainer.trained_table_device())
+    table.drain_pending()
+    keys = np.sort(table.keys())
+    np.savez(
+        os.path.join(workdir, f"rank{rank}.npz"),
+        losses=np.array(losses),
+        aucs=np.array(aucs),
+        host_keys=keys,
+        host_vals=table.pull_or_create(keys),
+        spliced_passes=np.array([spliced_passes]),
+        splice_common=np.array([splice["common"]]),
+        splice_new=np.array([splice["new"]]),
+        splice_departed=np.array([splice["departed"]]),
+        pass_keys=np.array(pass_keys),
+    )
+    print(f"rank {rank}: carried ok", flush=True)
+
+
 def pv_main():
     """Join(pv) -> update two-phase pass on the 2-host mesh: search_id
     global shuffle co-locates each query's ads on its owner host, pv batch
@@ -167,10 +287,12 @@ def pv_main():
 
     import jax
 
+    n_ranks = conf.get("n_ranks", 2)
+    local_dev = conf.get("local_devices", 2)
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{conf['coord_port']}",
-        num_processes=2,
+        num_processes=n_ranks,
         process_id=rank,
     )
     import jax.numpy as jnp
@@ -206,7 +328,7 @@ def pv_main():
     transport = TcpTransport(rank, eps, timeout=60.0)
     router = TcpShuffleRouter(transport)
 
-    n_global_dev = 4
+    n_global_dev = n_ranks * local_dev
     plan = make_mesh(n_global_dev)
 
     ds = BoxPSDataset(
@@ -215,7 +337,7 @@ def pv_main():
         batch_size=conf["local_batch"],
         n_mesh_shards=n_global_dev,
         rank=rank,
-        nranks=2,
+        nranks=n_ranks,
         shuffle_mode="search_id",  # co-locate each pv on its owner host
         router=router,
         transport=transport,
@@ -251,7 +373,7 @@ def pv_main():
             return logit
 
     model = RankModel()
-    per_dev_b = conf["local_batch"] // 2
+    per_dev_b = conf["local_batch"] // local_dev
     cfg_join = TrainStepConfig(
         num_slots=NS, batch_size=per_dev_b, layout=layout, sparse_opt=opt_cfg,
         auc_buckets=1000, axis_name=plan.axis, model_takes_rank_offset=True,
@@ -261,7 +383,7 @@ def pv_main():
 
     ds.set_current_phase(1)
     n_pvs = ds.preprocess_instance()
-    local_pv_batches = ds.num_pv_batches(n_devices=2)
+    local_pv_batches = ds.num_pv_batches(n_devices=local_dev)
     out_j = join_tr.train_pass(ds)
     join_resident = getattr(join_tr, "_resident_cache", None) is not None
 
@@ -299,5 +421,7 @@ def pv_main():
 if __name__ == "__main__":
     if sys.argv[1] == "pv":
         pv_main()
+    elif sys.argv[1] == "carried":
+        carried_main()
     else:
         main()

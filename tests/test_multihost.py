@@ -1,9 +1,11 @@
-"""Multi-host made real: 2 localhost processes, real sockets, real mesh.
+"""Multi-host made real: localhost process clusters, real sockets, real mesh.
 
 The reference proves its distributed tier with localhost subprocess
-clusters (test_dist_fleet_base.py:158-260); same pattern here. Two worker
-processes each own half the global device mesh (jax.distributed, gloo CPU
-collectives) and half the sparse table:
+clusters (test_dist_fleet_base.py:158-260); same pattern here, at 2 AND 4
+ranks (the reference's dualbox math is rank-count-general,
+data_set.cc:1452-1464 — 2 is the weakest test of generality). Worker
+processes each own a slice of the global device mesh (jax.distributed,
+gloo CPU collectives) and of the sparse table:
 
 - test_two_process_training_matches_single_process: striped files, no
   shuffle, one trained pass through TcpTransport + DistributedWorkingSet +
@@ -67,25 +69,31 @@ def _write_files(tmp_path, sizes, with_ins_id=False):
 
 def _run_cluster(
     tmp_path, mode, files, local_batch, parse_ins_id, round_to=32,
-    extra_env=None,
+    extra_env=None, extra_conf=None, n_ranks=2, local_devices=2,
 ):
-    coord, tp0, tp1 = _free_ports(3)
+    ports = _free_ports(1 + n_ranks)
     conf = dict(
-        coord_port=coord,
-        tp_ports=[tp0, tp1],
+        coord_port=ports[0],
+        tp_ports=ports[1:],
         files=files,
         local_batch=local_batch,
         num_slots=NS,
         embedx_dim=D,
         parse_ins_id=parse_ins_id,
         round_to=round_to,
+        n_ranks=n_ranks,
+        local_devices=local_devices,
     )
+    if extra_conf:
+        conf.update(extra_conf)
     with open(tmp_path / "conf.json", "w") as f:
         json.dump(conf, f)
     env = dict(os.environ)
     if extra_env:
         env.update(extra_env)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}"
+    )
     env["JAX_PLATFORMS"] = "cpu"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
@@ -95,12 +103,12 @@ def _run_cluster(
             [sys.executable, worker, mode, str(r), str(tmp_path)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
-        for r in range(2)
+        for r in range(n_ranks)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=240 * max(1, n_ranks // 2))
             outs.append(out)
     finally:
         for p in procs:
@@ -108,13 +116,13 @@ def _run_cluster(
                 p.kill()
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out[-4000:]}"
-    return [np.load(tmp_path / f"rank{r}.npz") for r in range(2)]
+    return [np.load(tmp_path / f"rank{r}.npz") for r in range(n_ranks)]
 
 
-def _single_process_reference(files, local_batch):
+def _single_process_reference(files, local_batch, n_ranks=2, local_devices=2):
     """The same pass, one process: global batches composed exactly as the
-    2-host run composes them (rank-local blocks concatenated), trained on a
-    4-device local mesh."""
+    n-host run composes them (rank-local blocks concatenated), trained on
+    an equal-size local mesh."""
     import jax
     import optax
 
@@ -148,15 +156,16 @@ def _single_process_reference(files, local_batch):
     )
     table = HostSparseTable(layout, opt_cfg, n_shards=4, seed=0)
 
-    stripes = [[], []]
-    for r in range(2):
-        for path in files[r::2]:
+    n_global = n_ranks * local_devices
+    stripes = [[] for _ in range(n_ranks)]
+    for r in range(n_ranks):
+        for path in files[r::n_ranks]:
             with open(path) as f:
                 for line in f:
                     rec = parse_line(line.rstrip("\n"), schema)
                     if rec is not None:
                         stripes[r].append(rec)
-    ws = PassWorkingSet(n_mesh_shards=4)
+    ws = PassWorkingSet(n_mesh_shards=n_global)
     for stripe in stripes:
         for rec in stripe:
             ws.add_keys(rec.u64_values)
@@ -164,9 +173,9 @@ def _single_process_reference(files, local_batch):
 
     model = DeepFM(num_slots=NS, feat_width=layout.pull_width,
                    embedx_dim=D, hidden=(16,))
-    plan = make_mesh(4)
+    plan = make_mesh(n_global)
     cfg = TrainStepConfig(
-        num_slots=NS, batch_size=local_batch // 2, layout=layout,
+        num_slots=NS, batch_size=local_batch // local_devices, layout=layout,
         sparse_opt=opt_cfg, auc_buckets=1000, axis_name=plan.axis,
     )
     step = make_sharded_train_step(model.apply, optax.adam(1e-2), cfg, plan)
@@ -177,9 +186,9 @@ def _single_process_reference(files, local_batch):
     n_batches = len(stripes[0]) // local_batch
     for i in range(n_batches):
         block = slice(i * local_batch, (i + 1) * local_batch)
-        recs = stripes[0][block] + stripes[1][block]
+        recs = sum((s[block] for s in stripes), [])
         batch = build_batch(recs, schema)
-        db = pack_batch_sharded(batch, ws, schema, 4, bucket=256)
+        db = pack_batch_sharded(batch, ws, schema, n_global, bucket=256)
         feed = {
             k: jax.device_put(v, plan.batch_sharding)
             for k, v in db.as_dict().items()
@@ -197,25 +206,28 @@ def _single_process_reference(files, local_batch):
     )
 
 
-def _check_train_matches_reference(dumps, ref):
+def _check_train_matches_reference(dumps, ref, num_batches=4):
     # pass layout identical: capacity + every referenced key's global row
-    assert dumps[0]["capacity"][0] == dumps[1]["capacity"][0] == ref["ws"].capacity
     for d in dumps:
+        assert d["capacity"][0] == ref["ws"].capacity
         np.testing.assert_array_equal(
             d["rows"], ref["ws"].lookup(d["sorted_keys"]).astype(np.int64)
         )
-    assert dumps[0]["num_batches"][0] == dumps[1]["num_batches"][0] == 4
+        assert d["num_batches"][0] == num_batches
 
     # trained table: hosts' shard blocks assemble into the reference table
-    merged = np.concatenate([dumps[0]["local_table"], dumps[1]["local_table"]])
+    merged = np.concatenate([d["local_table"] for d in dumps])
     assert merged.shape == ref["trained"].shape
     np.testing.assert_allclose(merged, ref["trained"], rtol=2e-3, atol=1e-4)
 
     # host tables after writeback: disjoint ownership, union == reference
-    k0, k1 = dumps[0]["host_keys"], dumps[1]["host_keys"]
-    assert len(np.intersect1d(k0, k1)) == 0
-    all_keys = np.concatenate([k0, k1])
-    all_vals = np.concatenate([dumps[0]["host_vals"], dumps[1]["host_vals"]])
+    for a in range(len(dumps)):
+        for b in range(a + 1, len(dumps)):
+            assert len(np.intersect1d(
+                dumps[a]["host_keys"], dumps[b]["host_keys"]
+            )) == 0
+    all_keys = np.concatenate([d["host_keys"] for d in dumps])
+    all_vals = np.concatenate([d["host_vals"] for d in dumps])
     order = np.argsort(all_keys)
     np.testing.assert_array_equal(all_keys[order], ref["host_keys"])
     np.testing.assert_allclose(
@@ -224,7 +236,8 @@ def _check_train_matches_reference(dumps, ref):
 
     # online AUC agrees (same batches, f32 bucket-edge tolerance)
     assert abs(dumps[0]["auc"][0] - ref["auc"]) < 5e-3
-    assert abs(dumps[0]["auc"][0] - dumps[1]["auc"][0]) < 1e-9
+    for d in dumps[1:]:
+        assert abs(dumps[0]["auc"][0] - d["auc"][0]) < 1e-9
 
 
 def test_two_process_training_matches_single_process(tmp_path):
@@ -250,6 +263,56 @@ def test_two_process_training_host_packed(tmp_path):
         assert d["used_resident"][0] == 0
     ref = _single_process_reference(files, GLOBAL_BATCH // 2)
     _check_train_matches_reference(dumps, ref)
+
+
+def test_four_process_training_matches_single_process(tmp_path):
+    """Rank-count generality (the reference's dualbox math is
+    rank-general, data_set.cc:1452-1464): the DWS key exchange, resident
+    placement, and striped batching at FOUR ranks x 2 local devices must
+    equal the same pass on one 8-device process."""
+    files = _write_files(tmp_path, [32] * 8)
+    local_batch = 16  # 4 ranks x 16 = 64 global, 8 per device
+    dumps = _run_cluster(
+        tmp_path, "train", files, local_batch, False, n_ranks=4,
+    )
+    for d in dumps:
+        assert d["used_resident"][0] == 1
+    ref = _single_process_reference(files, local_batch, n_ranks=4)
+    _check_train_matches_reference(dumps, ref)
+
+
+def test_four_process_pv_join_update_lockstep(tmp_path):
+    """The pv ghost lockstep at 4 ranks: search_id shuffle over 4 owners,
+    unequal local pv loads, batch counts allreduce-max'd, every real ad
+    trained exactly once globally; resident pv tier == host-packed."""
+    files, total = _write_pv_files(
+        tmp_path, n_even_queries=30, n_odd_queries=8, n_files=4
+    )
+    outs = _run_cluster(tmp_path, "pv", files, 16, False, n_ranks=4)
+    assert int(outs[0]["join_resident"][0]) == 1
+
+    (tmp_path / "hp").mkdir()
+    hp = _run_cluster(
+        tmp_path / "hp", "pv", files, 16, False, n_ranks=4,
+        extra_env={"PBOX_ENABLE_RESIDENT_FEED": "0"},
+    )
+    assert int(hp[0]["join_resident"][0]) == 0
+    for key, tol in (
+        ("join_loss", 1e-5), ("join_auc", 1e-6), ("upd_loss", 1e-5),
+    ):
+        assert abs(float(outs[0][key][0]) - float(hp[0][key][0])) < tol, key
+    # lockstep across ALL ranks: same join batch count = max local need
+    jb = [int(r["join_batches"][0]) for r in outs]
+    assert len(set(jb)) == 1
+    local = [int(r["local_pv_batches"][0]) for r in outs]
+    assert jb[0] == max(local)
+    assert max(local) > min(local), "4-way split should be uneven"
+    # every real ad trained exactly once globally on every rank's count
+    for r in outs:
+        assert int(r["join_ins"][0]) == total
+        assert np.isfinite(r["join_loss"][0]) and np.isfinite(r["upd_loss"][0])
+    ub = [int(r["upd_batches"][0]) for r in outs]
+    assert len(set(ub)) == 1 and ub[0] > 0
 
 
 def test_global_shuffle_and_lockstep_unequal_records(tmp_path):
@@ -289,7 +352,82 @@ def test_zero1_across_processes(tmp_path):
     assert not np.array_equal(dumps[0]["local_table"], dumps[1]["local_table"])
 
 
-def _write_pv_files(tmp_path, n_even_queries, n_odd_queries):
+def _write_overlapping_pass_files(tmp_path, n_passes, files_per_pass, n=48):
+    """Per-pass file groups whose key ranges overlap pass-to-pass (the CTR
+    stream shape the carried boundary exploits: most keys survive, some
+    depart, some are new)."""
+    rng = np.random.default_rng(23)
+    files = []
+    for p in range(n_passes):
+        # ~80% key-range overlap pass-to-pass (CTR-like recurrence)
+        lo, hi = 1 + 80 * p, 400 + 80 * p
+        for fi in range(files_per_pass):
+            path = str(tmp_path / f"pass{p}-part{fi}.txt")
+            with open(path, "w") as f:
+                for _ in range(n):
+                    keys = rng.integers(lo, hi, NS)
+                    f.write(
+                        f"1 {int(keys[0]) % 2}.0 "
+                        + " ".join(f"1 {k}" for k in keys)
+                        + "\n"
+                    )
+            files.append(path)
+    return files
+
+
+def test_two_process_carried_boundary_matches_classic(tmp_path):
+    """Multi-host device-carried pass boundary (per-host MultiHostCarrier
+    splice over the DistributedWorkingSet): a 3-pass day loop over
+    overlapping key streams must produce EXACTLY the host tables and
+    metrics of the classic full-writeback boundary, while moving only the
+    key-set delta over the host<->device wire (EndPass warm-cache parity
+    on every node, box_wrapper.cc:627-651)."""
+    files = _write_overlapping_pass_files(tmp_path, n_passes=3, files_per_pass=2)
+    conf = {"files_per_pass": 2}
+    (tmp_path / "car").mkdir()
+    car = _run_cluster(
+        tmp_path / "car", "carried", files, GLOBAL_BATCH // 2, False,
+        extra_env={"PBOX_ENABLE_CARRIED_TABLE": "1"}, extra_conf=conf,
+    )
+    (tmp_path / "cls").mkdir()
+    cls = _run_cluster(
+        tmp_path / "cls", "carried", files, GLOBAL_BATCH // 2, False,
+        extra_env={"PBOX_ENABLE_CARRIED_TABLE": "0"}, extra_conf=conf,
+    )
+    # the carried run actually spliced (passes 2 and 3), and the boundary
+    # moved only the delta: every splice found surviving rows, so uploads
+    # + departures stay strictly below the full-table traffic the classic
+    # boundary pays twice per pass
+    for r in range(2):
+        assert int(car[r]["spliced_passes"][0]) == 2
+        assert int(car[r]["splice_common"][0]) > 0
+        assert int(cls[r]["spliced_passes"][0]) == 0
+    common = sum(int(car[r]["splice_common"][0]) for r in range(2))
+    moved = sum(
+        int(car[r]["splice_new"][0]) + int(car[r]["splice_departed"][0])
+        for r in range(2)
+    )
+    # classic boundary traffic = full writeback (common+departed) + full
+    # re-upload (common+new) = 2*common + moved; the carrier ships only
+    # the key-set delta, so the host wire carries well under that
+    classic_traffic = 2 * common + moved
+    assert moved < 0.7 * classic_traffic
+
+    # carried == classic: per-pass metrics and the final host tables
+    for r in range(2):
+        np.testing.assert_allclose(
+            car[r]["losses"], cls[r]["losses"], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            car[r]["aucs"], cls[r]["aucs"], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_array_equal(car[r]["host_keys"], cls[r]["host_keys"])
+        np.testing.assert_allclose(
+            car[r]["host_vals"], cls[r]["host_vals"], rtol=1e-5, atol=1e-6
+        )
+
+
+def _write_pv_files(tmp_path, n_even_queries, n_odd_queries, n_files=2):
     """Logkey'd pv data with a skewed search_id parity split: after
     search_id-mode global shuffle, rank 0 owns ~n_even and rank 1 ~n_odd
     page views — unequal join batch counts force ghost equalization."""
@@ -298,7 +436,7 @@ def _write_pv_files(tmp_path, n_even_queries, n_odd_queries):
         2 * (i + 1) + 1 for i in range(n_odd_queries)
     ]
     rng.shuffle(sids)
-    files = [str(tmp_path / "part-0.txt"), str(tmp_path / "part-1.txt")]
+    files = [str(tmp_path / f"part-{i}.txt") for i in range(n_files)]
     handles = [open(p, "w") for p in files]
     total = 0
     for qi, sid in enumerate(sids):
@@ -307,7 +445,7 @@ def _write_pv_files(tmp_path, n_even_queries, n_odd_queries):
             keys = rng.integers(1, 500, NS)
             cmatch = 222 if rng.random() < 0.8 else 999  # some rank-invalid
             logkey = "0" * 11 + f"{cmatch:03x}" + f"{rank:02x}" + f"{sid:016x}"
-            handles[qi % 2].write(
+            handles[qi % len(handles)].write(
                 f"1 {logkey} 1 {int(keys[0]) % 2}.0 "
                 + " ".join(f"1 {k}" for k in keys)
                 + "\n"
