@@ -87,7 +87,8 @@ define_flag(
     "ici_wire_dtype",
     "fp32",
     "value format of the sharded pull/push all_to_all payloads over ICI: "
-    "fp32 | bf16",
+    "fp32 | bf16 | int8 (bf16/int8 keep the show/clk counter columns fp32; "
+    "int8 carries one per-record max-abs scale)",
 )
 
 # --- sparse table ---

@@ -20,12 +20,16 @@ Formats (``wire_dtype`` / ``ici_wire_dtype`` flags, defined in config.py so
 they exist before this module loads; default fp32 = exact):
 - ``bf16``: drop 16 mantissa bits; ~3 significant digits — comfortably
   inside CTR embedding noise, exactly half the bytes.
-- ``int8`` (row wire only): the EMBED VALUE block (embed_w + embedx +
-  expand — contiguous columns [embed_w_col, embed_g2_col)) is int8 with a
-  per-row max-abs scale, like the reference's int16 quant pull; the
-  heterogeneous remainder (show/clk counters, conv/pcoc extras, adagrad g2)
-  rides bf16 — a shared row scale would let a show=1000 counter zero out
-  0.01-magnitude embeddings.
+- ``int8``: the EMBED VALUE region (embed_w + embedx + expand — contiguous
+  columns [embed_w_col, embed_g2_col)) is int8 with per-row max-abs scales,
+  like the reference's int16 quant pull; the heterogeneous remainder
+  (show/clk counters, conv/pcoc extras, adagrad g2) rides bf16 — a shared
+  row scale would let a show=1000 counter zero out 0.01-magnitude
+  embeddings. Scales are PER BLOCK within the region — (embed_w+embedx)
+  and expand quantize independently, mirroring how the reference types
+  each value family separately (box_wrapper.cc:419-437): the expand block
+  trains on different gradients and can sit orders of magnitude away from
+  embedx, and one shared scale would quantize the smaller block to noise.
 
 Host-side casts use ml_dtypes (numpy bf16 support ships with jax).
 """
@@ -52,8 +56,20 @@ def _check(mode: str) -> str:
 
 
 def _embed_span(layout) -> Tuple[int, int]:
-    """[start, stop) of the contiguous embed-value block in a table row."""
+    """[start, stop) of the contiguous embed-value region in a table row."""
     return layout.embed_w_col, layout.embed_g2_col
+
+
+def _embed_blocks(layout) -> Tuple[Tuple[int, int], ...]:
+    """Independently-scaled sub-blocks tiling the embed-value region:
+    (embed_w + embedx) and, when present, the expand embedding — separate
+    value families with separate gradient flows, so separate quant scales
+    (the reference types each pull-value family on its own,
+    box_wrapper.cc:419-437)."""
+    a, b = _embed_span(layout)
+    if layout.expand_dim:
+        return ((a, layout.expand_col), (layout.expand_col, b))
+    return ((a, b),)
 
 
 # ---- table-row wire (boundary transfers) ------------------------------------
@@ -78,13 +94,16 @@ def fetch_rows_start(arr, layout, mode: str):
     if mode == "bf16":
         return {"mode": mode, "raw": arr.astype(jnp.bfloat16)}
     a, b = _embed_span(layout)
-    emb = arr[:, a:b]
-    scale = jnp.maximum(jnp.abs(emb).max(axis=1), 1e-12) / 127.0
-    q = jnp.clip(jnp.rint(emb / scale[:, None]), -127, 127).astype(jnp.int8)
+    qs, scales = [], []
+    for ba, bb in _embed_blocks(layout):
+        blk = arr[:, ba:bb]
+        s = jnp.maximum(jnp.abs(blk).max(axis=1), 1e-12) / 127.0
+        qs.append(jnp.clip(jnp.rint(blk / s[:, None]), -127, 127).astype(jnp.int8))
+        scales.append(s)
     return {
         "mode": mode,
-        "q": q,
-        "scale": scale.astype(jnp.float32),
+        "q": jnp.concatenate(qs, axis=1) if len(qs) > 1 else qs[0],
+        "scale": jnp.stack(scales, axis=1).astype(jnp.float32),  # [n, n_blocks]
         "head": arr[:, :a].astype(jnp.bfloat16),
         "tail": arr[:, b:].astype(jnp.bfloat16),
     }
@@ -99,12 +118,13 @@ def fetch_rows_finish(handle, layout) -> np.ndarray:
         return np.asarray(handle["raw"]).astype(np.float32)
     a, b = _embed_span(layout)
     q = np.asarray(handle["q"]).astype(np.float32)
-    scale = np.asarray(handle["scale"])
+    scale = np.asarray(handle["scale"])  # [n, n_blocks]
     head = np.asarray(handle["head"]).astype(np.float32)
     tail = np.asarray(handle["tail"]).astype(np.float32)
     out = np.empty((q.shape[0], layout.width), dtype=np.float32)
     out[:, :a] = head
-    out[:, a:b] = q * scale[:, None]
+    for bi, (ba, bb) in enumerate(_embed_blocks(layout)):
+        out[:, ba:bb] = q[:, ba - a : bb - a] * scale[:, bi : bi + 1]
     out[:, b:] = tail
     return out
 
@@ -126,17 +146,18 @@ def send_rows(arr: np.ndarray, layout, mode: str):
     if mode == "bf16":
         return jnp.asarray(arr.astype(BF16)).astype(jnp.float32)
     a, b = _embed_span(layout)
-    emb = arr[:, a:b]
-    scale = np.maximum(np.abs(emb).max(axis=1), 1e-12) / 127.0
-    q = np.clip(np.rint(emb / scale[:, None]), -127, 127).astype(np.int8)
     out = jnp.empty((arr.shape[0], layout.width), dtype=jnp.float32)
     out = out.at[:, :a].set(
         jnp.asarray(arr[:, :a].astype(BF16)).astype(jnp.float32)
     )
-    out = out.at[:, a:b].set(
-        jnp.asarray(q).astype(jnp.float32)
-        * jnp.asarray(scale.astype(np.float32))[:, None]
-    )
+    for ba, bb in _embed_blocks(layout):
+        blk = arr[:, ba:bb]
+        scale = np.maximum(np.abs(blk).max(axis=1), 1e-12) / 127.0
+        q = np.clip(np.rint(blk / scale[:, None]), -127, 127).astype(np.int8)
+        out = out.at[:, ba:bb].set(
+            jnp.asarray(q).astype(jnp.float32)
+            * jnp.asarray(scale.astype(np.float32))[:, None]
+        )
     out = out.at[:, b:].set(
         jnp.asarray(arr[:, b:].astype(BF16)).astype(jnp.float32)
     )
@@ -151,4 +172,6 @@ def row_wire_nbytes(n: int, layout, mode: str) -> int:
     if mode == "bf16":
         return n * w * 2
     a, b = _embed_span(layout)
-    return n * ((b - a) + (w - (b - a)) * 2 + 4)  # int8 + bf16 rest + scale
+    n_blocks = len(_embed_blocks(layout))
+    # int8 region + bf16 rest + one fp32 scale per block
+    return n * ((b - a) + (w - (b - a)) * 2 + 4 * n_blocks)

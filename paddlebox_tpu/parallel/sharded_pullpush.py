@@ -67,15 +67,37 @@ def sharded_pull(
             table_local, req_recv.reshape(-1), layout, embedx_threshold, scale
         ).reshape(n, K, -1)
     # route value buckets back: row s = bucket answered by shard s.
-    # ici_wire_dtype=bf16 halves the ICI payload (the quant pull-value
-    # family of box_wrapper.cc:419-437, applied to the only wire this
-    # architecture still ships values over per batch); flag read at trace
-    # time, so the cast compiles into the fixed collective.
+    # ici_wire_dtype=bf16 halves the ICI payload, int8 quarters it (the
+    # quant pull-value family of box_wrapper.cc:419-437, applied to the
+    # only wire this architecture still ships values over per batch); flag
+    # read at trace time, so the cast compiles into the fixed collective.
+    # Either way the whole COUNTER/STAT head of the record — everything
+    # before embed_w, i.e. show/clk plus the conv/pcoc extras of wider
+    # cvm layouts — stays fp32: counts past 256 would round in bf16, and
+    # a 1e4-magnitude conv count sharing one int8 scale with 0.01
+    # embeddings would quantize them to zero.
     from paddlebox_tpu import config as _config
 
-    if str(_config.get_flag("ici_wire_dtype")) == "bf16":
-        resp = resp.astype(jnp.bfloat16)
-    resp_back = lax.all_to_all(resp, axis_name, 0, 0, tiled=True)
+    a = layout.embed_w_col  # first embedding-value column of the record
+    wd = str(_config.get_flag("ici_wire_dtype"))
+    if wd == "bf16":
+        counts = lax.all_to_all(resp[:, :, :a], axis_name, 0, 0, tiled=True)
+        vals = lax.all_to_all(
+            resp[:, :, a:].astype(jnp.bfloat16), axis_name, 0, 0, tiled=True
+        ).astype(jnp.float32)
+        resp_back = jnp.concatenate([counts, vals], axis=2)
+    elif wd == "int8":
+        counts = lax.all_to_all(resp[:, :, :a], axis_name, 0, 0, tiled=True)
+        v = resp[:, :, a:]
+        scale = jnp.maximum(jnp.abs(v).max(axis=2), 1e-12) / 127.0  # [n, K]
+        q = jnp.clip(jnp.rint(v / scale[..., None]), -127, 127).astype(jnp.int8)
+        qr = lax.all_to_all(q, axis_name, 0, 0, tiled=True)
+        sr = lax.all_to_all(scale, axis_name, 0, 0, tiled=True)
+        resp_back = jnp.concatenate(
+            [counts, qr.astype(jnp.float32) * sr[..., None]], axis=2
+        )
+    else:
+        resp_back = lax.all_to_all(resp, axis_name, 0, 0, tiled=True)
     return resp_back.reshape(n * K, -1).astype(jnp.float32)
 
 
@@ -102,14 +124,16 @@ def sharded_push(
     recs = jnp.concatenate(
         [show_bucket[:, None], clk_bucket[:, None], grads_bucket], axis=1
     ).reshape(n, K, gw + 2)
-    # push grads in bf16 over ICI when flagged. The two show/clk count
-    # columns stay fp32: bf16 is exact only to 256, and a hot key whose
-    # per-bucket count sums past that would round — drifting everything
-    # show-gated downstream (embedx unlock, shrink, cache thresholds).
-    # 2 of gw+2 columns, so the extra bytes are negligible.
+    # push grads in bf16 (half) or per-record-scaled int8 (quarter) over
+    # ICI when flagged. The two show/clk count columns stay fp32: bf16 is
+    # exact only to 256, and a hot key whose per-bucket count sums past
+    # that would round — drifting everything show-gated downstream (embedx
+    # unlock, shrink, cache thresholds). 2 of gw+2 columns, so the extra
+    # bytes are negligible.
     from paddlebox_tpu import config as _config
 
-    if str(_config.get_flag("ici_wire_dtype")) == "bf16":
+    wd = str(_config.get_flag("ici_wire_dtype"))
+    if wd == "bf16":
         counts = lax.all_to_all(
             recs[:, :, :2], axis_name, 0, 0, tiled=True
         )  # fp32 [n, K, 2]
@@ -117,6 +141,16 @@ def sharded_push(
             recs[:, :, 2:].astype(jnp.bfloat16), axis_name, 0, 0, tiled=True
         ).astype(jnp.float32)
         recs_recv = jnp.concatenate([counts, grads_recv], axis=2)
+    elif wd == "int8":
+        counts = lax.all_to_all(recs[:, :, :2], axis_name, 0, 0, tiled=True)
+        g = recs[:, :, 2:]
+        scale = jnp.maximum(jnp.abs(g).max(axis=2), 1e-12) / 127.0  # [n, K]
+        q = jnp.clip(jnp.rint(g / scale[..., None]), -127, 127).astype(jnp.int8)
+        qr = lax.all_to_all(q, axis_name, 0, 0, tiled=True)
+        sr = lax.all_to_all(scale, axis_name, 0, 0, tiled=True)
+        recs_recv = jnp.concatenate(
+            [counts, qr.astype(jnp.float32) * sr[..., None]], axis=2
+        )
     else:
         recs_recv = lax.all_to_all(recs, axis_name, 0, 0, tiled=True)
     ranks_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]

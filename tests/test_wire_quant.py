@@ -66,6 +66,38 @@ def test_int8_rows_keep_counters_and_embeds():
     np.testing.assert_array_equal(fetch_rows(jax.numpy.asarray(z), lay, "int8"), 0)
 
 
+def test_int8_per_block_scales_isolate_expand_outliers():
+    """embedx and expand quantize with SEPARATE per-row scales: a 10.0
+    outlier in the expand block must not crush 0.05-magnitude embedx values
+    to noise (a shared scale would give them one step of 10/127 ~ 0.08 —
+    larger than the values themselves)."""
+    lay = ValueLayout(embedx_dim=8, expand_embed_dim=8)
+    rng = np.random.default_rng(3)
+    x = _rows(rng, 64, lay)
+    # expand block: big outliers; embedx stays small
+    x[:, lay.expand_col : lay.expand_col + lay.expand_dim] = rng.normal(
+        0, 4.0, (64, lay.expand_dim)
+    )
+    x[:, lay.expand_col] = 10.0  # hard outlier in every row's expand block
+    for back in (
+        fetch_rows(jax.numpy.asarray(x), lay, "int8"),
+        np.asarray(send_rows(x, lay, "int8")),
+    ):
+        ax, bx = lay.embedx_col, lay.embedx_col + lay.embedx_dim
+        emb, emb_back = x[:, ax:bx], back[:, ax:bx]
+        # error bounded by the EMBEDX block's own scale (incl. embed_w col),
+        # NOT the expand outlier's
+        blk = x[:, lay.embed_w_col : lay.expand_col]
+        bound = np.abs(blk).max(axis=1, keepdims=True) / 254 + 1e-7
+        assert (np.abs(emb_back - emb) <= bound + 1e-6).all()
+        # a shared-scale quantizer could not meet this bound
+        assert bound.max() < 10.0 / 254
+        # expand block still within its own scale
+        ea, eb = lay.expand_col, lay.expand_col + lay.expand_dim
+        ebound = np.abs(x[:, ea:eb]).max(axis=1, keepdims=True) / 254 + 1e-7
+        assert (np.abs(back[:, ea:eb] - x[:, ea:eb]) <= ebound + 1e-6).all()
+
+
 def test_unknown_mode_raises():
     lay = ValueLayout(embedx_dim=4)
     with pytest.raises(ValueError):
@@ -126,6 +158,51 @@ def test_int8_boundary_wire_trains_sanely(tmp_path):
     )
 
 
+def _train_multi_pass_boundary(tmp_path, mode, n_passes=4):
+    """n overlapping carried-boundary passes under a wire_dtype; returns
+    the per-pass metric dicts (loss, auc, auc_cumulative)."""
+    from tests.test_carrier import _mk, _write_pass
+
+    prev_c = config.get_flag("enable_carried_table")
+    prev_w = config.get_flag("wire_dtype")
+    config.set_flag("enable_carried_table", 1)
+    config.set_flag("wire_dtype", mode)
+    try:
+        layout, table, ds, tr = _mk(tmp_path, seed=0)
+        outs = [tr.train_pass(ds)]
+        ds.end_pass(tr.trained_table_device())
+        for p in range(1, n_passes):
+            f = _write_pass(
+                tmp_path / f"p{p}.txt", seed=p, lo=1 + 80 * p, hi=200 + 80 * p
+            )
+            ds.set_filelist([f])
+            ds.load_into_memory()
+            ds.begin_pass(round_to=8)
+            outs.append(tr.train_pass(ds))
+            ds.end_pass(tr.trained_table_device())
+        table.drain_pending()
+        return outs
+    finally:
+        config.set_flag("enable_carried_table", prev_c)
+        config.set_flag("wire_dtype", prev_w)
+
+
+def test_int8_boundary_wire_auc_delta_pinned(tmp_path):
+    """Quality parity under int8, pinned: over a 4-pass run where every
+    boundary crosses the quantized wire, per-pass AUC must stay within
+    0.01 of fp32 training and cumulative AUC within 0.005 — the numeric
+    contract the reference's int16 quant family ships with
+    (box_wrapper.cc:419-437), not a loose 'trains sanely' bound."""
+    outs_f = _train_multi_pass_boundary(tmp_path / "f", "fp32")
+    outs_q = _train_multi_pass_boundary(tmp_path / "q", "int8")
+    assert np.isclose(outs_q[0]["loss"], outs_f[0]["loss"], atol=1e-6)
+    for i, (of, oq) in enumerate(zip(outs_f, outs_q)):
+        assert abs(oq["auc"] - of["auc"]) <= 0.01, (
+            f"pass {i}: int8 AUC {oq['auc']:.4f} vs fp32 {of['auc']:.4f}"
+        )
+    assert abs(outs_q[-1]["auc_cumulative"] - outs_f[-1]["auc_cumulative"]) <= 0.005
+
+
 def test_bf16_ici_wire_mesh_step(tmp_path):
     """Sharded pull/push with bf16 all_to_all payloads stays within bf16
     tolerance of the fp32 mesh step."""
@@ -183,6 +260,83 @@ def test_bf16_ici_wire_mesh_step(tmp_path):
     out_b, tab_b = run("bf16")
     assert np.isclose(out_b["loss"], out_f["loss"], atol=5e-3)
     np.testing.assert_allclose(tab_b, tab_f, rtol=2e-2, atol=2e-2)
+    # int8 ICI wire (per-record scale, counters fp32): looser but bounded
+    out_q, tab_q = run("int8")
+    assert np.isclose(out_q["loss"], out_f["loss"], atol=2e-2)
+    np.testing.assert_allclose(tab_q, tab_f, rtol=6e-2, atol=6e-2)
+    lay = ValueLayout(embedx_dim=4)
+    # show/clk counters ride fp32 in the int8 payload -> exact
+    # (tables are [ns, cap, W]; the counter columns live on the last axis)
+    np.testing.assert_allclose(
+        tab_q[..., lay.SHOW], tab_f[..., lay.SHOW], rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        tab_q[..., lay.CLK], tab_f[..., lay.CLK], rtol=1e-6, atol=1e-6
+    )
+
+
+def test_ici_wire_preserves_full_counter_head_conv_layout():
+    """The compressed ICI pull wire must keep the WHOLE counter/stat head
+    fp32 — on CONV layouts that includes the conversion count at column 2,
+    which can sit at 1e4 next to 0.01-magnitude embeddings: sharing one
+    int8 scale with it would quantize every embedding to zero (and bf16
+    would round the count itself past 256)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddlebox_tpu.parallel import make_mesh, sharded_pull
+    from paddlebox_tpu.table import FeatureType
+
+    lay = ValueLayout(embedx_dim=8, feature_type=FeatureType.CONV)
+    assert lay.embed_w_col == 3  # show, clk, conv | embed_w ...
+    ndev, cap = 4, 8
+    rng = np.random.default_rng(5)
+    tbl = rng.normal(0, 0.01, (ndev, cap, lay.width)).astype(np.float32)
+    tbl[:, :, lay.SHOW] = rng.integers(300, 5000, (ndev, cap))
+    tbl[:, :, lay.CLK] = rng.integers(0, 500, (ndev, cap))
+    tbl[:, :, 2] = rng.integers(1000, 30000, (ndev, cap))  # conv count
+    tbl[:, cap - 1] = 0.0  # padding row
+
+    plan = make_mesh(ndev)
+    K = 4
+    req = rng.integers(0, cap - 1, (ndev, ndev, K)).astype(np.int32)
+
+    def run(mode):
+        prev = config.get_flag("ici_wire_dtype")
+        config.set_flag("ici_wire_dtype", mode)
+        try:
+            mapped = jax.jit(
+                jax.shard_map(
+                    lambda t, r: sharded_pull(
+                        t[0], r[0], lay, 0.0, 1.0, plan.axis
+                    )[None],
+                    mesh=plan.mesh,
+                    in_specs=(P(plan.axis), P(plan.axis)),
+                    out_specs=P(plan.axis),
+                    check_vma=False,
+                )
+            )
+            return np.asarray(
+                mapped(
+                    jax.device_put(jnp.asarray(tbl), plan.table_sharding),
+                    jax.device_put(jnp.asarray(req), plan.batch_sharding),
+                )
+            )
+        finally:
+            config.set_flag("ici_wire_dtype", prev)
+
+    ref = run("fp32")
+    for mode in ("bf16", "int8"):
+        got = run(mode)
+        # counter/stat head (show, clk, conv) bit-exact
+        np.testing.assert_array_equal(got[..., :3], ref[..., :3], err_msg=mode)
+        # embeds within the EMBED value range's own quant resolution, not
+        # the conv counter's
+        emb_ref = ref[..., 3:]
+        bound = np.abs(emb_ref).max(axis=-1, keepdims=True) / (
+            120.0 if mode == "int8" else 250.0
+        ) + 1e-7
+        assert (np.abs(got[..., 3:] - emb_ref) <= bound).all(), mode
 
 
 def test_resident_counts_compression_upload_bytes(tmp_path):

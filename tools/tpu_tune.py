@@ -102,6 +102,30 @@ def main():
             }
         ),
     )
+    show(
+        "wire=int8",
+        run_bench(
+            {
+                "PBOX_RESIDENT_SCAN_BATCHES": best[1],
+                "PBOX_MAX_INFLIGHT_STEPS": best[2],
+                "PBOX_WIRE_DTYPE": "int8",
+                "PBOX_BENCH_INIT_TIMEOUT": 120,
+                "PBOX_BENCH_INIT_RETRIES": 1,
+            }
+        ),
+    )
+    # bytes-per-boundary-row under each wire format at the bench layout
+    # (what the ablation rows above are actually trading against quality)
+    sys.path.insert(0, REPO)
+    from bench import EMBEDX_DIM
+    from paddlebox_tpu.ops.wire_quant import row_wire_nbytes
+    from paddlebox_tpu.table import ValueLayout
+
+    lay = ValueLayout(embedx_dim=EMBEDX_DIM)
+    per_m = {m: row_wire_nbytes(1_000_000, lay, m) / 1e6 for m in
+             ("fp32", "bf16", "int8")}
+    print("row wire MB per 1M rows: "
+          + "  ".join(f"{m}={v:.1f}" for m, v in per_m.items()))
     # carried-table ablation: classic full writeback + re-upload boundary
     show(
         "carried=off",
