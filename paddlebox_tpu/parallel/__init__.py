@@ -19,6 +19,8 @@ from paddlebox_tpu.parallel.sharded_pullpush import (
 )
 from paddlebox_tpu.parallel.pipeline import (
     PipelineSpec,
+    hetero_mlp_stage_apply,
+    hetero_mlp_stage_init,
     init_pipeline_state,
     make_pipeline_train_step,
     pipeline_forward,
@@ -36,6 +38,8 @@ __all__ = [
     "sharded_pull",
     "sharded_push",
     "PipelineSpec",
+    "hetero_mlp_stage_apply",
+    "hetero_mlp_stage_init",
     "pipeline_forward",
     "make_pipeline_train_step",
     "init_pipeline_state",

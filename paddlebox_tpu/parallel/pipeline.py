@@ -21,10 +21,12 @@ Here the same structure compiles into ONE shard_map'd XLA program:
 - the optimize pass applies once per (global) batch on each stage's own
   params — grads never leave their stage, only activations move.
 
-Uniform-stage contract: every stage maps [mb, H] -> [mb, H]. Encoders /
-heads live inside the first/last stage's params (build_mlp_stages pads
-layer widths to H) — the same discipline the reference imposes by cutting
-one program into equal sections.
+Stage contract: every stage maps [mb, H] -> [mb, H] at the ACTIVATION HOP
+(static shapes keep the scan one XLA program), but stages need NOT be
+uniform inside: ``hetero_mlp_stage_init`` pads arbitrary per-stage layer
+counts and widths to [L, H, H] with exactness-preserving zero padding and
+identity gates, matching the reference's arbitrary program cut points
+(optimizer.py:5194) without giving up the single stacked-scan program.
 """
 
 from __future__ import annotations
@@ -215,6 +217,93 @@ def init_pipeline_state(
     sh = plan.sharded(axis)
     put = lambda t: jax.device_put(t, sh)
     return jax.tree.map(put, stacked), jax.tree.map(put, opt0)
+
+
+# ---- heterogeneous stages via padded stacking ---------------------------
+#
+# The reference cuts ONE program at arbitrary points (optimizer.py:5194
+# device_guard sections), so its stages have whatever shapes the cut
+# produces. The stacked-scan design above wants one uniform [n_stages, ...]
+# pytree — the TPU-native way to keep arbitrary cuts AND one XLA program is
+# to pad every stage to the max layer count L and max width H:
+#
+#   * width padding is exact for matmul+bias+relu chains: padded weight
+#     rows/cols and bias lanes are zero, so padded activation lanes stay
+#     zero through the whole net and their cotangents die at the next
+#     stage's zero weight rows — adam/sgd see zero grads and never move
+#     the padding;
+#   * layer-count padding uses a per-layer gate g in {0,1} (stop_gradient'd,
+#     so it is carried in the params pytree but never trained):
+#     w_eff = g*w + (1-g)*I and h' = g*relu(z) + (1-g)*z — a g=0 layer is
+#     an exact identity with zero grads into its (w, b).
+#
+# Cost: the padded matmuls run at [H, H]; for MXU-tiled H (128/256) the
+# padding rides lanes the systolic array would idle anyway.
+
+
+def hetero_mlp_stage_init(
+    rng, widths: Sequence[Sequence[int]]
+) -> Tuple[List[Any], List[List[Tuple[np.ndarray, np.ndarray]]]]:
+    """Per-stage params for a pipeline with DIFFERENT layer counts/widths.
+
+    ``widths[s] = [d_0, d_1, ..., d_k]`` — stage s maps width d_0 to d_k
+    through k relu layers. Consecutive stages must chain:
+    ``widths[s][-1] == widths[s+1][0]``.
+
+    Returns ``(stages, raw)``: ``stages`` are padded [L, H, H]/[L, H]/[L]
+    pytrees (identical structure, ready for ``init_pipeline_state``), and
+    ``raw`` holds the unpadded ``(w [d_in, d_out], b [d_out])`` numpy layers
+    for building a sequential equality reference in tests.
+    """
+    for s in range(len(widths) - 1):
+        if widths[s][-1] != widths[s + 1][0]:
+            raise ValueError(
+                f"stage {s} emits width {widths[s][-1]} but stage {s + 1} "
+                f"consumes {widths[s + 1][0]}"
+            )
+    H = max(max(w) for w in widths)
+    L = max(len(w) - 1 for w in widths)
+    stages, raw = [], []
+    for ws in widths:
+        w_pad = np.zeros((L, H, H), np.float32)
+        b_pad = np.zeros((L, H), np.float32)
+        gate = np.zeros((L,), np.float32)
+        layers = []
+        for l in range(len(ws) - 1):
+            d_in, d_out = ws[l], ws[l + 1]
+            rng, k = jax.random.split(rng)
+            w = np.asarray(
+                jax.random.normal(k, (d_in, d_out)) / np.sqrt(d_in),
+                np.float32,
+            )
+            b = np.zeros((d_out,), np.float32)
+            w_pad[l, :d_in, :d_out] = w
+            gate[l] = 1.0
+            layers.append((w, b))
+        stages.append({
+            "w": jnp.asarray(w_pad),
+            "b": jnp.asarray(b_pad),
+            "g": jnp.asarray(gate),
+        })
+        raw.append(layers)
+    return stages, raw
+
+
+def hetero_mlp_stage_apply(stage_params, x):
+    """[mb, H] -> [mb, H] over gated padded layers; exact identity where
+    g=0, exact relu-MLP where g=1 (see the padding invariants above)."""
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+
+    def layer(h, wbg):
+        w, b, g = wbg
+        g = lax.stop_gradient(g)  # structural gate, never trained
+        z = h @ (g * w + (1.0 - g) * eye) + g * b
+        return g * jax.nn.relu(z) + (1.0 - g) * z, None
+
+    h, _ = lax.scan(
+        layer, x, (stage_params["w"], stage_params["b"], stage_params["g"])
+    )
+    return h
 
 
 # ---- a simple homogeneous MLP stage for models/tests --------------------

@@ -1,0 +1,176 @@
+"""Non-uniform pipeline stages (reference: arbitrary program cut points,
+optimizer.py:5194 device_guard sections).
+
+The padded-stacking design must be EXACTLY the unpadded heterogeneous
+network — values and gradients — across training steps: zero width padding
+and identity layer gates may not leak into the real lanes, and the
+optimizer may not move the padding.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.parallel import (
+    PipelineSpec,
+    hetero_mlp_stage_apply,
+    hetero_mlp_stage_init,
+    init_pipeline_state,
+    make_mesh,
+    make_pipeline_train_step,
+    pipeline_forward,
+)
+from jax.sharding import PartitionSpec as P
+
+# 4 stages, heterogeneous widths AND layer counts; H = 16, L = 3
+WIDTHS = [[6, 10, 16], [16, 12], [12, 9, 14, 12], [12, 8]]
+N_STAGES = len(WIDTHS)
+D_IN, D_OUT, H = 6, 8, 16
+MB, M = 4, 6
+
+
+@pytest.fixture(scope="module")
+def built():
+    return hetero_mlp_stage_init(jax.random.PRNGKey(7), WIDTHS)
+
+
+def seq_forward(raw, x):
+    """Unpadded reference: the true heterogeneous relu MLP."""
+    for layers in raw:
+        for w, b in layers:
+            x = jax.nn.relu(x @ w + b)
+    return x
+
+
+def pad_x(x):
+    return jnp.pad(x, ((0, 0), (0, 0), (0, H - x.shape[-1])))
+
+
+def test_chain_mismatch_rejected():
+    with pytest.raises(ValueError, match="emits width"):
+        hetero_mlp_stage_init(jax.random.PRNGKey(0), [[4, 8], [6, 4]])
+
+
+def test_hetero_forward_matches_unpadded(built):
+    stages, raw = built
+    plan = make_mesh(N_STAGES, axis="pp")
+    spec = PipelineSpec(n_micro=M, axis_name="pp")
+    fwd = pipeline_forward(hetero_mlp_stage_apply, spec)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(M, MB, D_IN)).astype(np.float32))
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    mapped = jax.jit(
+        jax.shard_map(
+            lambda p, xm: fwd(jax.tree.map(lambda a: a[0], p), xm),
+            mesh=plan.mesh,
+            in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(mapped(jax.device_put(stacked, plan.batch_sharding),
+                            pad_x(x)))
+    want_real = np.asarray(
+        jax.vmap(lambda xx: seq_forward([[(jnp.asarray(w), jnp.asarray(b))
+                                          for w, b in ls] for ls in raw], xx))(x)
+    )
+    # real lanes match the unpadded net; padded lanes are exactly zero
+    np.testing.assert_allclose(got[..., :D_OUT], want_real, rtol=2e-5, atol=2e-5)
+    assert np.all(got[..., D_OUT:] == 0.0)
+
+
+def test_hetero_training_matches_unpadded(built):
+    """Multi-step adam on the padded pipeline == adam on the true
+    heterogeneous net: no grad leakage into padding, gates never trained."""
+    stages, raw = built
+    plan = make_mesh(N_STAGES, axis="pp")
+    spec = PipelineSpec(n_micro=M, axis_name="pp")
+    opt = optax.adam(1e-2)
+
+    def loss_fn(y, tgt):
+        return jnp.mean((y[..., :D_OUT] - tgt) ** 2)
+
+    step = make_pipeline_train_step(hetero_mlp_stage_apply, loss_fn, opt,
+                                    spec, plan)
+    state = init_pipeline_state(plan, stages, opt)
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(M, MB, D_IN)).astype(np.float32))
+    tgt = jnp.asarray(np.tanh(rng.normal(size=(M, MB, D_OUT))).astype(np.float32))
+
+    # unpadded reference trained with the same adam
+    ref_params = [[(jnp.asarray(w), jnp.asarray(b)) for w, b in ls]
+                  for ls in raw]
+
+    def ref_loss(ps):
+        y = jax.vmap(lambda xx: seq_forward(ps, xx))(x)
+        return jnp.mean(jax.vmap(lambda yy, tt: jnp.mean((yy - tt) ** 2))(y, tgt))
+
+    ref_opt = opt.init(ref_params)
+    xp = pad_x(x)
+    for i in range(5):
+        l_ref, g_ref = jax.value_and_grad(ref_loss)(ref_params)
+        upd, ref_opt = opt.update(g_ref, ref_opt, ref_params)
+        ref_params = optax.apply_updates(ref_params, upd)
+        state, loss = step(state, xp, tgt)
+        np.testing.assert_allclose(float(loss), float(l_ref), rtol=5e-5,
+                                   err_msg=f"step {i}")
+
+    # padded params: real blocks equal the reference, padding still zero,
+    # gates untouched
+    final = jax.tree.map(lambda a: np.asarray(a), state[0])
+    for s, ls in enumerate(raw):
+        for l, (w0, _) in enumerate(ls):
+            d_in, d_out = w0.shape
+            got_w = final["w"][s, l]
+            ref_w = np.asarray(ref_params[s][l][0])
+            np.testing.assert_allclose(got_w[:d_in, :d_out], ref_w,
+                                       rtol=5e-4, atol=5e-5)
+            assert np.all(got_w[d_in:, :] == 0.0)
+            assert np.all(got_w[:, d_out:] == 0.0)
+            np.testing.assert_allclose(final["b"][s, l, :d_out],
+                                       np.asarray(ref_params[s][l][1]),
+                                       rtol=5e-4, atol=5e-5)
+            assert np.all(final["b"][s, l, d_out:] == 0.0)
+    want_gate = np.zeros_like(final["g"])
+    for s, ws in enumerate(WIDTHS):
+        want_gate[s, : len(ws) - 1] = 1.0
+    np.testing.assert_array_equal(final["g"], want_gate)
+
+
+def test_hetero_composes_with_dp(built):
+    """pp x dp with heterogeneous stages: one step equals the 1-D run."""
+    from paddlebox_tpu.parallel.mesh import make_mesh_2d
+
+    widths2 = [[6, 10, 16], [16, 12, 8]]
+    stages2, _ = hetero_mlp_stage_init(jax.random.PRNGKey(9), widths2)
+    opt = optax.adam(1e-2)
+
+    def loss_fn(y, tgt):
+        return jnp.mean((y[..., :D_OUT] - tgt) ** 2)
+
+    rng = np.random.default_rng(2)
+    x = pad_x(jnp.asarray(rng.normal(size=(M, MB, D_IN)).astype(np.float32)))
+    tgt = jnp.asarray(np.tanh(rng.normal(size=(M, MB, D_OUT))).astype(np.float32))
+    spec = PipelineSpec(n_micro=M, axis_name="pp")
+
+    plan1 = make_mesh(2, axis="pp")
+    step1 = make_pipeline_train_step(hetero_mlp_stage_apply, loss_fn, opt,
+                                     spec, plan1)
+    st1 = init_pipeline_state(plan1, stages2, opt)
+    st1, loss1 = step1(st1, x, tgt)
+
+    plan2 = make_mesh_2d(2, 2)
+    step2 = make_pipeline_train_step(hetero_mlp_stage_apply, loss_fn, opt,
+                                     spec, plan2, dp_axis="dp")
+    st2 = init_pipeline_state(plan2, stages2, opt, axis="pp")
+    st2, loss2 = step2(st2, x, tgt)
+
+    np.testing.assert_allclose(float(loss2), float(loss1), rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(st2[0]), jax.tree.leaves(st1[0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
