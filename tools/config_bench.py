@@ -46,7 +46,37 @@ def write_files(tmpdir, rng, n_rows, n_slots, key_space):
     return [path]
 
 
-def run_config(name, model_fn, n_slots, batch, embedx, rows, batches, key_space):
+def convert_data_dir(data_dir: str, workdir: str):
+    """Real-format (Kaggle Criteo) dir -> converted slot-format files.
+
+    Every *.txt in the dir converts line-by-line via convert_criteo_line;
+    malformed/truncated lines take the reject path. Returns (files,
+    accepted, rejected)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from criteo_convergence import convert_criteo_line
+
+    out_files, n_ok, n_rej = [], 0, 0
+    for fn in sorted(os.listdir(data_dir)):
+        if not fn.endswith(".txt"):
+            continue
+        op = os.path.join(workdir, "conv-" + fn)
+        with open(os.path.join(data_dir, fn)) as fi, open(op, "w") as fo:
+            for line in fi:
+                s = line.rstrip("\n")
+                out = convert_criteo_line(s) if s else None
+                if out is None:
+                    n_rej += 1
+                    continue
+                fo.write(out + "\n")
+                n_ok += 1
+        out_files.append(op)
+    if not out_files or n_ok == 0:
+        raise ValueError(f"no usable *.txt lines under {data_dir}")
+    return out_files, n_ok, n_rej
+
+
+def run_config(name, model_fn, n_slots, batch, embedx, rows, batches,
+               key_space, data_files=None):
     import jax
     import optax
 
@@ -68,7 +98,11 @@ def run_config(name, model_fn, n_slots, batch, embedx, rows, batches, key_space)
     opt_cfg = SparseOptimizerConfig(embedx_threshold=0.0)
     table = HostSparseTable(layout, opt_cfg, n_shards=8, seed=0)
     with tempfile.TemporaryDirectory() as tmpdir:
-        files = write_files(tmpdir, rng, rows, n_slots, key_space)
+        files = (
+            data_files
+            if data_files is not None
+            else write_files(tmpdir, rng, rows, n_slots, key_space)
+        )
         ds = BoxPSDataset(schema, table, batch_size=batch, shuffle_mode="local", seed=0)
         ds.set_filelist(files)
         ds.load_into_memory()
@@ -100,11 +134,14 @@ def run_config(name, model_fn, n_slots, batch, embedx, rows, batches, key_space)
 def main():
     rows = 65_536
     batches = 24
+    data_dir = None
     for i, a in enumerate(sys.argv):
         if a == "--rows":
             rows = int(sys.argv[i + 1])
         if a == "--batches":
             batches = int(sys.argv[i + 1])
+        if a == "--data-dir":
+            data_dir = sys.argv[i + 1]
     info, _ = probe_backend_with_retries(
         float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "120"))
     )
@@ -156,16 +193,51 @@ def main():
             39, 1024, 8,
         ),
     ]
-    for name, fn, n_slots, batch, embedx in configs:
-        try:
-            r = run_config(
-                name, fn, n_slots, batch, embedx, rows, batches,
-                key_space=1 << 20,
-            )
-            r["platform"] = platform
-            print(json.dumps(r), flush=True)
-        except Exception as e:  # one config failing must not hide the rest
-            print(json.dumps({"config": name, "error": repr(e)[:300]}), flush=True)
+    data_ctx = tempfile.TemporaryDirectory() if data_dir else None
+    data_files = None
+    n_ok = n_rej = 0
+    if data_dir:
+        # real-format mode: every config runs the converted 39-slot Criteo
+        # stream (the day real data appears, point --data-dir at it);
+        # malformed lines take the reject path and are counted
+        data_files, n_ok, n_rej = convert_data_dir(data_dir, data_ctx.name)
+        print(
+            json.dumps({
+                "data_dir": data_dir, "accepted": n_ok, "rejected": n_rej,
+            }),
+            flush=True,
+        )
+    try:
+        for name, fn, n_slots, batch, embedx in configs:
+            n_batches = batches
+            if data_dir:
+                n_slots = 39  # the converted stream's slot count
+                if name.startswith("4-dcn"):
+                    from paddlebox_tpu.models import DCN as _DCN
+
+                    fn = lambda lay: _DCN(  # noqa: E731
+                        39, lay.pull_width, n_cross=3, hidden=(64, 32)
+                    )
+                # size this config to the real corpus (wraparound keeps
+                # shapes); per-config locals so one config's clamp can't
+                # leak into the next
+                batch = min(batch, max(64, n_ok // 4))
+                n_batches = min(batches, max(2, n_ok // batch))
+            try:
+                r = run_config(
+                    name, fn, n_slots, batch, embedx, rows, n_batches,
+                    key_space=1 << 20, data_files=data_files,
+                )
+                r["platform"] = platform
+                if data_dir:
+                    r["real_format"] = True
+                    r["rejected_lines"] = n_rej
+                print(json.dumps(r), flush=True)
+            except Exception as e:  # one config failing must not hide the rest
+                print(json.dumps({"config": name, "error": repr(e)[:300]}), flush=True)
+    finally:
+        if data_ctx is not None:
+            data_ctx.cleanup()
 
 
 if __name__ == "__main__":

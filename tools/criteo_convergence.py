@@ -50,24 +50,38 @@ CAT_MASK = (1 << 40) - 1
 
 
 def convert_criteo_line(line: str) -> str | None:
-    """One Kaggle train.txt line -> slot-format line (label + 39 slots)."""
+    """One Kaggle train.txt line -> slot-format line (label + 39 slots).
+
+    Returns None for malformed input — wrong column count, non-integer
+    label/int feature, non-hex categorical — the reject path a real crawl
+    needs (the reference's BufferedLineFileReader drops bad lines the same
+    way, data_feed.cc line-parse error branches)."""
     parts = line.rstrip("\n").split("\t")
     if len(parts) != 1 + N_INT + N_CAT:
         return None
     label = parts[0]
+    if label not in ("0", "1"):
+        return None
     out = [f"1 {label}.0"]
-    for i in range(N_INT):
-        v = parts[1 + i]
-        if v == "":
-            bucket = 0
-        else:
-            iv = int(v)
-            bucket = int(math.log2(iv + 1)) + 1 if iv >= 0 else 0
-        out.append(f"1 {(np.uint64(i) << np.uint64(40)) | np.uint64(bucket + 1)}")
-    for j in range(N_CAT):
-        v = parts[1 + N_INT + j]
-        key = int(v, 16) & CAT_MASK if v else 0
-        out.append(f"1 {(np.uint64(N_INT + j) << np.uint64(40)) | np.uint64(key + 1)}")
+    try:
+        for i in range(N_INT):
+            v = parts[1 + i]
+            if v == "":
+                bucket = 0
+            else:
+                iv = int(v)
+                bucket = int(math.log2(iv + 1)) + 1 if iv >= 0 else 0
+            out.append(
+                f"1 {(np.uint64(i) << np.uint64(40)) | np.uint64(bucket + 1)}"
+            )
+        for j in range(N_CAT):
+            v = parts[1 + N_INT + j]
+            key = int(v, 16) & CAT_MASK if v else 0
+            out.append(
+                f"1 {(np.uint64(N_INT + j) << np.uint64(40)) | np.uint64(key + 1)}"
+            )
+    except ValueError:
+        return None
     return " ".join(out)
 
 
