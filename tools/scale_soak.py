@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Scale soak: >=1e8 keys through the full multi-host pass path.
+
+SURVEY §7 hard-part-1 is the reference's 1e11-key tiered store contract
+(the closed lib's remit, cmake/external/box_ps.cmake:20-29); this harness
+measures how far THIS machine's open implementation actually scales and
+records the ceiling: a 2-process cluster (TcpTransport, real sockets)
+pushes a synthetic pass of --keys total referenced keys through
+
+  DistributedWorkingSet.finalize   (two-round key exchange + local build)
+  pbx_block_stats                  (the pass-prepare pad sweep at scale)
+  writeback + decay_and_shrink     (host-table publish at scale)
+  maybe_spill + compaction         (mem_cap_rows forces the disk tier)
+
+and dumps per-stage wall times, peak RSS, and spill/compaction counters to
+SOAK_r05.json. Pass sizing: each rank references keys/2 uint64 keys with
+~25% cross-rank overlap (the CTR recurrence shape), so the exchange routes
+a realistic mix of owned and remote keys.
+
+  python tools/scale_soak.py [--keys 1e8] [--out SOAK_r05.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def worker(rank: int, conf: dict) -> None:
+    import numpy as np
+
+    from paddlebox_tpu.parallel.transport import TcpTransport
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+    from paddlebox_tpu.utils import native
+
+    n_keys_local = conf["keys"] // 2
+    layout = ValueLayout(embedx_dim=conf["embedx_dim"])
+    opt = SparseOptimizerConfig(
+        embedx_threshold=0.0, show_clk_decay=0.98, shrink_threshold=0.0
+    )
+    spill_dir = os.path.join(conf["workdir"], f"spill-{rank}")
+    os.makedirs(spill_dir, exist_ok=True)
+    table = HostSparseTable(
+        layout, opt, n_shards=64, seed=0,
+        mem_cap_rows=conf["mem_cap_rows"], spill_dir=spill_dir,
+    )
+    eps = [f"127.0.0.1:{p}" for p in conf["tp_ports"]]
+    tp = TcpTransport(rank, eps, timeout=600.0)
+    out = {"rank": rank, "keys_local": n_keys_local}
+
+    rng = np.random.default_rng(rank)
+    # ~25% of keys drawn from a shared pool (cross-rank overlap), the rest
+    # rank-disjoint — the exchange routes a realistic owned/remote mix
+    shared = rng.integers(1, conf["keys"] // 4, n_keys_local // 4).astype(
+        np.uint64
+    )
+    own_lo = 1 << 40
+    own = (
+        rng.integers(0, 1 << 39, n_keys_local - len(shared)).astype(np.uint64)
+        + np.uint64(own_lo + (rank << 39))
+    )
+    keys = np.concatenate([shared, own])
+
+    ws = DistributedWorkingSet(tp, n_mesh_shards=conf["n_shards_mesh"])
+    t0 = time.perf_counter()
+    ws.add_keys(keys)
+    out["add_keys_s"] = round(time.perf_counter() - t0, 3)
+    del keys, shared, own
+
+    t0 = time.perf_counter()
+    dev = ws.finalize(table, round_to=4096)
+    out["finalize_s"] = round(time.perf_counter() - t0, 3)
+    out["referenced"] = int(ws.n_keys)
+    out["capacity"] = int(ws.capacity)
+    owned = sum(len(k) for k in ws.owned_shard_keys)
+    out["owned"] = int(owned)
+
+    # pad sweep at scale: synthetic records over the referenced keys (20
+    # keys/record), swept by the native pbx_block_stats batch matrix
+    if native.available():
+        kpr = 20
+        n_rec = ws.n_keys // kpr
+        rows_all = ws.row_of_sorted.astype(np.int32)
+        rec_rows = rows_all[: n_rec * kpr]
+        base = (np.arange(n_rec, dtype=np.int64)) * kpr
+        counts = np.full(n_rec, kpr, dtype=np.int64)
+        bs = 2048
+        n_blocks = min(512, n_rec // bs)
+        blocks = (
+            np.random.default_rng(1)
+            .integers(0, n_rec, (n_blocks, bs))
+            .astype(np.int64)
+        )
+        t0 = time.perf_counter()
+        L, bm = native.block_stats(
+            rec_rows, base, counts, blocks,
+            ws.capacity, conf["n_shards_mesh"],
+        )
+        out["sweep_s"] = round(time.perf_counter() - t0, 3)
+        out["sweep_blocks"] = int(n_blocks)
+        out["sweep_records"] = int(n_blocks * bs)
+        out["sweep_max_bucket"] = int(bm.max())
+        del rec_rows, base, counts, blocks
+
+    # publish: perturb the local slice and write it back (EndPass shape)
+    t0 = time.perf_counter()
+    dev[:, :, layout.SHOW] += 1.0
+    ws.writeback(dev)
+    out["writeback_s"] = round(time.perf_counter() - t0, 3)
+    del dev
+
+    t0 = time.perf_counter()
+    table.decay_and_shrink()
+    out["decay_s"] = round(time.perf_counter() - t0, 3)
+
+    t0 = time.perf_counter()
+    if table.mem_cap_rows is not None:
+        table.maybe_spill()
+    out["spill_s"] = round(time.perf_counter() - t0, 3)
+    stats = getattr(table, "spill_stats", None)
+    if callable(stats):
+        out["spill_stats"] = stats()
+
+    out["peak_rss_gb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20), 2
+    )
+    tp.barrier("soak-done")
+    tp.close()
+    with open(os.path.join(conf["workdir"], f"soak-{rank}.json"), "w") as f:
+        json.dump(out, f)
+    print(f"rank {rank}: {json.dumps(out)}", flush=True)
+
+
+def main() -> int:
+    keys = int(float(next(
+        (sys.argv[i + 1] for i, a in enumerate(sys.argv) if a == "--keys"),
+        "1e8",
+    )))
+    out_path = next(
+        (sys.argv[i + 1] for i, a in enumerate(sys.argv) if a == "--out"),
+        os.path.join(REPO, "SOAK_r05.json"),
+    )
+    if "--worker" in sys.argv:
+        rank = int(sys.argv[sys.argv.index("--worker") + 1])
+        with open(sys.argv[sys.argv.index("--conf") + 1]) as f:
+            worker(rank, json.load(f))
+        return 0
+
+    with tempfile.TemporaryDirectory() as workdir:
+        conf = {
+            "keys": keys,
+            "embedx_dim": 8,
+            "n_shards_mesh": 8,
+            # cap at ~60% of expected owned rows: forces the spill tier
+            "mem_cap_rows": int(keys / 2 * 0.6),
+            "tp_ports": _free_ports(2),
+            "workdir": workdir,
+        }
+        conf_path = os.path.join(workdir, "conf.json")
+        with open(conf_path, "w") as f:
+            json.dump(conf, f)
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 str(r), "--conf", conf_path],
+                cwd=REPO,
+            )
+            for r in range(2)
+        ]
+        rc = [p.wait() for p in procs]
+        wall = time.perf_counter() - t0
+        if any(rc):
+            print(f"soak failed: rc={rc}", file=sys.stderr)
+            return 1
+        ranks = []
+        for r in range(2):
+            with open(os.path.join(workdir, f"soak-{r}.json")) as f:
+                ranks.append(json.load(f))
+    result = {
+        "metric": "multihost_pass_scale_soak",
+        "keys_total": keys,
+        "wall_s": round(wall, 1),
+        "ranks": ranks,
+        "machine": {"cpus": os.cpu_count()},
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({
+        "keys": keys, "wall_s": round(wall, 1),
+        "finalize_s": [r["finalize_s"] for r in ranks],
+        "peak_rss_gb": [r["peak_rss_gb"] for r in ranks],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
