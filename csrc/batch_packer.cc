@@ -129,35 +129,73 @@ void pbx_gather_f32_slot(const float* values, const int64_t* base,
 // rows: int32 [total_keys] pass-local row per key occurrence;
 // base/counts: int64 [n_records] flat key span per record;
 // indices: int64 [n_blocks * b] record ids, row-major blocks.
-// Dedup is epoch-stamped by block id over the n_rows id space; per-shard
-// unique counters reset per block (ns is small). Returns 0, or -1 on an
-// out-of-range record/row.
+// Dedup is a per-block gather + sort + run walk: work scales with the
+// block's key count, never with the table's row count (an epoch-stamp
+// table over the row id space would memset O(n_rows) per CALL — at a
+// 45M-row pass that is 365 MB of writes before any work). The scratch
+// buffer reuses its high-water allocation across blocks. Returns 0, or
+// -1 on an out-of-range record/row.
 int pbx_block_stats(const int32_t* rows, const int64_t* base,
                     const int64_t* counts, int64_t n_records,
                     const int64_t* indices, int64_t n_blocks, int64_t b,
                     int64_t cap, int64_t ns, int64_t n_rows,
                     int64_t* L_out, int64_t* bmax_out) {
-  std::vector<int64_t> stamp((size_t)n_rows, -1);
-  std::vector<int64_t> scnt((size_t)ns, 0);
+  std::vector<uint32_t> buf, tmp;
   for (int64_t blk = 0; blk < n_blocks; ++blk) {
-    std::fill(scnt.begin(), scnt.end(), 0);
-    int64_t L = 0, bmax = 0;
     const int64_t* idx = indices + blk * b;
+    int64_t L = 0;
     for (int64_t i = 0; i < b; ++i) {
       const int64_t r = idx[i];
-      if (r < 0 || r >= n_records) return -1;
-      const int64_t a = base[r];
-      const int64_t e = a + counts[r];
+      if (r < 0 || r >= n_records || counts[r] < 0) return -1;
       L += counts[r];
-      for (int64_t j = a; j < e; ++j) {
-        const int32_t row = rows[j];
-        if (row < 0 || row >= n_rows) return -1;
-        if (stamp[row] != blk) {
-          stamp[row] = blk;
-          const int64_t c = ++scnt[row / cap];
-          if (c > bmax) bmax = c;
-        }
+    }
+    buf.resize((size_t)L);
+    tmp.resize((size_t)L);
+    // gather: each record's key rows are contiguous -> one memcpy per
+    // record (rows are validated against n_rows during the run walk via
+    // the max; negative values wrap to huge uint32 and fail the check)
+    size_t w = 0;
+    for (int64_t i = 0; i < b; ++i) {
+      const int64_t r = idx[i];
+      const int64_t c = counts[r];
+      std::memcpy(buf.data() + w, rows + base[r], (size_t)c * sizeof(int32_t));
+      w += (size_t)c;
+    }
+    // LSD radix sort, 4x8-bit passes: ~3-5x faster than comparison sort
+    // at the 1e5-1e6 keys a device block carries
+    uint32_t maxv = 0;
+    for (size_t k = 0; k < w; ++k) maxv = buf[k] > maxv ? buf[k] : maxv;
+    // compare in int64: a uint32-truncated n_rows would falsely reject
+    // everything at exactly 2^32 rows (negative int32 rows arrive here
+    // wrapped to huge uint32 values, so they fail this check too)
+    if ((int64_t)maxv >= n_rows) return -1;
+    uint32_t cnt[256];
+    for (int shift = 0; shift < 32 && (maxv >> shift); shift += 8) {
+      std::memset(cnt, 0, sizeof(cnt));
+      for (size_t k = 0; k < w; ++k) ++cnt[(buf[k] >> shift) & 0xFF];
+      uint32_t run = 0;
+      for (int v = 0; v < 256; ++v) {
+        const uint32_t c = cnt[v];
+        cnt[v] = run;
+        run += c;
       }
+      for (size_t k = 0; k < w; ++k) tmp[cnt[(buf[k] >> shift) & 0xFF]++] = buf[k];
+      buf.swap(tmp);
+    }
+    // unique runs, counted per shard (rows are shard-major: shard=row/cap)
+    int64_t bmax = 0, scur = -1, c = 0;
+    uint32_t prev = 0xFFFFFFFFu;
+    for (size_t k = 0; k < w; ++k) {
+      const uint32_t row = buf[k];
+      if (row == prev) continue;
+      prev = row;
+      const int64_t s = (int64_t)row / cap;
+      if (s >= ns) return -1;  // row beyond the [ns, cap] shard grid
+      if (s != scur) {
+        scur = s;
+        c = 0;
+      }
+      if (++c > bmax) bmax = c;
     }
     L_out[blk] = L;
     bmax_out[blk] = bmax;
