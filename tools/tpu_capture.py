@@ -6,9 +6,11 @@ Runs, in order of value-per-minute (so even a short healthy window yields
 a usable artifact — the file is (re)written after every stage):
 
   1. headline   bench.py at the default knobs (resident + carrier + bf16)
-  2. ablations  wire=fp32, wire=int8, carried=off, pv join phase
-  3. scatter    tools/op_probe.py --scatter-sweep (the SCATTER_NOTES
-                decision input: push floor vs padded-width candidates)
+  2. scatter    tools/op_probe.py --scatter-sweep (the SCATTER_NOTES
+                decision input: push floor vs padded-width candidates —
+                round 5's window closed before this stage, so it now runs
+                SECOND: it is the only item never measured on hardware)
+  3. ablations  wire=fp32, wire=int8, carried=off, pv join phase
   4. sweep      bench.py across (resident_scan_batches x max_inflight)
 
 Writes tools/last_good_tpu_capture.json after each stage and appends a
@@ -98,8 +100,26 @@ def main() -> int:
         return 1
     _save(cap)
 
-    # -- 2. ablations at default knobs (the VERDICT-required sub-fields
-    # first: carrier / wire / pv — each one bench run) -------------------
+    # -- 2. scatter decision sweep (SCATTER_NOTES adopt/reject input): the
+    # only item with ZERO hardware measurements across five rounds runs
+    # right after the headline ------------------------------------------
+    print("[capture] scatter sweep...", file=sys.stderr, flush=True)
+    try:
+        p = subprocess.run(
+            [sys.executable, "tools/op_probe.py", "--scatter-sweep"],
+            cwd=REPO, capture_output=True, text=True, timeout=900,
+        )
+        cap["scatter_sweep"] = {
+            "rc": p.returncode,
+            "stdout": p.stdout[-8000:],
+            "stderr": p.stderr[-2000:],
+        }
+    except subprocess.TimeoutExpired:
+        cap["scatter_sweep"] = {"error": "op_probe timed out after 900s"}
+    _save(cap)
+
+    # -- 3. ablations at default knobs (the VERDICT-required sub-fields:
+    # carrier / wire / pv — each one bench run) --------------------------
     ablations = {}
     for name, env_extra in [
         ("carried_off", {"PBOX_ENABLE_CARRIED_TABLE": 0}),
@@ -115,22 +135,6 @@ def main() -> int:
         )
         cap["ablations"] = ablations
         _save(cap)
-
-    # -- 3. scatter decision sweep (SCATTER_NOTES adopt/reject input) -----
-    print("[capture] scatter sweep...", file=sys.stderr, flush=True)
-    try:
-        p = subprocess.run(
-            [sys.executable, "tools/op_probe.py", "--scatter-sweep"],
-            cwd=REPO, capture_output=True, text=True, timeout=900,
-        )
-        cap["scatter_sweep"] = {
-            "rc": p.returncode,
-            "stdout": p.stdout[-8000:],
-            "stderr": p.stderr[-2000:],
-        }
-    except subprocess.TimeoutExpired:
-        cap["scatter_sweep"] = {"error": "op_probe timed out after 900s"}
-    _save(cap)
 
     # -- 4. knob sweep ----------------------------------------------------
     combos = [(8, 2), (16, 2)] if quick else [(4, 2), (8, 1), (8, 2), (8, 4), (16, 2), (32, 2)]
