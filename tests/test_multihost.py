@@ -427,6 +427,35 @@ def test_two_process_carried_boundary_matches_classic(tmp_path):
         )
 
 
+def test_four_process_carried_boundary_matches_classic(tmp_path):
+    """The per-host carrier is rank-count-general too: same carried ==
+    classic equality at 4 ranks x 2 local devices (8 table shards, 2 per
+    host, 1 per device)."""
+    files = _write_overlapping_pass_files(tmp_path, n_passes=2, files_per_pass=4)
+    conf = {"files_per_pass": 4}
+    (tmp_path / "car").mkdir()
+    car = _run_cluster(
+        tmp_path / "car", "carried", files, 16, False, n_ranks=4,
+        extra_env={"PBOX_ENABLE_CARRIED_TABLE": "1"}, extra_conf=conf,
+    )
+    (tmp_path / "cls").mkdir()
+    cls = _run_cluster(
+        tmp_path / "cls", "carried", files, 16, False, n_ranks=4,
+        extra_env={"PBOX_ENABLE_CARRIED_TABLE": "0"}, extra_conf=conf,
+    )
+    for r in range(4):
+        assert int(car[r]["spliced_passes"][0]) == 1
+        assert int(car[r]["splice_common"][0]) > 0
+        assert int(cls[r]["spliced_passes"][0]) == 0
+        np.testing.assert_allclose(
+            car[r]["losses"], cls[r]["losses"], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_array_equal(car[r]["host_keys"], cls[r]["host_keys"])
+        np.testing.assert_allclose(
+            car[r]["host_vals"], cls[r]["host_vals"], rtol=1e-5, atol=1e-6
+        )
+
+
 def _write_pv_files(tmp_path, n_even_queries, n_odd_queries, n_files=2):
     """Logkey'd pv data with a skewed search_id parity split: after
     search_id-mode global shuffle, rank 0 owns ~n_even and rank 1 ~n_odd
