@@ -179,6 +179,7 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "tools", "last_good_tpu_bench.json")
 CAPTURE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "tools", "last_good_tpu_capture.json")
+CAPTURE_LOCK_PATH = CAPTURE_PATH + ".lock"  # shared with tools/tpu_capture.py
 PROBE_LOOP_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "tools", "tpu_probe_log.jsonl")
 
@@ -256,8 +257,47 @@ def fail_fast(reason: str) -> None:
     sys.exit(1)
 
 
+def wait_for_capture_lock() -> None:
+    """If a tpu_capture.py run is in flight (lock file with a live pid),
+    wait for it instead of racing it: two benches sharing one chip and one
+    host core degrade BOTH numbers. Skipped inside the capture itself
+    (PBOX_BENCH_NO_LOCK_WAIT) and bounded so a driver-budgeted run is
+    never starved — after the wait the capture artifact is fresh and this
+    run either measures a free chip or embeds the capture."""
+    if os.environ.get("PBOX_BENCH_NO_LOCK_WAIT", "0") == "1":
+        return
+    lock = CAPTURE_LOCK_PATH
+    budget = float(os.environ.get("PBOX_BENCH_CAPTURE_WAIT", "2400"))
+    t0 = time.time()
+    warned = False
+    while time.time() - t0 < budget:
+        try:
+            with open(lock) as f:
+                pid = int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            return
+        if pid <= 0:
+            return  # truncated/garbage lock: os.kill(0, ...) would probe
+            # our own process group and "succeed" forever
+        try:
+            os.kill(pid, 0)  # liveness probe, no signal delivered
+        except ProcessLookupError:
+            return  # stale lock
+        except PermissionError:
+            pass  # pid EXISTS under another uid: the capture is live, wait
+        if not warned:
+            print(
+                f"bench: capture in flight (pid {pid}), waiting up to "
+                f"{budget:.0f}s for it to finish",
+                file=sys.stderr, flush=True,
+            )
+            warned = True
+        time.sleep(15)
+
+
 def main():
     profile = "--profile" in sys.argv
+    wait_for_capture_lock()
     timeout_s = float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "120"))
     info, probe_log = probe_backend_with_retries(timeout_s)
     tpu_error = None

@@ -35,7 +35,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bench import CAPTURE_PATH, bench_config_id  # noqa: E402
+from bench import CAPTURE_LOCK_PATH, CAPTURE_PATH, bench_config_id  # noqa: E402
 
 HISTORY_PATH = os.path.join(REPO, "tools", "tpu_capture_history.jsonl")
 
@@ -52,6 +52,8 @@ def run_bench(env_extra: dict, timeout: float = 480):
     # and a wedge mid-capture should fail fast, not burn the window
     env.setdefault("PBOX_BENCH_INIT_RETRIES", "1")
     env.setdefault("PBOX_BENCH_INIT_TIMEOUT", "150")
+    # our own bench children must not wait on our own capture lock
+    env["PBOX_BENCH_NO_LOCK_WAIT"] = "1"
     try:
         p = subprocess.run(
             [sys.executable, "bench.py"],
@@ -82,6 +84,26 @@ def _save(cap: dict) -> None:
 
 def main() -> int:
     quick = "--quick" in sys.argv
+    # advertise the in-flight capture so a concurrently-launched bench.py
+    # (e.g. the driver's round-end run) waits instead of sharing the chip
+    # and the host core with us — racing degrades BOTH measurements.
+    # tmp + atomic rename inside the try: a half-written (empty) lock must
+    # never persist, and a failed write must still unlink
+    try:
+        tmp = f"{CAPTURE_LOCK_PATH}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(str(os.getpid()))
+        os.replace(tmp, CAPTURE_LOCK_PATH)
+        return _main_locked(quick)
+    finally:
+        for p in (tmp, CAPTURE_LOCK_PATH):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def _main_locked(quick: bool) -> int:
     cap = {
         "started_at": _now(),
         "bench_config": bench_config_id(),
