@@ -53,11 +53,18 @@ class DistributedStrategy:
     def __post_init__(self):
         if self.a_sync and self.localsgd:
             raise ValueError("a_sync and localsgd are mutually exclusive")
-        if self.pipeline and (self.a_sync or self.localsgd or self.sharding):
+        if self.pipeline and (self.a_sync or self.localsgd):
             raise ValueError(
-                "pipeline composes with none of a_sync/localsgd/sharding "
-                "here: pipeline stages own their params (no DP dense sync "
-                "to reconfigure, and Zero1 chunks need the dp axis)"
+                "pipeline composes with neither a_sync nor localsgd here: "
+                "pipeline stages own their params — there is no DP dense "
+                "sync to reconfigure"
+            )
+        if self.pipeline and self.sharding and self.pipeline_dp_degree < 2:
+            raise ValueError(
+                "pipeline + sharding needs a dp axis to chunk over: set "
+                "pipeline_configs['dp_degree'] > 1 (pp x dp mesh; pass a "
+                "Zero1Optimizer over the dp axis to "
+                "make_pipeline_train_step)"
             )
 
     # ---- translation ----------------------------------------------------

@@ -48,13 +48,31 @@ class Zero1Optimizer:
     # These guards turn the wrong-path AttributeError into a real message.
     def init(self, params):
         raise RuntimeError(
-            "Zero1Optimizer state is mesh-sharded: it runs only inside "
-            "make_sharded_train_step (init via init_sharded_train_state). "
-            "For single-device or pipeline training use the inner optimizer."
+            "Zero1Optimizer state is mesh-sharded: it runs inside "
+            "make_sharded_train_step (init via init_sharded_train_state) "
+            "or make_pipeline_train_step with dp_axis (init via "
+            "init_pipeline_state). For single-device training use the "
+            "inner optimizer."
         )
 
     def update(self, grads, state, params=None):
         self.init(params)  # same message
+
+    def check_axis(self, axis_name: str, n_axis: int) -> None:
+        """Validate this optimizer against the mesh axis it will chunk
+        over (one chunk per device along that axis). Single source for the
+        checks every consumer (sharded step, pipeline step, state init)
+        must make — they would otherwise drift apart."""
+        if self.axis_name != axis_name:
+            raise ValueError(
+                f"Zero1Optimizer chunks over axis {self.axis_name!r}, "
+                f"step/state built for axis {axis_name!r}"
+            )
+        if self.n_dev != n_axis:
+            raise ValueError(
+                f"Zero1Optimizer built for {self.n_dev} devices, axis "
+                f"{axis_name!r} has {n_axis}"
+            )
 
     def _chunks(self, tree: Any) -> Tuple[jnp.ndarray, Any, int]:
         """ravel -> pad -> [n_dev, c]; returns (chunks, unravel, true_len)."""

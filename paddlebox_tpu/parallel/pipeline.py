@@ -121,7 +121,16 @@ def make_pipeline_train_step(
     microbatch (x_micro/targets split on the mb axis over dp), and stage
     grads pmean over dp before the local update, exactly the reference's
     PipelineTrainer-sections x fleet-DP-ranks layering.
+
+    ``dense_opt`` may be a ``Zero1Optimizer`` over ``dp_axis``: each dp
+    replica of a stage then holds 1/n_dp of that stage's optimizer moments
+    and updates only its chunk (all_gather over dp rebuilds the full
+    update) — pipeline x sharding, the fleet sharding meta-optimizer
+    layered under PipelineTrainer sections. Bit-compatible with the plain
+    inner optimizer for elementwise transforms.
     """
+    from paddlebox_tpu.fleet.zero import Zero1Optimizer
+
     if spec.axis_name not in plan.mesh.axis_names:
         raise ValueError(
             f"PipelineSpec.axis_name {spec.axis_name!r} not a mesh axis "
@@ -133,13 +142,24 @@ def make_pipeline_train_step(
             f"dp_axis {dp_axis!r} not a mesh axis {plan.mesh.axis_names}; "
             "build a 2-D mesh with make_mesh_2d(n_pp, n_dp)"
         )
+    is_zero = isinstance(dense_opt, Zero1Optimizer)
+    if is_zero:
+        if dp_axis is None:
+            raise ValueError(
+                "pipeline ZeRO-1 shards optimizer state over the dp axis: "
+                "pass dp_axis= on a pp x dp mesh"
+            )
+        dense_opt.check_axis(dp_axis, int(plan.mesh.shape[dp_axis]))
     fwd = pipeline_forward(stage_apply, spec, broadcast=False)
     ax = spec.axis_name
 
     def local_step(state, x_micro, targets):
         params, opt_state = state
         p_local = jax.tree.map(lambda x: x[0], params)
-        o_local = jax.tree.map(lambda x: x[0], opt_state)
+        # ZeRO-1 state carries a second (dp-sharded) leading axis
+        o_local = jax.tree.map(
+            (lambda x: x[0, 0]) if is_zero else (lambda x: x[0]), opt_state
+        )
 
         def batch_loss(p):
             y = fwd(p, x_micro)  # [M, mb, H], zeros off the last stage
@@ -163,16 +183,25 @@ def make_pipeline_train_step(
             loss = lax.pmean(loss, dp_axis)
         # grads arrive on the stage that owns each parameter (autodiff of
         # ppermute routes them); the update pass is purely local —
-        # SectionWorker's kOptimize-on-microbatch-0 parity
-        updates, new_opt = dense_opt.update(grads, o_local, p_local)
+        # SectionWorker's kOptimize-on-microbatch-0 parity. Under ZeRO-1
+        # each dp replica updates only its chunk of this stage's params
+        # (moments sharded 1/n_dp) and all_gathers the update over dp.
+        if is_zero:
+            updates, new_opt = dense_opt.update_local(grads, o_local, p_local)
+        else:
+            updates, new_opt = dense_opt.update(grads, o_local, p_local)
         new_p = optax.apply_updates(p_local, updates)
         new_state = (
             jax.tree.map(lambda x: x[None], new_p),
-            jax.tree.map(lambda x: x[None], new_opt),
+            jax.tree.map(
+                (lambda x: x[None, None]) if is_zero else (lambda x: x[None]),
+                new_opt,
+            ),
         )
         return new_state, loss
 
     pp = P(ax)
+    opt_spec = P(ax, dp_axis) if is_zero else pp
     rep = P()
     # microbatches split their mb axis over dp when composed
     data = rep if dp_axis is None else P(None, dp_axis)
@@ -181,7 +210,7 @@ def make_pipeline_train_step(
         params, opt_state = state
         specs_state = (
             jax.tree.map(lambda _: pp, params),
-            jax.tree.map(lambda _: pp, opt_state),
+            jax.tree.map(lambda _: opt_spec, opt_state),
         )
         mapped = jax.shard_map(
             local_step,
@@ -200,12 +229,18 @@ def init_pipeline_state(
     stage_params: Sequence[Any],  # one pytree per stage, identical structure
     dense_opt: optax.GradientTransformation,
     axis: Optional[str] = None,
+    dp_axis: Optional[str] = None,
 ) -> Tuple[Any, Any]:
     """Stack per-stage params along a leading pp-sharded axis + opt state.
 
     ``axis`` names the pipeline axis; defaults to the plan's axis (the 1-D
     pipeline mesh). On a 2-D pp x dp mesh pass the pp axis explicitly —
-    stages shard over it and replicate over dp."""
+    stages shard over it and replicate over dp. With a ``Zero1Optimizer``
+    (pass ``dp_axis`` too) the optimizer state gains a second leading axis
+    [n_stages, n_dp, ...] sharded (pp, dp), so each dp replica physically
+    holds 1/n_dp of its stage's moments."""
+    from paddlebox_tpu.fleet.zero import Zero1Optimizer
+
     axis = axis or plan.axis
     n = int(plan.mesh.shape[axis])
     if len(stage_params) != n:
@@ -213,9 +248,21 @@ def init_pipeline_state(
             f"{len(stage_params)} stages for a {n}-stage {axis!r} axis"
         )
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
-    opt0 = jax.vmap(dense_opt.init)(stacked)
     sh = plan.sharded(axis)
     put = lambda t: jax.device_put(t, sh)
+    if isinstance(dense_opt, Zero1Optimizer):
+        if dp_axis is None:
+            raise ValueError(
+                "Zero1Optimizer pipeline state needs dp_axis= (pp x dp mesh)"
+            )
+        dense_opt.check_axis(dp_axis, int(plan.mesh.shape[dp_axis]))
+        opt0 = jax.vmap(dense_opt.init_stacked)(stacked)  # [n_pp, n_dp, ...]
+        sh_opt = plan.sharded(axis, dp_axis)
+        return (
+            jax.tree.map(put, stacked),
+            jax.tree.map(lambda t: jax.device_put(t, sh_opt), opt0),
+        )
+    opt0 = jax.vmap(dense_opt.init)(stacked)
     return jax.tree.map(put, stacked), jax.tree.map(put, opt0)
 
 

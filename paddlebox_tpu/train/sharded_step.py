@@ -56,10 +56,7 @@ def init_sharded_train_state(
     if isinstance(dense_opt, Zero1Optimizer):
         if local_dense:
             raise ValueError("ZeRO sharding and kstep local replicas conflict")
-        if dense_opt.n_dev != n:
-            raise ValueError(
-                f"Zero1Optimizer built for {dense_opt.n_dev} devices, mesh has {n}"
-            )
+        dense_opt.check_axis(plan.axis, n)
         # moment chunks live dp-sharded: device i holds 1/n of the state
         opt_state = (
             opt_state if opt_state is not None else dense_opt.init_stacked(params)
@@ -126,10 +123,8 @@ def make_local_mesh_step(
             "ZeRO state sharding needs identical (replicated) grads each "
             "step; kstep's local grads would diverge the chunks"
         )
-    if is_zero and dense_opt.axis_name != plan.axis:
-        raise ValueError(
-            f"Zero1Optimizer axis {dense_opt.axis_name!r} != mesh axis {plan.axis!r}"
-        )
+    if is_zero:
+        dense_opt.check_axis(plan.axis, plan.n_devices)
     lay, opt = cfg.layout, cfg.sparse_opt
     S, b = cfg.num_slots, cfg.batch_size
     ax = plan.axis
