@@ -37,6 +37,57 @@ from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
 
 
+def _compressed_a2a(recs, axis_name, head: int, sections):
+    """all_to_all [n, K, W] records under the ici_wire_dtype flag.
+
+    ``head`` columns (counters/stats) always ride fp32; each ``(a, b)``
+    span in ``sections`` is a separate VALUE FAMILY quantized with its own
+    per-record max-abs scale under int8 — embedx and expand train on
+    different gradients and can sit orders of magnitude apart, so one
+    shared scale would quantize the smaller family to noise (the same
+    per-block rule as the row wire, ops/wire_quant.py)."""
+    from paddlebox_tpu import config as _config
+
+    wd = str(_config.get_flag("ici_wire_dtype"))
+    if wd == "bf16":
+        counts = lax.all_to_all(recs[:, :, :head], axis_name, 0, 0, tiled=True)
+        vals = lax.all_to_all(
+            recs[:, :, head:].astype(jnp.bfloat16), axis_name, 0, 0, tiled=True
+        ).astype(jnp.float32)
+        return jnp.concatenate([counts, vals], axis=2)
+    if wd == "int8":
+        # three collectives total regardless of section count: fp32 head,
+        # one concatenated int8 payload, one stacked scale matrix (same
+        # batching as the row wire's fetch_rows_start) — every extra
+        # all_to_all would add fixed launch/sync latency per batch
+        qs, scales = [], []
+        for a, b in sections:
+            v = recs[:, :, a:b]
+            s = jnp.maximum(jnp.abs(v).max(axis=2), 1e-12) / 127.0
+            qs.append(
+                jnp.clip(jnp.rint(v / s[..., None]), -127, 127).astype(jnp.int8)
+            )
+            scales.append(s)
+        counts = lax.all_to_all(recs[:, :, :head], axis_name, 0, 0, tiled=True)
+        qr = lax.all_to_all(
+            jnp.concatenate(qs, axis=2), axis_name, 0, 0, tiled=True
+        )
+        sr = lax.all_to_all(
+            jnp.stack(scales, axis=2), axis_name, 0, 0, tiled=True
+        )  # [n, K, n_sections]
+        outs = [counts]
+        off = 0
+        for si, (a, b) in enumerate(sections):
+            wsec = b - a
+            outs.append(
+                qr[:, :, off : off + wsec].astype(jnp.float32)
+                * sr[:, :, si : si + 1]
+            )
+            off += wsec
+        return jnp.concatenate(outs, axis=2)
+    return lax.all_to_all(recs, axis_name, 0, 0, tiled=True)
+
+
 def sharded_pull(
     table_local: jnp.ndarray,  # [cap, width] this shard's rows
     req_ranks: jnp.ndarray,  # int32 [n_shards, K] this device's requests
@@ -71,33 +122,16 @@ def sharded_pull(
     # quant pull-value family of box_wrapper.cc:419-437, applied to the
     # only wire this architecture still ships values over per batch); flag
     # read at trace time, so the cast compiles into the fixed collective.
-    # Either way the whole COUNTER/STAT head of the record — everything
-    # before embed_w, i.e. show/clk plus the conv/pcoc extras of wider
-    # cvm layouts — stays fp32: counts past 256 would round in bf16, and
-    # a 1e4-magnitude conv count sharing one int8 scale with 0.01
-    # embeddings would quantize them to zero.
-    from paddlebox_tpu import config as _config
-
+    # The counter/stat head (everything before embed_w — show/clk plus
+    # conv/pcoc extras) stays fp32; embedx and the extended pull's expand
+    # block quantize as separate int8 sections.
     a = layout.embed_w_col  # first embedding-value column of the record
-    wd = str(_config.get_flag("ici_wire_dtype"))
-    if wd == "bf16":
-        counts = lax.all_to_all(resp[:, :, :a], axis_name, 0, 0, tiled=True)
-        vals = lax.all_to_all(
-            resp[:, :, a:].astype(jnp.bfloat16), axis_name, 0, 0, tiled=True
-        ).astype(jnp.float32)
-        resp_back = jnp.concatenate([counts, vals], axis=2)
-    elif wd == "int8":
-        counts = lax.all_to_all(resp[:, :, :a], axis_name, 0, 0, tiled=True)
-        v = resp[:, :, a:]
-        scale = jnp.maximum(jnp.abs(v).max(axis=2), 1e-12) / 127.0  # [n, K]
-        q = jnp.clip(jnp.rint(v / scale[..., None]), -127, 127).astype(jnp.int8)
-        qr = lax.all_to_all(q, axis_name, 0, 0, tiled=True)
-        sr = lax.all_to_all(scale, axis_name, 0, 0, tiled=True)
-        resp_back = jnp.concatenate(
-            [counts, qr.astype(jnp.float32) * sr[..., None]], axis=2
-        )
-    else:
-        resp_back = lax.all_to_all(resp, axis_name, 0, 0, tiled=True)
+    W = resp.shape[2]
+    pull_w = layout.pull_width
+    sections = (
+        [(a, pull_w), (pull_w, W)] if extended else [(a, W)]
+    )
+    resp_back = _compressed_a2a(resp, axis_name, a, sections)
     return resp_back.reshape(n * K, -1).astype(jnp.float32)
 
 
@@ -128,31 +162,13 @@ def sharded_push(
     # ICI when flagged. The two show/clk count columns stay fp32: bf16 is
     # exact only to 256, and a hot key whose per-bucket count sums past
     # that would round — drifting everything show-gated downstream (embedx
-    # unlock, shrink, cache thresholds). 2 of gw+2 columns, so the extra
-    # bytes are negligible.
-    from paddlebox_tpu import config as _config
-
-    wd = str(_config.get_flag("ici_wire_dtype"))
-    if wd == "bf16":
-        counts = lax.all_to_all(
-            recs[:, :, :2], axis_name, 0, 0, tiled=True
-        )  # fp32 [n, K, 2]
-        grads_recv = lax.all_to_all(
-            recs[:, :, 2:].astype(jnp.bfloat16), axis_name, 0, 0, tiled=True
-        ).astype(jnp.float32)
-        recs_recv = jnp.concatenate([counts, grads_recv], axis=2)
-    elif wd == "int8":
-        counts = lax.all_to_all(recs[:, :, :2], axis_name, 0, 0, tiled=True)
-        g = recs[:, :, 2:]
-        scale = jnp.maximum(jnp.abs(g).max(axis=2), 1e-12) / 127.0  # [n, K]
-        q = jnp.clip(jnp.rint(g / scale[..., None]), -127, 127).astype(jnp.int8)
-        qr = lax.all_to_all(q, axis_name, 0, 0, tiled=True)
-        sr = lax.all_to_all(scale, axis_name, 0, 0, tiled=True)
-        recs_recv = jnp.concatenate(
-            [counts, qr.astype(jnp.float32) * sr[..., None]], axis=2
-        )
-    else:
-        recs_recv = lax.all_to_all(recs, axis_name, 0, 0, tiled=True)
+    # unlock, shrink, cache thresholds). An extended push's expand grads
+    # quantize as their own int8 section, like the pull side.
+    pw2 = 2 + layout.push_width
+    sections = (
+        [(2, pw2), (pw2, gw + 2)] if gw > layout.push_width else [(2, gw + 2)]
+    )
+    recs_recv = _compressed_a2a(recs, axis_name, 2, sections)
     ranks_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
 
     M = n * K

@@ -339,6 +339,126 @@ def test_ici_wire_preserves_full_counter_head_conv_layout():
         assert (np.abs(got[..., 3:] - emb_ref) <= bound).all(), mode
 
 
+def test_ici_int8_extended_pull_sections_isolate_expand():
+    """Extended pulls concat embedx + expand into one record; the int8 ICI
+    wire must scale them as separate sections — an expand outlier may not
+    crush embedx (the same per-family rule as the row wire)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.parallel.sharded_pullpush import sharded_pull
+
+    lay = ValueLayout(embedx_dim=8, expand_embed_dim=8)
+    ndev, cap = 4, 8
+    rng = np.random.default_rng(6)
+    tbl = rng.normal(0, 0.01, (ndev, cap, lay.width)).astype(np.float32)
+    tbl[:, :, lay.SHOW] = rng.integers(300, 3000, (ndev, cap))
+    tbl[:, :, lay.CLK] = rng.integers(0, 300, (ndev, cap))
+    # expand block: hard outliers next to 0.01-magnitude embedx
+    tbl[:, :, lay.expand_col] = 8.0
+    tbl[:, cap - 1] = 0.0
+
+    plan = make_mesh(ndev)
+    K = 4
+    req = rng.integers(0, cap - 1, (ndev, ndev, K)).astype(np.int32)
+
+    def run(mode):
+        prev = config.get_flag("ici_wire_dtype")
+        config.set_flag("ici_wire_dtype", mode)
+        try:
+            mapped = jax.jit(
+                jax.shard_map(
+                    lambda t, r: sharded_pull(
+                        t[0], r[0], lay, 0.0, 1.0, plan.axis, extended=True
+                    )[None],
+                    mesh=plan.mesh,
+                    in_specs=(P(plan.axis), P(plan.axis)),
+                    out_specs=P(plan.axis),
+                    check_vma=False,
+                )
+            )
+            return np.asarray(
+                mapped(
+                    jax.device_put(jnp.asarray(tbl), plan.table_sharding),
+                    jax.device_put(jnp.asarray(req), plan.batch_sharding),
+                )
+            )
+        finally:
+            config.set_flag("ici_wire_dtype", prev)
+
+    ref = run("fp32")
+    got = run("int8")
+    pw = lay.pull_width
+    # counters exact; embedx error bounded by the EMBEDX section's scale
+    np.testing.assert_array_equal(got[..., :2], ref[..., :2])
+    emb_ref = ref[..., 2:pw]
+    bound = np.abs(ref[..., lay.embed_w_col:pw]).max(axis=-1, keepdims=True) / 120.0 + 1e-7
+    assert (np.abs(got[..., 2:pw] - emb_ref) <= bound).all()
+    assert bound.max() < 8.0 / 254  # a shared scale could not meet this
+    # expand section bounded by its own (outlier-sized) scale
+    ebound = np.abs(ref[..., pw:]).max(axis=-1, keepdims=True) / 120.0 + 1e-7
+    assert (np.abs(got[..., pw:] - ref[..., pw:]) <= ebound).all()
+
+
+def test_ici_int8_push_sections_isolate_expand_grads():
+    """The push wire's section math (head=2 counters, embedx grads and
+    expand grads as separate int8 sections — the pw2 pivot in
+    sharded_push): counters bit-exact, each grad family bounded by its OWN
+    per-record scale even with an expand-grad outlier."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.parallel.sharded_pullpush import _compressed_a2a
+
+    lay = ValueLayout(embedx_dim=8, expand_embed_dim=8)
+    ndev, K = 4, 4
+    pw = lay.push_width
+    gw = lay.extended_push_width  # embedx grads + expand grads
+    rng = np.random.default_rng(7)
+    recs = rng.normal(0, 0.01, (ndev, ndev, K, gw + 2)).astype(np.float32)
+    recs[..., 0] = rng.integers(1, 2000, (ndev, ndev, K))  # show counts
+    recs[..., 1] = rng.integers(0, 500, (ndev, ndev, K))  # clk counts
+    recs[..., 2 + pw] = 5.0  # expand-grad outlier in every record
+
+    plan = make_mesh(ndev)
+    # exactly sharded_push's extended section split
+    pw2 = 2 + pw
+    sections = [(2, pw2), (pw2, gw + 2)]
+
+    def run(mode):
+        prev = config.get_flag("ici_wire_dtype")
+        config.set_flag("ici_wire_dtype", mode)
+        try:
+            mapped = jax.jit(
+                jax.shard_map(
+                    lambda r: _compressed_a2a(r[0], plan.axis, 2, sections)[None],
+                    mesh=plan.mesh,
+                    in_specs=(P(plan.axis),),
+                    out_specs=P(plan.axis),
+                    check_vma=False,
+                )
+            )
+            return np.asarray(mapped(jax.device_put(
+                jnp.asarray(recs), plan.batch_sharding
+            )))
+        finally:
+            config.set_flag("ici_wire_dtype", prev)
+
+    ref = run("fp32")
+    got = run("int8")
+    np.testing.assert_array_equal(got[..., :2], ref[..., :2])  # counters
+    gbound = np.abs(ref[..., 2:pw2]).max(axis=-1, keepdims=True) / 120.0 + 1e-7
+    assert (np.abs(got[..., 2:pw2] - ref[..., 2:pw2]) <= gbound).all()
+    assert gbound.max() < 5.0 / 254  # shared scale could not meet this
+    ebound = np.abs(ref[..., pw2:]).max(axis=-1, keepdims=True) / 120.0 + 1e-7
+    assert (np.abs(got[..., pw2:] - ref[..., pw2:]) <= ebound).all()
+    # bf16 mode: counters exact too (the fp32 head path)
+    got16 = run("bf16")
+    np.testing.assert_array_equal(got16[..., :2], ref[..., :2])
+
+
 def test_resident_counts_compression_upload_bytes(tmp_path):
     """The resident upload ships uint8 counts (+int32 base) instead of the
     int32 offset matrix — bit-identical training, ~4x smaller offsets."""

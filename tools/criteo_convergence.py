@@ -189,7 +189,15 @@ def main():
 
     import jax
 
-    if args.cpu or jax.default_backend() not in ("tpu",):
+    if not args.cpu:
+        try:
+            backend = jax.default_backend()
+        except RuntimeError:
+            # wedged accelerator init (the axon tunnel's failure mode):
+            # fall back instead of dying before the first row
+            backend = "cpu"
+        args.cpu = backend not in ("tpu",)
+    if args.cpu:
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
