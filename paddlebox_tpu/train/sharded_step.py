@@ -49,6 +49,17 @@ def init_sharded_train_state(
     local_dense: bool = False,  # kstep/LocalSGD: per-device dense replicas
 ) -> TrainState:
     n = plan.n_devices
+    # the dense trees are COPIED before placement: device_put to a
+    # matching sharding ALIASES an already-placed array, and the jitted
+    # step donates its state — without the copy, the first superstep
+    # would delete the caller's params/opt_state leaves out from under
+    # any other reference (a second-phase trainer sharing params, or the
+    # trainer's own self.params after a mid-pass failure). Dense CTR
+    # trees are small; the table deliberately is NOT copied (full-table
+    # HBM) — its donation consuming the input is the intended handoff.
+    params = jax.tree.map(jnp.copy, params)
+    if opt_state is not None:
+        opt_state = jax.tree.map(jnp.copy, opt_state)
     auc = AucState(
         pos=jnp.zeros((n, auc_buckets), jnp.int32),
         neg=jnp.zeros((n, auc_buckets), jnp.int32),

@@ -237,7 +237,8 @@ class CTRTrainer:
             # device COPIES of params/opt_state: the step donates its state,
             # so handing self.params's own buffers over would delete them —
             # a mid-pass save_dense or an aborted pass would then read dead
-            # arrays (the mesh path's put_replicated already copies)
+            # arrays (init_sharded_train_state makes the same copies on
+            # the mesh path)
             return TrainState(
                 table=flat,
                 params=jax.tree.map(jnp.copy, self.params),

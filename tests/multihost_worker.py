@@ -275,16 +275,11 @@ def carried_main():
     print(f"rank {rank}: carried ok", flush=True)
 
 
-def pv_main():
-    """Join(pv) -> update two-phase pass on the 2-host mesh: search_id
-    global shuffle co-locates each query's ads on its owner host, pv batch
-    counts and pack pads are transport-locksteped (ghost batches on the
-    short host), then the update phase runs the store fast path."""
-    _, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
-    rank = int(rank_s)
-    with open(os.path.join(workdir, "conf.json")) as f:
-        conf = json.load(f)
-
+def _pv_setup(conf, rank, opt_overrides=None):
+    """Shared pv-worker setup: jax.distributed init, transport/router,
+    global mesh, search_id-shuffled dataset, RankModel (DeepFM +
+    rank_attention), and join/update trainers. Both pv entry points build
+    from here so their fixtures cannot diverge."""
     import jax
 
     n_ranks = conf.get("n_ranks", 2)
@@ -296,7 +291,6 @@ def pv_main():
         process_id=rank,
     )
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
     from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
@@ -319,9 +313,11 @@ def pv_main():
         parse_logkey=True,
     )
     layout = ValueLayout(embedx_dim=conf["embedx_dim"])
-    opt_cfg = SparseOptimizerConfig(
+    opt_kwargs = dict(
         embed_lr=0.2, embedx_lr=0.2, embedx_threshold=0.0, initial_range=0.01
     )
+    opt_kwargs.update(opt_overrides or {})
+    opt_cfg = SparseOptimizerConfig(**opt_kwargs)
     table = HostSparseTable(layout, opt_cfg, n_shards=4, seed=0)
 
     eps = [f"127.0.0.1:{p}" for p in conf["tp_ports"]]
@@ -330,23 +326,12 @@ def pv_main():
 
     n_global_dev = n_ranks * local_dev
     plan = make_mesh(n_global_dev)
-
     ds = BoxPSDataset(
-        schema,
-        table,
-        batch_size=conf["local_batch"],
-        n_mesh_shards=n_global_dev,
-        rank=rank,
-        nranks=n_ranks,
+        schema, table, batch_size=conf["local_batch"],
+        n_mesh_shards=n_global_dev, rank=rank, nranks=n_ranks,
         shuffle_mode="search_id",  # co-locate each pv on its owner host
-        router=router,
-        transport=transport,
-        seed=0,
+        router=router, transport=transport, seed=0,
     )
-    ds.set_filelist(conf["files"])
-    ds.set_date("20260101")
-    ds.load_into_memory()
-    ds.begin_pass(round_to=conf["round_to"])
 
     base = DeepFM(
         num_slots=NS, feat_width=layout.pull_width,
@@ -369,7 +354,9 @@ def pv_main():
             )
             if rank_offset is not None:
                 x = feats.reshape(feats.shape[0], -1)
-                logit = logit + rank_attention(x, rank_offset, p["rank_param"], 3)[:, 0]
+                logit = logit + rank_attention(
+                    x, rank_offset, p["rank_param"], 3
+                )[:, 0]
             return logit
 
     model = RankModel()
@@ -378,8 +365,34 @@ def pv_main():
         num_slots=NS, batch_size=per_dev_b, layout=layout, sparse_opt=opt_cfg,
         auc_buckets=1000, axis_name=plan.axis, model_takes_rank_offset=True,
     )
+    cfg_upd = TrainStepConfig(
+        num_slots=NS, batch_size=per_dev_b, layout=layout, sparse_opt=opt_cfg,
+        auc_buckets=1000, axis_name=plan.axis,
+    )
     join_tr = CTRTrainer(model, cfg_join, dense_opt=optax.adam(1e-2), plan=plan)
     join_tr.init_params(jax.random.PRNGKey(0))
+    upd_tr = CTRTrainer(model, cfg_upd, dense_opt=optax.adam(1e-2), plan=plan)
+    upd_tr.opt_state = optax.adam(1e-2).init(join_tr.params)  # shapes only
+    upd_tr.init_params = lambda rng=None: None
+    return ds, table, join_tr, upd_tr, local_dev
+
+
+def pv_main():
+    """Join(pv) -> update two-phase pass on the 2-host mesh: search_id
+    global shuffle co-locates each query's ads on its owner host, pv batch
+    counts and pack pads are transport-locksteped (ghost batches on the
+    short host), then the update phase runs the store fast path."""
+    _, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    rank = int(rank_s)
+    with open(os.path.join(workdir, "conf.json")) as f:
+        conf = json.load(f)
+    import numpy as np
+
+    ds, table, join_tr, upd_tr, local_dev = _pv_setup(conf, rank)
+    ds.set_filelist(conf["files"])
+    ds.set_date("20260101")
+    ds.load_into_memory()
+    ds.begin_pass(round_to=conf["round_to"])
 
     ds.set_current_phase(1)
     n_pvs = ds.preprocess_instance()
@@ -389,14 +402,10 @@ def pv_main():
 
     ds.set_current_phase(0)
     ds.postprocess_instance()
-    cfg_upd = TrainStepConfig(
-        num_slots=NS, batch_size=per_dev_b, layout=layout, sparse_opt=opt_cfg,
-        auc_buckets=1000, axis_name=plan.axis,
-    )
-    upd_tr = CTRTrainer(model, cfg_upd, dense_opt=optax.adam(1e-2), plan=plan)
+    # the update phase continues from the JOIN-TRAINED dense params (one
+    # live model across phases, box_wrapper.h:620-622) — bind AFTER the
+    # join pass (join_tr.params rebinds to fresh arrays at its pass end)
     upd_tr.params = join_tr.params
-    upd_tr.opt_state = optax.adam(1e-2).init(join_tr.params)
-    upd_tr.init_params = lambda rng=None: None
     join_tr.handoff_table(ds)  # join-phase sparse updates carry into update
     out_u = upd_tr.train_pass(ds)
 
@@ -418,9 +427,74 @@ def pv_main():
     print(f"rank {rank}: pv ok", flush=True)
 
 
+def pv2_main():
+    """TWO-pass pv (join->update) day loop: composes the multi-host
+    resident pv tier with the multi-host carried boundary — every pass
+    boundary hands end_pass the live device table, so with
+    PBOX_ENABLE_CARRIED_TABLE=1 the second pass's finalize splices the
+    update-phase-trained rows per host instead of a full writeback.
+    Dumps per-pass metrics + final host table for carried==classic
+    equality."""
+    _, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    rank = int(rank_s)
+    with open(os.path.join(workdir, "conf.json")) as f:
+        conf = json.load(f)
+    import numpy as np
+
+    ds, table, join_tr, upd_tr, local_dev = _pv_setup(
+        conf, rank,
+        opt_overrides={"show_clk_decay": 0.95, "shrink_threshold": 0.0},
+    )
+    per_pass = conf["files_per_pass"]
+    n_passes = len(conf["files"]) // per_pass
+    join_losses, upd_losses = [], []
+    spliced_passes = 0
+    for p in range(n_passes):
+        ds.set_filelist(conf["files"][p * per_pass : (p + 1) * per_pass])
+        ds.set_date(f"202602{p + 1:02d}")
+        ds.load_into_memory()
+        ds.begin_pass(round_to=conf["round_to"])
+        if getattr(ds.ws, "boundary_stats", None) is not None:
+            spliced_passes += 1
+        ds.set_current_phase(1)
+        ds.preprocess_instance()
+        out_j = join_tr.train_pass(ds)
+        ds.set_current_phase(0)
+        ds.postprocess_instance()
+        # one live model across phases and passes: update continues from
+        # the join-trained dense params, the next pass's join from the
+        # update-trained ones (bind AFTER each pass — train_pass rebinds
+        # trainer.params to fresh arrays at pass end)
+        upd_tr.params = join_tr.params
+        join_tr.handoff_table(ds)
+        out_u = upd_tr.train_pass(ds)
+        join_tr.params = upd_tr.params
+        join_losses.append(out_j["loss"])
+        upd_losses.append(out_u["loss"])
+        # the join-phase trainer shares the dense params; the sparse side
+        # ends with the update-phase-trained DEVICE table
+        ds.end_pass(upd_tr.trained_table_device())
+    table.drain_pending()
+    keys = np.sort(table.keys())
+    np.savez(
+        os.path.join(workdir, f"rank{rank}.npz"),
+        join_losses=np.array(join_losses),
+        upd_losses=np.array(upd_losses),
+        spliced_passes=np.array([spliced_passes]),
+        host_keys=keys,
+        host_vals=table.pull_or_create(keys),
+        join_resident=np.array(
+            [int(getattr(join_tr, "_resident_cache", None) is not None)]
+        ),
+    )
+    print(f"rank {rank}: pv2 ok", flush=True)
+
+
 if __name__ == "__main__":
     if sys.argv[1] == "pv":
         pv_main()
+    elif sys.argv[1] == "pv2":
+        pv2_main()
     elif sys.argv[1] == "carried":
         carried_main()
     else:

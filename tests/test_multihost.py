@@ -456,22 +456,25 @@ def test_four_process_carried_boundary_matches_classic(tmp_path):
         )
 
 
-def _write_pv_files(tmp_path, n_even_queries, n_odd_queries, n_files=2):
+def _write_pv_files(
+    tmp_path, n_even_queries, n_odd_queries, n_files=2, lo=1, hi=500,
+    prefix="part", seed=11,
+):
     """Logkey'd pv data with a skewed search_id parity split: after
     search_id-mode global shuffle, rank 0 owns ~n_even and rank 1 ~n_odd
     page views — unequal join batch counts force ghost equalization."""
-    rng = np.random.default_rng(11)
+    rng = np.random.default_rng(seed)
     sids = [2 * (i + 1) for i in range(n_even_queries)] + [
         2 * (i + 1) + 1 for i in range(n_odd_queries)
     ]
     rng.shuffle(sids)
-    files = [str(tmp_path / f"part-{i}.txt") for i in range(n_files)]
+    files = [str(tmp_path / f"{prefix}-{i}.txt") for i in range(n_files)]
     handles = [open(p, "w") for p in files]
     total = 0
     for qi, sid in enumerate(sids):
         n_ads = int(rng.integers(1, 4))
         for rank in range(1, n_ads + 1):
-            keys = rng.integers(1, 500, NS)
+            keys = rng.integers(lo, hi, NS)
             cmatch = 222 if rng.random() < 0.8 else 999  # some rank-invalid
             logkey = "0" * 11 + f"{cmatch:03x}" + f"{rank:02x}" + f"{sid:016x}"
             handles[qi % len(handles)].write(
@@ -483,6 +486,47 @@ def _write_pv_files(tmp_path, n_even_queries, n_odd_queries, n_files=2):
     for h in handles:
         h.close()
     return files, total
+
+
+def test_two_process_pv_carried_day_loop_matches_classic(tmp_path):
+    """The two flagship multi-host tiers COMPOSED: a 2-pass join->update
+    (pv) day loop on the resident pv tier where every boundary hands
+    end_pass the live device table. Carried (per-host splice of the
+    update-phase-trained rows) must equal the classic full writeback on
+    metrics and host tables."""
+    files = []
+    for p in range(2):
+        fs, _ = _write_pv_files(
+            tmp_path, n_even_queries=20, n_odd_queries=8,
+            lo=1 + 120 * p, hi=400 + 120 * p, prefix=f"pass{p}",
+            seed=11 + p,
+        )
+        files.extend(fs)
+    conf = {"files_per_pass": 2}
+    (tmp_path / "car").mkdir()
+    car = _run_cluster(
+        tmp_path / "car", "pv2", files, GLOBAL_BATCH // 2, False,
+        extra_env={"PBOX_ENABLE_CARRIED_TABLE": "1"}, extra_conf=conf,
+    )
+    (tmp_path / "cls").mkdir()
+    cls = _run_cluster(
+        tmp_path / "cls", "pv2", files, GLOBAL_BATCH // 2, False,
+        extra_env={"PBOX_ENABLE_CARRIED_TABLE": "0"}, extra_conf=conf,
+    )
+    for r in range(2):
+        assert int(car[r]["join_resident"][0]) == 1  # resident pv tier ran
+        assert int(car[r]["spliced_passes"][0]) == 1  # pass 2 spliced
+        assert int(cls[r]["spliced_passes"][0]) == 0
+        np.testing.assert_allclose(
+            car[r]["join_losses"], cls[r]["join_losses"], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            car[r]["upd_losses"], cls[r]["upd_losses"], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_array_equal(car[r]["host_keys"], cls[r]["host_keys"])
+        np.testing.assert_allclose(
+            car[r]["host_vals"], cls[r]["host_vals"], rtol=1e-5, atol=1e-6
+        )
 
 
 def test_two_process_pv_join_update_lockstep(tmp_path):
