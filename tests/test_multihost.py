@@ -529,6 +529,42 @@ def test_two_process_pv_carried_day_loop_matches_classic(tmp_path):
         )
 
 
+def test_four_process_pv_carried_day_loop_matches_classic(tmp_path):
+    """pv x carried at 4 ranks: the composed day loop is rank-general."""
+    files = []
+    for p in range(2):
+        fs, _ = _write_pv_files(
+            tmp_path, n_even_queries=24, n_odd_queries=12,
+            lo=1 + 120 * p, hi=400 + 120 * p, prefix=f"pass{p}",
+            seed=17 + p, n_files=4,
+        )
+        files.extend(fs)
+    conf = {"files_per_pass": 4}
+    (tmp_path / "car").mkdir()
+    car = _run_cluster(
+        tmp_path / "car", "pv2", files, 16, False, n_ranks=4,
+        extra_env={"PBOX_ENABLE_CARRIED_TABLE": "1"}, extra_conf=conf,
+    )
+    (tmp_path / "cls").mkdir()
+    cls = _run_cluster(
+        tmp_path / "cls", "pv2", files, 16, False, n_ranks=4,
+        extra_env={"PBOX_ENABLE_CARRIED_TABLE": "0"}, extra_conf=conf,
+    )
+    for r in range(4):
+        assert int(car[r]["spliced_passes"][0]) == 1
+        assert int(cls[r]["spliced_passes"][0]) == 0
+        np.testing.assert_allclose(
+            car[r]["join_losses"], cls[r]["join_losses"], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            car[r]["upd_losses"], cls[r]["upd_losses"], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_array_equal(car[r]["host_keys"], cls[r]["host_keys"])
+        np.testing.assert_allclose(
+            car[r]["host_vals"], cls[r]["host_vals"], rtol=1e-5, atol=1e-6
+        )
+
+
 def test_two_process_pv_join_update_lockstep(tmp_path):
     """Multi-host join-phase (pv) training — now on the RESIDENT pv tier
     (device-sharded PvPlan stacks, ghost batches locksteped): search_id
