@@ -162,18 +162,12 @@ def main():
 
 
 
-def carried_main():
-    """Multi-pass day loop over overlapping key streams: every boundary
-    hands end_pass the live DEVICE table (trained_table_device). With
-    PBOX_ENABLE_CARRIED_TABLE=1 the locksteped gate builds a per-host
-    MultiHostCarrier (splice + departure push + new-key upload only); with
-    0 the same call takes the classic full writeback. The test asserts the
-    two runs produce identical host tables and metrics."""
-    _, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
-    rank = int(rank_s)
-    with open(os.path.join(workdir, "conf.json")) as f:
-        conf = json.load(f)
-
+def _flat_setup(conf, rank):
+    """Shared flat-record (non-pv) worker setup. Returns a ``build()``
+    closure that constructs a FRESH (table, dataset, trainer) triple over
+    the one live transport/mesh — carried_main calls it once; the resume
+    worker calls it again after "restarting" to prove a fresh process can
+    rebuild from checkpoints alone."""
     import jax
 
     n_ranks = conf.get("n_ranks", 2)
@@ -184,7 +178,6 @@ def carried_main():
         num_processes=n_ranks,
         process_id=rank,
     )
-    import numpy as np
     import optax
 
     from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
@@ -212,19 +205,12 @@ def carried_main():
         embed_lr=0.2, embedx_lr=0.2, embedx_threshold=0.0,
         initial_range=0.01, show_clk_decay=0.95, shrink_threshold=0.0,
     )
-    table = HostSparseTable(layout, opt_cfg, n_shards=4, seed=0)
-
     eps = [f"127.0.0.1:{p}" for p in conf["tp_ports"]]
     transport = TcpTransport(rank, eps, timeout=60.0)
     router = TcpShuffleRouter(transport)
 
     n_global_dev = n_ranks * local_dev
     plan = make_mesh(n_global_dev)
-    ds = BoxPSDataset(
-        schema, table, batch_size=conf["local_batch"],
-        n_mesh_shards=n_global_dev, rank=rank, nranks=n_ranks,
-        shuffle_mode="none", router=router, transport=transport, seed=0,
-    )
     model = DeepFM(
         num_slots=NS, feat_width=layout.pull_width,
         embedx_dim=conf["embedx_dim"], hidden=(16,),
@@ -234,8 +220,35 @@ def carried_main():
         layout=layout, sparse_opt=opt_cfg, auc_buckets=1000,
         axis_name=plan.axis,
     )
-    trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan)
-    trainer.init_params(jax.random.PRNGKey(0))
+
+    def build():
+        table = HostSparseTable(layout, opt_cfg, n_shards=4, seed=0)
+        ds = BoxPSDataset(
+            schema, table, batch_size=conf["local_batch"],
+            n_mesh_shards=n_global_dev, rank=rank, nranks=n_ranks,
+            shuffle_mode="none", router=router, transport=transport, seed=0,
+        )
+        trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan)
+        trainer.init_params(jax.random.PRNGKey(0))
+        return table, ds, trainer
+
+    return build
+
+
+def carried_main():
+    """Multi-pass day loop over overlapping key streams: every boundary
+    hands end_pass the live DEVICE table (trained_table_device). With
+    PBOX_ENABLE_CARRIED_TABLE=1 the locksteped gate builds a per-host
+    MultiHostCarrier (splice + departure push + new-key upload only); with
+    0 the same call takes the classic full writeback. The test asserts the
+    two runs produce identical host tables and metrics."""
+    _, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    rank = int(rank_s)
+    with open(os.path.join(workdir, "conf.json")) as f:
+        conf = json.load(f)
+    import numpy as np
+
+    table, ds, trainer = _flat_setup(conf, rank)()
 
     per_pass = conf["files_per_pass"]
     n_passes = len(conf["files"]) // per_pass
@@ -273,6 +286,63 @@ def carried_main():
         pass_keys=np.array(pass_keys),
     )
     print(f"rank {rank}: carried ok", flush=True)
+
+
+def carried_resume_main():
+    """Day-level checkpoint/resume on the multi-host path: train 2 carried
+    passes, save_base per host (each host checkpoints its OWN key slice +
+    the replicated dense), then REBUILD everything from fresh objects and
+    resume from disk alone, and train pass 3 on the resumed state. The
+    test pins the final host tables and pass-3 loss EQUAL to an
+    uninterrupted 3-pass run (day-level InitializeGPUAndLoadModel parity,
+    per host)."""
+    _, rank_s, workdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    rank = int(rank_s)
+    with open(os.path.join(workdir, "conf.json")) as f:
+        conf = json.load(f)
+    import numpy as np
+
+    from paddlebox_tpu.train import CheckpointManager
+
+    build = _flat_setup(conf, rank)
+    table, ds, trainer = build()
+    per_pass = conf["files_per_pass"]
+    losses = []
+    for p in range(2):
+        ds.set_filelist(conf["files"][p * per_pass : (p + 1) * per_pass])
+        ds.set_date(f"202601{p + 1:02d}")
+        ds.load_into_memory()
+        ds.begin_pass(round_to=conf["round_to"])
+        out = trainer.train_pass(ds)
+        losses.append(out["loss"])
+        ds.end_pass(trainer.trained_table_device())
+    ds.wait_end_pass()
+    ckpt = os.path.join(workdir, f"ckpt-{rank}")
+    # save_base drains pending carriers via the save path's drain hook
+    CheckpointManager(ckpt).save_base("20260102", table, trainer)
+
+    # "process restart": fresh table/dataset/trainer over the live
+    # transport; ONLY the checkpoint directory carries state across
+    table2, ds2, tr2 = build()
+    cur = CheckpointManager(ckpt).resume(table2, tr2)
+    assert cur is not None and cur["date"] == "20260102", cur
+    p = 2
+    ds2.set_filelist(conf["files"][p * per_pass : (p + 1) * per_pass])
+    ds2.set_date("20260103")
+    ds2.load_into_memory()
+    ds2.begin_pass(round_to=conf["round_to"])
+    out = tr2.train_pass(ds2)
+    losses.append(out["loss"])
+    ds2.end_pass(tr2.trained_table_device())
+    table2.drain_pending()
+    keys = np.sort(table2.keys())
+    np.savez(
+        os.path.join(workdir, f"rank{rank}.npz"),
+        losses=np.array(losses),
+        host_keys=keys,
+        host_vals=table2.pull_or_create(keys),
+    )
+    print(f"rank {rank}: carried-resume ok", flush=True)
 
 
 def _pv_setup(conf, rank, opt_overrides=None):
@@ -497,5 +567,7 @@ if __name__ == "__main__":
         pv2_main()
     elif sys.argv[1] == "carried":
         carried_main()
+    elif sys.argv[1] == "carried_resume":
+        carried_resume_main()
     else:
         main()

@@ -529,6 +529,36 @@ def test_two_process_pv_carried_day_loop_matches_classic(tmp_path):
         )
 
 
+def test_two_process_carried_day_loop_resume_matches_uninterrupted(tmp_path):
+    """Checkpoint/resume ON the multi-host path: 2 carried passes +
+    per-host save_base, then everything rebuilt from fresh objects and
+    resumed from disk alone, then pass 3 — must equal an UNINTERRUPTED
+    3-pass carried run on losses and final host tables (each host
+    checkpoints its own slice; dense is replicated; decay epochs are
+    checkpoint-stamped so resumed counters match the live table)."""
+    files = _write_overlapping_pass_files(tmp_path, n_passes=3, files_per_pass=2)
+    conf = {"files_per_pass": 2}
+    env = {"PBOX_ENABLE_CARRIED_TABLE": "1"}
+    (tmp_path / "ref").mkdir()
+    ref = _run_cluster(
+        tmp_path / "ref", "carried", files, GLOBAL_BATCH // 2, False,
+        extra_env=env, extra_conf=conf,
+    )
+    (tmp_path / "res").mkdir()
+    res = _run_cluster(
+        tmp_path / "res", "carried_resume", files, GLOBAL_BATCH // 2, False,
+        extra_env=env, extra_conf=conf,
+    )
+    for r in range(2):
+        np.testing.assert_allclose(
+            res[r]["losses"], ref[r]["losses"], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_array_equal(res[r]["host_keys"], ref[r]["host_keys"])
+        np.testing.assert_allclose(
+            res[r]["host_vals"], ref[r]["host_vals"], rtol=1e-5, atol=1e-6
+        )
+
+
 def test_four_process_pv_carried_day_loop_matches_classic(tmp_path):
     """pv x carried at 4 ranks: the composed day loop is rank-general."""
     files = []
