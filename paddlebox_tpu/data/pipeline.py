@@ -20,10 +20,17 @@ T = TypeVar("T")
 R = TypeVar("R")
 
 from paddlebox_tpu import config
+from paddlebox_tpu.utils.faultinject import fire as _fault_fire
 
 config.define_flag("feed_pipeline_workers", 3, "background packer thread count")
 config.define_flag(
     "feed_pipeline_depth", 6, "max batches packed/uploaded ahead of compute"
+)
+config.define_flag(
+    "feed_pipeline_retries",
+    1,
+    "re-runs of a failed prefetch job before its exception surfaces (a "
+    "transient packer/device_put hiccup should not kill the pass)",
 )
 
 
@@ -32,29 +39,56 @@ def prefetch(
     fn: Callable[[T], R],
     workers: int | None = None,
     depth: int | None = None,
+    retries: int | None = None,
 ) -> Iterator[R]:
     """Yield ``fn(job)`` in order, computing up to ``depth`` jobs ahead on
-    ``workers`` threads. Exceptions surface at the failing job's position;
-    the window keeps order deterministic (same batches, same sequence, with
-    or without the pipeline)."""
+    ``workers`` threads. A failed job is re-run up to ``retries`` times
+    (transient packer/``device_put`` hiccups heal in place); a persistent
+    exception surfaces at the failing job's position — the window keeps
+    order deterministic (same batches, same sequence, with or without the
+    pipeline)."""
     workers = workers or config.get_flag("feed_pipeline_workers")
     depth = depth or config.get_flag("feed_pipeline_depth")
+    if retries is None:
+        retries = config.get_flag("feed_pipeline_retries")
+
+    def run(job: T) -> R:
+        _fault_fire("pipeline.prefetch_job")
+        return fn(job)
+
     it = iter(jobs)
     ex = ThreadPoolExecutor(max_workers=workers)
     futs: deque = deque()
     try:
         for job in it:
-            futs.append(ex.submit(fn, job))
+            futs.append((job, ex.submit(run, job)))
             if len(futs) >= depth:
                 break
         sentinel = object()
         while futs:
-            f = futs.popleft()
+            job, f = futs.popleft()
             nxt = next(it, sentinel)
             if nxt is not sentinel:
-                futs.append(ex.submit(fn, nxt))
-            yield f.result()
+                futs.append((nxt, ex.submit(run, nxt)))
+            try:
+                yield f.result()
+            except Exception:
+                # retry in the consumer thread: delivery position (and thus
+                # order) is preserved by construction, and the in-flight
+                # window behind this job keeps working meanwhile
+                from paddlebox_tpu.utils.monitor import STAT_ADD
+
+                for attempt in range(max(0, retries)):
+                    STAT_ADD("pipeline_prefetch_retries")
+                    try:
+                        yield run(job)
+                        break
+                    except Exception:
+                        if attempt + 1 >= retries:
+                            raise
+                else:
+                    raise
     finally:
-        for f in futs:
+        for _, f in futs:
             f.cancel()
         ex.shutdown(wait=True, cancel_futures=True)

@@ -466,15 +466,22 @@ class HostSparseTable:
                 keys=keys, values=vals,
             )
 
-    def save_delta(self, path: str) -> int:
-        """Write only keys touched since the last save; returns count."""
+    def save_delta(self, path: str, clear_touched: bool = True) -> int:
+        """Write only keys touched since the last save; returns count.
+
+        ``clear_touched=False`` keeps the touched set intact so the caller
+        can defer the clear (via :meth:`clear_touched`) until the written
+        delta is durable — a crashed-and-retried save then re-snapshots the
+        same keys instead of publishing an empty delta.
+        """
         self.drain_pending()
         os.makedirs(path, exist_ok=True)
         total = 0
         with self._maintenance_lock:  # stamp/snapshot atomicity (see save_base)
             epoch = self.decay_epochs
             snaps = [
-                self._snapshot_shard(s, only_touched=True)
+                self._snapshot_shard(s, only_touched=True,
+                                     clear_touched=clear_touched)
                 for s in range(self.n_shards)
             ]
         for s, (keys, vals) in enumerate(snaps):
@@ -493,6 +500,23 @@ class HostSparseTable:
                 f,
             )
         return total
+
+    def clear_touched(self) -> None:
+        """Drop the touched-keys set on every shard.
+
+        Pairs with ``save_delta(..., clear_touched=False)``: the checkpoint
+        layer snapshots without clearing, publishes durably, commits the
+        cursor, and only THEN clears — so a crash anywhere inside the save
+        leaves the touched set armed for the retry. Call only at a
+        quiescent point (no concurrent pushes), or updates between the
+        snapshot and this clear would drop out of the next delta.
+        """
+        if self._native is not None:
+            self._native.clear_touched()
+            return
+        for shard in self._shards:
+            with shard.lock:
+                shard.touched.clear()
 
     def cache_threshold(self, cache_rate: float = 0.1) -> float:
         """Show-count threshold whose admitted fraction is CLOSEST to

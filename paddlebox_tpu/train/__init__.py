@@ -6,6 +6,13 @@ from paddlebox_tpu.train.sharded_step import (
 )
 from paddlebox_tpu.train.async_dense import AsyncDenseTable
 from paddlebox_tpu.train.checkpoint import CheckpointManager
+from paddlebox_tpu.train.supervisor import (
+    HealthGates,
+    PassFailure,
+    PassRejected,
+    PassSupervisor,
+    RetryPolicy,
+)
 from paddlebox_tpu.train.trainer import CTRTrainer
 
 __all__ = [
@@ -18,4 +25,9 @@ __all__ = [
     "AsyncDenseTable",
     "CTRTrainer",
     "CheckpointManager",
+    "HealthGates",
+    "PassFailure",
+    "PassRejected",
+    "PassSupervisor",
+    "RetryPolicy",
 ]

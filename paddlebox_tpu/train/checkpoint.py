@@ -11,23 +11,105 @@ Directory layout managed here:
 
     root/
       cursor.json                  {"date", "delta_idx"} — last durable state
+      cursor.prev.json             the cursor this one replaced (fallback)
       <date>/base/                 full sparse snapshot (HostSparseTable dir)
       <date>/delta-NNNN/           touched-keys snapshots, applied in order
-      <date>/dense.npz             dense params + optimizer state
+      <date>/dense-NNNN.npz        dense params + optimizer state per save
 
-``resume()`` rebuilds the newest durable state: load the cursor date's base,
-apply its deltas in order, restore dense — then training re-enters at the
-next pass with deterministic file striping (the reference's day-level
-re-entry model).
+Durability discipline (the robustness tentpole):
+
+- Sparse snapshot dirs are written to a ``.tmp`` sibling, stamped with a
+  ``manifest.json`` carrying per-file size+CRC32, and published atomically
+  via ``os.replace`` — a crash mid-save can never leave a half-written dir
+  under the final name.
+- The cursor is rewritten (atomically) only after every artifact it names
+  is durable, so the crash window between any two writes leaves the cursor
+  pointing at the previous consistent (sparse, dense) pair.
+- ``resume()`` verifies manifests before trusting a snapshot and walks
+  back to the newest consistent state (shorter delta chain, or the
+  previous cursor) instead of loading a torn one.
+
+Injection sites (utils/faultinject): ``checkpoint.save`` fires at each
+durability boundary inside save_base/save_delta (hit counts select a crash
+window — see docs/ROBUSTNESS.md); ``checkpoint.load`` fires in resume()
+before the base load and before each delta apply.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
+import zlib
 from typing import Any, Dict, Optional
 
 from paddlebox_tpu.table.sparse_table import HostSparseTable
+from paddlebox_tpu.utils.faultinject import fire as _fault_fire
+from paddlebox_tpu.utils.monitor import STAT_ADD
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_NAME = "manifest.json"
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def write_manifest(snap_dir: str) -> str:
+    """Stamp ``snap_dir`` with per-file size+CRC32 over its current
+    contents. Written atomically (tmp + replace) so a torn manifest can
+    never pass for a complete one."""
+    files: Dict[str, Dict[str, int]] = {}
+    for name in sorted(os.listdir(snap_dir)):
+        p = os.path.join(snap_dir, name)
+        if name == MANIFEST_NAME or not os.path.isfile(p):
+            continue
+        files[name] = {"size": os.path.getsize(p), "crc32": _file_crc32(p)}
+    mpath = os.path.join(snap_dir, MANIFEST_NAME)
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"files": files}, f)
+    os.replace(tmp, mpath)
+    return mpath
+
+
+def verify_snapshot(snap_dir: str, require_manifest: bool = False) -> bool:
+    """True iff ``snap_dir`` holds a complete, uncorrupted snapshot.
+
+    Every manifest entry must exist with the recorded size and CRC32. A
+    dir without a manifest is a pre-manifest (legacy) snapshot: accepted
+    unless ``require_manifest`` (counted so operators can see unverified
+    loads), since refusing would brick every old checkpoint tree."""
+    if not os.path.isdir(snap_dir):
+        return False
+    mpath = os.path.join(snap_dir, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        if require_manifest:
+            return False
+        STAT_ADD("ckpt_unverified_snapshots")
+        return os.path.exists(os.path.join(snap_dir, "meta.json"))
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        for name, want in manifest["files"].items():
+            p = os.path.join(snap_dir, name)
+            if not os.path.exists(p):
+                return False
+            if os.path.getsize(p) != want["size"]:
+                return False
+            if _file_crc32(p) != want["crc32"]:
+                return False
+    except (OSError, ValueError, KeyError):
+        return False
+    return True
 
 
 class CheckpointManager:
@@ -43,35 +125,79 @@ class CheckpointManager:
     def _cursor_path(self) -> str:
         return os.path.join(self.root, "cursor.json")
 
-    def cursor(self) -> Optional[Dict[str, Any]]:
-        p = self._cursor_path()
-        if not os.path.exists(p):
+    def _prev_cursor_path(self) -> str:
+        return os.path.join(self.root, "cursor.prev.json")
+
+    def _read_cursor(self, path: str) -> Optional[Dict[str, Any]]:
+        if not os.path.exists(path):
             return None
-        with open(p) as f:
-            return json.load(f)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # a torn cursor reads as absent, never as garbage
+
+    def cursor(self) -> Optional[Dict[str, Any]]:
+        return self._read_cursor(self._cursor_path())
+
+    def prev_cursor(self) -> Optional[Dict[str, Any]]:
+        return self._read_cursor(self._prev_cursor_path())
 
     def _write_cursor(self, date: str, delta_idx: int, dense: Optional[str]) -> None:
-        tmp = self._cursor_path() + ".tmp"
         cur = {"date": date, "delta_idx": delta_idx}
         if dense is not None:
             cur["dense"] = dense  # the dense file this sparse state pairs with
+        # keep the superseded cursor as the fallback anchor: if every
+        # artifact of the NEW state later verifies torn (bit rot, torn
+        # copy), resume() can still land on the previous consistent state
+        old = self.cursor()
+        if old is not None and old != cur:
+            ptmp = self._prev_cursor_path() + ".tmp"
+            with open(ptmp, "w") as f:
+                json.dump(old, f)
+            os.replace(ptmp, self._prev_cursor_path())
+        tmp = self._cursor_path() + ".tmp"
         with open(tmp, "w") as f:
             json.dump(cur, f)
         os.replace(tmp, self._cursor_path())  # atomic: crash-safe cursor
 
     # ---- save ------------------------------------------------------------
 
+    def _publish_snapshot(self, write_fn, final_dir: str) -> None:
+        """tmp dir -> write_fn -> manifest -> atomic rename to final_dir.
+
+        A crash anywhere before the rename leaves only the ``.tmp``
+        sibling; the final name either doesn't exist or holds the complete
+        previous snapshot. Retried saves clear stale tmp leftovers."""
+        tmp = final_dir + ".tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)  # torn leftover from a failed attempt
+        os.makedirs(tmp, exist_ok=True)
+        write_fn(tmp)
+        _fault_fire("checkpoint.save")  # window: sparse written, unpublished
+        write_manifest(tmp)
+        if os.path.isdir(final_dir):
+            # a complete snapshot is being overwritten (re-save of the same
+            # pass after a downstream failure): drop it just before the
+            # rename — the cursor never points here until we finish
+            shutil.rmtree(final_dir)
+        os.replace(tmp, final_dir)
+
     def save_base(self, date: str, table: HostSparseTable, trainer=None) -> str:
         """Full sparse snapshot + dense (SaveBase parity). Resets the day's
         delta counter — deltas are relative to this base."""
+        _fault_fire("checkpoint.save")  # window: nothing written yet
         day = self._day(date)
-        table.save_base(os.path.join(day, "base"))
+        base_dir = os.path.join(day, "base")
+        self._publish_snapshot(table.save_base, base_dir)
+        _fault_fire("checkpoint.save")  # window: sparse published, no dense
         dense = None
         if trainer is not None:
             dense = "dense-0000.npz"
             trainer.save_dense(os.path.join(day, dense))
+        _fault_fire("checkpoint.save")  # window: all durable, cursor stale
         self._write_cursor(date, delta_idx=0, dense=dense)
-        return os.path.join(day, "base")
+        return base_dir
 
     def save_delta(self, date: str, table: HostSparseTable, trainer=None) -> str:
         """Touched-keys snapshot (SaveDelta / xbox online-publish parity).
@@ -88,15 +214,25 @@ class CheckpointManager:
                 f"no base saved for date {date!r} — save_base first "
                 "(deltas are relative to a base)"
             )
+        _fault_fire("checkpoint.save")  # window: nothing written yet
         idx = cur["delta_idx"] + 1
         day = self._day(date)
         path = os.path.join(day, f"delta-{idx:04d}")
-        table.save_delta(path)
+        # defer the touched-set clear until the cursor commits: a save that
+        # crashes after publishing (but before the cursor names it) retries
+        # with the SAME touched keys instead of snapshotting an empty delta
+        # over the published one
+        self._publish_snapshot(
+            lambda d: table.save_delta(d, clear_touched=False), path
+        )
+        _fault_fire("checkpoint.save")  # window: delta published, no dense
         dense = cur.get("dense")
         if trainer is not None:
             dense = f"dense-{idx:04d}.npz"
             trainer.save_dense(os.path.join(day, dense))
+        _fault_fire("checkpoint.save")  # window: all durable, cursor stale
         self._write_cursor(date, delta_idx=idx, dense=dense)
+        table.clear_touched()  # delta committed: keys count as saved now
         # retire dense files older than the previous cursor (keep one back
         # for safety against torn reads of cursor.json readers) — but never
         # the file the new cursor itself references (deltas saved with
@@ -109,30 +245,89 @@ class CheckpointManager:
             if os.path.exists(stale):
                 try:
                     os.remove(stale)
-                except OSError:
-                    pass
+                except OSError as e:
+                    # a leaked dense file is an ops problem (disk creep on
+                    # multi-day runs) — count it and say which file
+                    STAT_ADD("ckpt_dense_retire_failures")
+                    logger.warning(
+                        "failed to retire stale dense checkpoint %s: %s",
+                        stale, e,
+                    )
         return path
 
     # ---- resume ----------------------------------------------------------
 
+    def _consistent_state(self, cur: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Verify ``cur``'s artifacts; return the newest consistent state
+        reachable from it (possibly a shorter delta chain), or None when
+        even the base is torn/missing."""
+        day = self._day(cur["date"])
+        if not verify_snapshot(os.path.join(day, "base")):
+            return None
+        m = 0
+        for i in range(1, cur["delta_idx"] + 1):
+            if not verify_snapshot(os.path.join(day, f"delta-{i:04d}")):
+                break  # deltas apply in order: a torn link truncates the chain
+            m = i
+        dense = cur.get("dense")
+        if m < cur["delta_idx"]:
+            # walked back: the cursor's dense pairs with the full chain, so
+            # re-pair with the newest surviving dense at or below m
+            dense = None
+            for i in range(m, -1, -1):
+                name = f"dense-{i:04d}.npz"
+                if os.path.exists(os.path.join(day, name)):
+                    dense = name
+                    break
+        return {"date": cur["date"], "delta_idx": m, "dense": dense}
+
     def resume(self, table: HostSparseTable, trainer=None) -> Optional[Dict[str, Any]]:
         """Rebuild the newest durable state into ``table`` (+ trainer dense).
 
-        Returns the cursor ({"date", "delta_idx"}) or None when nothing was
+        Every snapshot is manifest-verified before it is trusted: a torn
+        delta truncates the chain to the last consistent link, a torn base
+        falls back to the previous cursor's state — resume never loads a
+        half-written snapshot. Returns the state actually loaded
+        ({"date", "delta_idx", ...}) or None when nothing consistent was
         ever saved (cold start).
         """
         cur = self.cursor()
         if cur is None:
-            return None
-        day = self._day(cur["date"])
+            # a torn/missing cursor with an intact predecessor is a crash
+            # mid-rotation, not a cold start — resume from the predecessor
+            cur = self.prev_cursor()
+            if cur is None:
+                return None
+            STAT_ADD("ckpt_resume_fallbacks")
+            logger.warning("cursor unreadable; resuming from prev cursor %s", cur)
+        state = self._consistent_state(cur)
+        if state is None or state["delta_idx"] < cur["delta_idx"]:
+            STAT_ADD("ckpt_resume_fallbacks")
+            logger.warning(
+                "checkpoint state %s is torn; falling back (candidate: %s)",
+                cur, state,
+            )
+        if state is None:
+            prev = self.prev_cursor()
+            if prev is not None:
+                state = self._consistent_state(prev)
+            if state is None:
+                raise RuntimeError(
+                    f"no consistent checkpoint reachable from cursor {cur} "
+                    f"(prev {self.prev_cursor()}) — every candidate snapshot "
+                    "failed manifest verification"
+                )
+        day = self._day(state["date"])
+        _fault_fire("checkpoint.load")
         table.load(os.path.join(day, "base"))
-        for i in range(1, cur["delta_idx"] + 1):
+        for i in range(1, state["delta_idx"] + 1):
+            _fault_fire("checkpoint.load")
             table.apply_delta(os.path.join(day, f"delta-{i:04d}"))
         # per-save dense file named in the cursor; "dense.npz" is the
         # pre-versioning layout (older checkpoints)
-        dense = os.path.join(day, cur.get("dense") or "dense.npz")
+        dense = os.path.join(day, state.get("dense") or "dense.npz")
         if trainer is not None and os.path.exists(dense):
             if trainer.params is None:
                 trainer.init_params()
             trainer.load_dense(dense)
-        return cur
+        return state

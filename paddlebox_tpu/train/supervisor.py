@@ -1,0 +1,362 @@
+"""PassSupervisor: the self-healing pass/day loop.
+
+The repo has had the recovery *pieces* for a while — PassGuard
+confirm/revert (train/rollback.py, FleetWrapper::Confirm/Revert parity),
+retry-until-open on flaky inputs (utils/fs.py, data_feed.cc:2738-2740
+parity), NaN-batch containment in the device step, and day-level
+base+delta resume (train/checkpoint.py). What production actually needs is
+the layer that COMPOSES them: a multi-day CTR run survives a bad pass
+because something notices, reverts, retries, and — when retries don't help
+— falls back to the last durable state and re-enters. That layer is
+``PassSupervisor``.
+
+One supervised pass runs:
+
+    load (fs retries inside) -> begin_pass(enable_revert) [guard armed]
+      -> prepare_pass -> train_pass -> health gates -> end_pass [confirm]
+      -> optional checkpoint publish (base/delta, manifest-verified)
+
+Any exception or gate rejection reverts the pass (bit-exact: retraining
+after revert equals a never-interrupted run, pinned by
+tests/test_rollback.py) and retries under bounded exponential backoff.
+When ``max_retries`` is exhausted the supervisor escalates once: it
+restores the last durable checkpoint state via ``CheckpointManager.
+resume()`` (manifest-verified, torn-snapshot fallback) and re-enters with
+a fresh retry budget. Every action lands in a structured incident log —
+``self.incidents``, process-wide counters in utils/monitor, and instant
+events in the utils/trace timeline.
+
+Health gates (the "pass is poisoned" detectors the reference applies by
+operator convention):
+
+- NaN gate: the ratio of NaN-skipped batches (the step's containment
+  counter) must stay under ``nan_ratio_max`` — a pass that skims over too
+  many poisoned batches is itself poisoned.
+- AUC floor: the pass AUC must not fall more than ``auc_floor_margin``
+  below the trailing mean of the last ``auc_window`` CONFIRMED passes
+  (only consulted after ``auc_min_history`` confirmations, so a cold
+  start can't self-reject).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu import config
+from paddlebox_tpu.utils.monitor import STAT_ADD
+from paddlebox_tpu.utils.trace import PROFILER
+
+config.define_flag(
+    "supervisor_max_retries",
+    2,
+    "revert+retry attempts per pass before the supervisor escalates to a "
+    "checkpoint resume (and, failing that, gives up)",
+)
+
+
+class PassRejected(RuntimeError):
+    """A health gate rejected an otherwise-completed pass."""
+
+    def __init__(self, gate: str, detail: str):
+        super().__init__(f"pass rejected by {gate} gate: {detail}")
+        self.gate = gate
+        self.detail = detail
+
+
+class PassFailure(RuntimeError):
+    """The supervisor exhausted retries AND escalation for one pass."""
+
+
+@dataclass
+class HealthGates:
+    nan_ratio_max: float = 0.05
+    auc_window: int = 5
+    auc_min_history: int = 3
+    auc_floor_margin: float = 0.05
+    auc_absolute_floor: Optional[float] = None
+
+
+@dataclass
+class RetryPolicy:
+    max_retries: Optional[int] = None  # None -> supervisor_max_retries flag
+    backoff_s: float = 0.5
+    backoff_mult: float = 2.0
+    backoff_max_s: float = 30.0
+    # injectable for tests (chaos schedules must not wall-clock sleep)
+    sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
+
+    @property
+    def retries(self) -> int:
+        if self.max_retries is not None:
+            return self.max_retries
+        return int(config.get_flag("supervisor_max_retries"))
+
+    def backoff(self, attempt: int) -> float:
+        return min(
+            self.backoff_s * self.backoff_mult ** max(0, attempt - 1),
+            self.backoff_max_s,
+        )
+
+
+@dataclass
+class Incident:
+    """One structured entry of the supervisor's incident log."""
+
+    pass_seq: int
+    date: Optional[str]
+    kind: str      # load_error | train_error | gate_nan | gate_auc |
+                   # ckpt_save_error | escalate_resume | gave_up | skipped
+    action: str    # retry | revert_retry | resume | raise | skip
+    attempt: int
+    detail: str = ""
+    wall_time: float = field(default_factory=time.time)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "pass_seq": self.pass_seq,
+            "date": self.date,
+            "kind": self.kind,
+            "action": self.action,
+            "attempt": self.attempt,
+            "detail": self.detail,
+            "wall_time": self.wall_time,
+        }
+
+
+class PassSupervisor:
+    """Fault-tolerant driver for the pass/day loop of one trainer.
+
+    ``checkpoint`` (a CheckpointManager) enables both the escalation path
+    and the per-pass publishing ``run_day`` performs; without it the
+    supervisor still reverts/retries but gives up when retries exhaust.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        trainer,
+        checkpoint=None,
+        gates: Optional[HealthGates] = None,
+        retry: Optional[RetryPolicy] = None,
+        round_to: int = 512,
+        shrink: bool = True,
+        on_give_up: str = "raise",  # raise | skip (drop the pass, keep the day)
+    ):
+        if on_give_up not in ("raise", "skip"):
+            raise ValueError(f"on_give_up must be 'raise' or 'skip', got {on_give_up!r}")
+        self.ds = dataset
+        self.tr = trainer
+        self.table = dataset.table
+        self.checkpoint = checkpoint
+        self.gates = gates or HealthGates()
+        self.retry = retry or RetryPolicy()
+        self.round_to = round_to
+        self.shrink = shrink
+        self.on_give_up = on_give_up
+        self.incidents: List[Incident] = []
+        self._auc_history: deque = deque(maxlen=self.gates.auc_window)
+        self._pass_seq = 0
+        self._date: Optional[str] = None
+
+    # ---- incident log ----------------------------------------------------
+
+    def _record(self, kind: str, action: str, attempt: int, detail: str = "") -> Incident:
+        inc = Incident(
+            pass_seq=self._pass_seq,
+            date=self._date,
+            kind=kind,
+            action=action,
+            attempt=attempt,
+            detail=detail,
+        )
+        self.incidents.append(inc)
+        STAT_ADD("supervisor_incidents")
+        STAT_ADD(f"supervisor_{kind}")
+        PROFILER.instant(f"supervisor:{kind}", inc.as_dict())
+        return inc
+
+    # ---- pieces ----------------------------------------------------------
+
+    def _load_with_retry(self, date: Optional[str], files: Sequence[str]) -> None:
+        for attempt in range(self.retry.retries + 1):
+            try:
+                if date is not None:
+                    self.ds.set_date(date)
+                self.ds.set_filelist(list(files))
+                self.ds.load_into_memory()
+                return
+            except Exception as e:
+                # the fs tier already burned its own retry-until-open
+                # budget; reaching here means the input is still missing
+                # or the reader died mid-stream
+                if attempt >= self.retry.retries:
+                    self._record("load_error", "raise", attempt, repr(e))
+                    raise PassFailure(
+                        f"load failed after {attempt + 1} attempts: {e}"
+                    ) from e
+                self._record("load_error", "retry", attempt, repr(e))
+                self.retry.sleep(self.retry.backoff(attempt + 1))
+
+    def _gate(self, out: Dict[str, float]) -> None:
+        g = self.gates
+        batches = out.get("batches", 0.0)
+        if batches:
+            ratio = out.get("nan_batches", 0.0) / batches
+            if ratio > g.nan_ratio_max:
+                raise PassRejected(
+                    "nan",
+                    f"{ratio:.3f} of batches NaN-skipped "
+                    f"(max {g.nan_ratio_max:.3f})",
+                )
+        auc = out.get("auc")
+        if auc is None or not np.isfinite(auc):
+            return
+        if g.auc_absolute_floor is not None and auc < g.auc_absolute_floor:
+            raise PassRejected(
+                "auc", f"auc {auc:.4f} under absolute floor {g.auc_absolute_floor:.4f}"
+            )
+        if len(self._auc_history) >= g.auc_min_history:
+            floor = float(np.mean(self._auc_history)) - g.auc_floor_margin
+            if auc < floor:
+                raise PassRejected(
+                    "auc",
+                    f"auc {auc:.4f} under trailing floor {floor:.4f} "
+                    f"(window of {len(self._auc_history)} confirmed passes)",
+                )
+
+    def _attempt(self, n_batches: Optional[int]) -> Dict[str, float]:
+        """One armed begin->train->gate->confirm cycle."""
+        if not self.ds._in_pass:
+            # first attempt, or a revert re-armed the in-memory data
+            self.ds.begin_pass(
+                round_to=self.round_to, enable_revert=True, trainer=self.tr
+            )
+        self.tr.prepare_pass(self.ds, n_batches)
+        out = self.tr.train_pass(self.ds, n_batches=n_batches)
+        self._gate(out)
+        # classic (host) writeback: a guard is armed, so the carried-table
+        # boundary is gated off anyway — hand over the host copy
+        self.ds.end_pass(self.tr.trained_table(), shrink=self.shrink)
+        return out
+
+    def _revert(self, attempt: int, cause: BaseException) -> None:
+        kind = (
+            f"gate_{cause.gate}" if isinstance(cause, PassRejected) else "train_error"
+        )
+        try:
+            self.ds.revert_pass()
+        except Exception as e:
+            # an unrevertable pass (guard lost, revert itself died) can
+            # only be healed by the durable tier
+            self._record(kind, "revert_failed", attempt, f"{cause!r}; revert: {e!r}")
+            raise PassFailure(f"revert failed after {cause!r}: {e}") from e
+        self._record(kind, "revert_retry", attempt, repr(cause))
+
+    def _escalate(self, attempt: int, cause: BaseException) -> None:
+        """Resume the last durable (manifest-verified) state and re-enter."""
+        state = self.checkpoint.resume(self.table, self.tr)
+        # external overwrite of table rows + dense params: the trainer's
+        # cached device state is stale now
+        self.tr._state = None
+        self.tr._state_ws = None
+        self._record(
+            "escalate_resume", "resume", attempt, f"{cause!r} -> resumed {state}"
+        )
+
+    def _save_checkpoint(self, mode: str) -> None:
+        assert self.checkpoint is not None
+        for attempt in range(self.retry.retries + 1):
+            try:
+                if mode == "base":
+                    self.checkpoint.save_base(self._date, self.table, self.tr)
+                else:
+                    self.checkpoint.save_delta(self._date, self.table, self.tr)
+                return
+            except Exception as e:
+                # atomic publishing means a failed attempt left nothing
+                # under a final name — a retry starts clean
+                if attempt >= self.retry.retries:
+                    self._record("ckpt_save_error", "raise", attempt, repr(e))
+                    raise PassFailure(
+                        f"checkpoint {mode} save failed after "
+                        f"{attempt + 1} attempts: {e}"
+                    ) from e
+                self._record("ckpt_save_error", "retry", attempt, repr(e))
+                self.retry.sleep(self.retry.backoff(attempt + 1))
+
+    # ---- the supervised pass --------------------------------------------
+
+    def run_pass(
+        self,
+        files: Sequence[str],
+        date: Optional[str] = None,
+        n_batches: Optional[int] = None,
+        save: Optional[str] = None,  # None | "base" | "delta"
+    ) -> Optional[Dict[str, float]]:
+        """Load, train, gate, and publish one pass, healing failures.
+
+        Returns the pass metrics, or None when the pass was dropped
+        (``on_give_up="skip"`` after retries AND escalation failed).
+        """
+        if save not in (None, "base", "delta"):
+            raise ValueError(f"save must be None, 'base' or 'delta', got {save!r}")
+        if save is not None and self.checkpoint is None:
+            raise ValueError("save requires a CheckpointManager")
+        self._pass_seq += 1
+        self._date = date if date is not None else self._date
+        self._load_with_retry(date, files)
+        escalated = False
+        attempt = 0
+        while True:
+            try:
+                with PROFILER.record_event("supervised_pass_attempt", "supervisor"):
+                    out = self._attempt(n_batches)
+                break
+            except Exception as e:
+                self._revert(attempt, e)
+                attempt += 1
+                if attempt > self.retry.retries:
+                    if not escalated and self.checkpoint is not None:
+                        self._escalate(attempt, e)
+                        escalated = True
+                        attempt = 0
+                        continue
+                    if self.on_give_up == "skip":
+                        self._record("gave_up", "skip", attempt, repr(e))
+                        return None
+                    self._record("gave_up", "raise", attempt, repr(e))
+                    raise PassFailure(
+                        f"pass {self._pass_seq} failed after retries"
+                        + (" and checkpoint resume" if escalated else "")
+                    ) from e
+                self.retry.sleep(self.retry.backoff(attempt))
+        auc = out.get("auc")
+        if auc is not None and np.isfinite(auc):
+            self._auc_history.append(float(auc))
+        if save is not None:
+            self._save_checkpoint(save)
+        return out
+
+    def run_day(
+        self,
+        date: str,
+        pass_files: Sequence[Sequence[str]],
+        n_batches: Optional[int] = None,
+        publish: bool = True,
+    ) -> List[Optional[Dict[str, float]]]:
+        """One day = base save after the first pass, delta saves after the
+        rest (the reference's SaveBase + per-pass need_save_delta cadence).
+        ``publish=False`` trains without checkpointing."""
+        outs: List[Optional[Dict[str, float]]] = []
+        do_save = publish and self.checkpoint is not None
+        for p, files in enumerate(pass_files):
+            mode = None if not do_save else ("base" if p == 0 else "delta")
+            outs.append(
+                self.run_pass(files, date=date, n_batches=n_batches, save=mode)
+            )
+        return outs

@@ -54,6 +54,7 @@ from paddlebox_tpu.train.train_step import (
     make_train_step,
 )
 from paddlebox_tpu.utils.dump import DumpWorkerPool, dump_fields, dump_param
+from paddlebox_tpu.utils.faultinject import fire as _fault_fire
 from paddlebox_tpu.utils.trace import PROFILER
 from paddlebox_tpu import config
 
@@ -631,6 +632,9 @@ class CTRTrainer:
                 else:
                     fresh = jax.device_put(fresh)
                 holder["state"] = holder["state"]._replace(params=fresh)
+            # chaos seam: a per-batch device failure (OOM, interconnect
+            # reset, preempted core) surfaces here as a dispatch exception
+            _fault_fire("step.device")
             t_disp.start()
             with PROFILER.record_event("train_step_dispatch", "pass"):
                 holder["state"], m = step_fn(holder["state"], feed)
@@ -849,6 +853,7 @@ class CTRTrainer:
                     )
                 else:
                     idx_dev = jnp.asarray(np.stack(chunk))
+                _fault_fire("step.device")  # chaos seam (see classic stepper)
                 t_disp.start()
                 with PROFILER.record_event("superstep_dispatch", "pass"):
                     holder["state"], mstack = sstep(holder["state"], idx_dev)

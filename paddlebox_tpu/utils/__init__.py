@@ -4,13 +4,23 @@ Reference: paddle/fluid/framework/io/{fs,shell}.*, string/string_helper.h,
 platform/{timer,monitor,profiler}.* (SURVEY.md B20/B21 + §5).
 """
 
+from paddlebox_tpu.utils.faultinject import (  # noqa: F401
+    InjectedFault,
+    fail_always,
+    fail_nth,
+    fail_once,
+    fail_prob,
+    inject,
+)
 from paddlebox_tpu.utils.fs import (  # noqa: F401
     FileMgr,
     fs_exists,
     fs_glob,
     fs_mkdir,
     fs_open_read,
+    fs_open_read_retry,
     fs_open_write,
+    fs_open_write_retry,
     fs_remove,
 )
 from paddlebox_tpu.utils.line_reader import (  # noqa: F401

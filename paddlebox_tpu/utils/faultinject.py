@@ -1,0 +1,204 @@
+"""Deterministic fault injection for the pass/day robustness loop.
+
+The reference earns its multi-day soak claims through recovery machinery
+(Confirm/Revert on the PS tables fleet_wrapper.h:319-321, retry-until-open
+on transiently missing inputs data_feed.cc:2738-2740, base+delta publishing
+a restarted job resumes from). Those mechanisms are only as trustworthy as
+the failure harness that exercises them — so this module gives every
+recovery seam a *named injection site* that tests can arm with seeded,
+counted triggers and tear down hermetically.
+
+Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
+
+    fs.open_read            utils/fs.py  fs_open_read / fs_read_bytes_retry
+    fs.open_write           utils/fs.py  fs_open_write
+    pipeline.prefetch_job   data/pipeline.py  each prefetch job execution
+    checkpoint.save         train/checkpoint.py  each durability boundary
+                            inside save_base/save_delta (multiple fires per
+                            save — hit counts select a crash window)
+    checkpoint.load         train/checkpoint.py  resume(): before base load
+                            and before each delta apply
+    step.device             train/trainer.py  before each device-step (or
+                            superstep) dispatch
+
+A site fires via :func:`fire`; when no plan is installed that is a single
+global read, so production paths pay nothing. Tests install a
+:class:`FaultPlan` through the :func:`inject` context manager:
+
+    with inject(fail_nth("fs.open_read", 1)):          # flake once, heal
+        ...
+
+Triggers compose per rule: ``nth`` fails one specific hit, ``prob`` fails
+each hit with probability p under a fixed seed, and ``times`` bounds how
+many failures a rule deals before going inert (``times=1`` is
+fail-once-then-heal). All counters are plan-scoped, so a test's schedule
+can never leak into the next test.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class InjectedFault(OSError):
+    """Deterministic injected failure.
+
+    Subclasses OSError on purpose: the fs retry tier (``_retry_open``)
+    treats OSError as transient, so an injected flake exercises exactly
+    the production retry path.
+    """
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(f"injected fault at site {site!r} (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+
+@dataclass
+class FaultRule:
+    """One trigger bound to one site.
+
+    ``nth``    1-based hit index (counted from plan install) that fails.
+    ``prob``   iid failure probability per hit, drawn from ``seed``.
+    ``times``  failure budget before the rule heals (None = unlimited).
+    ``exc``    optional factory ``(site, hit) -> BaseException``.
+    """
+
+    site: str
+    nth: Optional[int] = None
+    prob: float = 0.0
+    seed: int = 0
+    times: Optional[int] = 1
+    exc: Optional[Callable[[str, int], BaseException]] = None
+    _rng: np.random.Generator = field(init=False, repr=False)
+    _fired: int = field(default=0, init=False, repr=False)
+
+    def __post_init__(self) -> None:
+        self._rng = np.random.default_rng(self.seed)
+
+    def should_fail(self, hit: int) -> bool:
+        if self.times is not None and self._fired >= self.times:
+            return False
+        if self.nth is not None and hit == self.nth:
+            return True
+        # the draw happens on every hit the budget allows, so a schedule's
+        # failure positions depend only on (seed, hit sequence)
+        if self.prob > 0.0 and self._rng.random() < self.prob:
+            return True
+        return False
+
+    def make_exc(self, hit: int) -> BaseException:
+        self._fired += 1
+        if self.exc is not None:
+            return self.exc(self.site, hit)
+        return InjectedFault(self.site, hit)
+
+
+class FaultPlan:
+    """An installed set of rules + per-site hit/failure counters."""
+
+    def __init__(self, rules: List[FaultRule]):
+        self._rules: Dict[str, List[FaultRule]] = {}
+        for r in rules:
+            self._rules.setdefault(r.site, []).append(r)
+        self._hits: Dict[str, int] = {}
+        self._failures: Dict[str, int] = {}
+        # sites fire from worker threads (prefetch pool, end_pass_async
+        # publisher), so counter state must be serialized
+        self._lock = threading.Lock()
+
+    def hit(self, site: str) -> None:
+        with self._lock:
+            n = self._hits.get(site, 0) + 1
+            self._hits[site] = n
+            for rule in self._rules.get(site, ()):
+                if rule.should_fail(n):
+                    self._failures[site] = self._failures.get(site, 0) + 1
+                    exc = rule.make_exc(n)
+                    break
+            else:
+                return
+        from paddlebox_tpu.utils.monitor import STAT_ADD
+
+        STAT_ADD("faults_injected")
+        raise exc
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def failures(self, site: str) -> int:
+        with self._lock:
+            return self._failures.get(site, 0)
+
+
+_active: Optional[FaultPlan] = None
+_install_lock = threading.Lock()
+
+
+def fire(site: str) -> None:
+    """Injection-site hook. No-op (one global read) when nothing is armed."""
+    plan = _active
+    if plan is not None:
+        plan.hit(site)
+
+
+@contextmanager
+def inject(*rules: FaultRule) -> Iterator[FaultPlan]:
+    """Install ``rules`` for the dynamic extent of the block (hermetic:
+    the previous plan — usually none — is restored on exit, even on
+    error). Yields the plan so tests can read hit/failure counters."""
+    global _active
+    plan = FaultPlan(list(rules))
+    with _install_lock:
+        prev, _active = _active, plan
+    try:
+        yield plan
+    finally:
+        with _install_lock:
+            _active = prev
+
+
+def fail_nth(
+    site: str,
+    n: int,
+    times: Optional[int] = 1,
+    exc: Optional[Callable[[str, int], BaseException]] = None,
+) -> FaultRule:
+    """Fail exactly the ``n``-th hit of ``site`` (1-based, counted from
+    plan install)."""
+    return FaultRule(site=site, nth=n, times=times, exc=exc)
+
+
+def fail_once(
+    site: str, exc: Optional[Callable[[str, int], BaseException]] = None
+) -> FaultRule:
+    """Fail the first hit, then heal — the canonical transient flake."""
+    return fail_nth(site, 1, times=1, exc=exc)
+
+
+def fail_always(
+    site: str,
+    times: Optional[int] = None,
+    exc: Optional[Callable[[str, int], BaseException]] = None,
+) -> FaultRule:
+    """Fail every hit (until ``times`` failures, if set) — a persistent
+    outage rather than a flake."""
+    return FaultRule(site=site, prob=1.0, times=times, exc=exc)
+
+
+def fail_prob(
+    site: str,
+    p: float,
+    seed: int = 0,
+    times: Optional[int] = None,
+    exc: Optional[Callable[[str, int], BaseException]] = None,
+) -> FaultRule:
+    """Fail each hit with probability ``p`` under a fixed seed; ``times``
+    caps the total failures (None = every drawn hit fails)."""
+    return FaultRule(site=site, prob=p, seed=seed, times=times, exc=exc)

@@ -26,11 +26,18 @@ import time
 from typing import IO, Iterator, List, Optional
 
 from paddlebox_tpu import config
+from paddlebox_tpu.utils.faultinject import fire as _fault_fire
 
 config.define_flag("hadoop_bin", "hadoop", "hadoop client binary for hdfs:/afs: paths")
 config.define_flag("hdfs_retry", 3, "retry count for remote fs commands")
 config.define_flag(
     "fs_open_retries", 3, "retry-until-open attempts for data files"
+)
+config.define_flag(
+    "fs_open_backoff_s",
+    1.0,
+    "base linear backoff (seconds) between retry-until-open attempts; "
+    "tests and chaos schedules turn it down to keep injected flakes cheap",
 )
 
 _REMOTE_PREFIXES = ("hdfs:", "afs:")
@@ -88,10 +95,12 @@ class _PipeStream:
             self.proc.wait()
 
 
-def _retry_open(fn, retries: Optional[int], backoff_s: float):
+def _retry_open(fn, retries: Optional[int], backoff_s: Optional[float]):
     """Shared retry-until-open policy: OSError -> linear backoff -> raise
     the last error after ``fs_open_retries`` attempts."""
     n = max(1, retries if retries is not None else config.get_flag("fs_open_retries"))
+    if backoff_s is None:
+        backoff_s = config.get_flag("fs_open_backoff_s")
     last: Optional[BaseException] = None
     for attempt in range(n):
         try:
@@ -99,6 +108,9 @@ def _retry_open(fn, retries: Optional[int], backoff_s: float):
         except OSError as e:
             last = e
             if attempt + 1 < n:
+                from paddlebox_tpu.utils.monitor import STAT_ADD
+
+                STAT_ADD("fs_open_retries_total")
                 time.sleep(backoff_s * (attempt + 1))
     raise last
 
@@ -107,7 +119,7 @@ def fs_open_read_retry(
     path: str,
     converter: Optional[str] = None,
     retries: Optional[int] = None,
-    backoff_s: float = 1.0,
+    backoff_s: Optional[float] = None,
 ):
     """Retry-until-open (data_feed.cc:2738-2740 parity): a transiently
     unavailable file — AFS flake, NFS lag, a part file still being
@@ -139,7 +151,7 @@ def fs_open_read_retry(
 
 
 def fs_read_bytes_retry(
-    path: str, retries: Optional[int] = None, backoff_s: float = 1.0
+    path: str, retries: Optional[int] = None, backoff_s: Optional[float] = None
 ) -> bytes:
     """Whole-file bytes with retry-until-open — LOCAL plain files only (the
     native parser's one-shot fast path; its caller routes remote/gz paths
@@ -151,6 +163,7 @@ def fs_read_bytes_retry(
         )
 
     def attempt():
+        _fault_fire("fs.open_read")
         with open(path, "rb") as f:
             return f.read()
 
@@ -164,6 +177,7 @@ def fs_open_read(path: str, converter: Optional[str] = None):
     transparently; ``converter`` (a shell command reading stdin) is spliced
     last, exactly where the reference puts pipe converters.
     """
+    _fault_fire("fs.open_read")
     if is_remote(path):
         cmd = f"{_hadoop_cmd()} -cat '{path}'"
         if path.endswith(".gz"):
@@ -182,9 +196,28 @@ def fs_open_read(path: str, converter: Optional[str] = None):
     return open(path, "r")
 
 
+def fs_open_write_retry(
+    path: str,
+    converter: Optional[str] = None,
+    retries: Optional[int] = None,
+    backoff_s: Optional[float] = None,
+):
+    """Retry-until-open for WRITES: the same policy as
+    ``fs_open_read_retry`` (a flaky AFS mount that rejects the first open
+    used to fail the whole pass on one OSError). Only the OPEN retries —
+    a mid-stream write failure still surfaces, since silently rewriting a
+    partially-flushed stream could duplicate data."""
+
+    def attempt():
+        return fs_open_write(path, converter)
+
+    return _retry_open(attempt, retries, backoff_s)
+
+
 def fs_open_write(path: str, converter: Optional[str] = None):
     """Writable text stream; remote goes through ``hadoop fs -put -``; local
     parents are created (fs_open_write parity: reference mkdir -p's first)."""
+    _fault_fire("fs.open_write")
     if is_remote(path):
         cmd = f"{_hadoop_cmd()} -put - '{path}'"
         if converter:

@@ -53,6 +53,27 @@ class Profiler:
                     }
                 )
 
+    def instant(self, name: str, args: Optional[Dict] = None,
+                category: str = "incident") -> None:
+        """Zero-duration structured event (chrome trace "i" phase): the
+        supervisor's incident log lands in the same timeline as the pass
+        stages it interrupted, with the details in ``args``."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "cat": category,
+                    "ph": "i",
+                    "s": "g",  # global scope: draw the incident across rows
+                    "ts": time.perf_counter_ns() / 1e3,
+                    "pid": 0,
+                    "tid": threading.get_ident() % 100000,
+                    "args": args or {},
+                }
+            )
+
     def export_chrome_trace(self, path: str) -> int:
         """Write chrome://tracing JSON (timeline.py parity). Returns #events."""
         with self._lock:

@@ -19,3 +19,10 @@ if "host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "chaos: fault-injected robustness schedules (fast ones run in tier-1)"
+    )
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 suite")

@@ -1,0 +1,267 @@
+"""Crash-window tests for CheckpointManager durability.
+
+Every ``checkpoint.save`` injection hit is one durability boundary inside
+save_base/save_delta (4 fires per save call):
+
+    hit 1   nothing written yet
+    hit 2   sparse snapshot in the .tmp dir, unpublished
+    hit 3   sparse published, dense not yet written
+    hit 4   sparse + dense durable, cursor still stale
+
+A "crash" in any window must leave resume() landing on the previous
+consistent (sparse, dense) pair, and a retried save must commit the same
+state a never-crashed save would have.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointManager,
+    verify_snapshot,
+)
+from paddlebox_tpu.utils.faultinject import InjectedFault, fail_nth, inject
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+LAYOUT = ValueLayout(embedx_dim=2)
+OPT = SparseOptimizerConfig()
+DATE, DATE2 = "20260101", "20260102"
+
+
+class DenseStub:
+    """Minimal trainer-shaped object for the dense half of a checkpoint:
+    the manager only needs params/init_params/save_dense/load_dense."""
+
+    def __init__(self):
+        self.params = None
+
+    def init_params(self, *_):
+        self.params = np.zeros(3, dtype=np.float32)
+
+    def bump(self, v):
+        if self.params is None:
+            self.init_params()
+        self.params = self.params + np.float32(v)
+
+    def save_dense(self, path):
+        np.savez(path, params=self.params)
+
+    def load_dense(self, path):
+        with np.load(path) as z:
+            self.params = z["params"]
+
+
+def make_table():
+    return HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+
+
+def mutate(table, seed, lo=1, hi=400, n=48):
+    rng = np.random.default_rng(seed)
+    keys = np.unique(rng.integers(lo, hi, n).astype(np.uint64))
+    rows = table.pull_or_create(keys)
+    rows += rng.standard_normal(rows.shape).astype(np.float32)
+    table.push(keys, rows)
+
+
+def state_of(table):
+    k = np.sort(table.keys())
+    return k, table.pull_or_create(k)
+
+
+def resume_fresh(root):
+    """Resume into a brand-new table+dense, as a restarted process would."""
+    t, d = make_table(), DenseStub()
+    st = CheckpointManager(root).resume(t, d)
+    return st, t, d
+
+
+def assert_same_resume(root, ref):
+    st, t, d = resume_fresh(root)
+    ref_st, ref_t, ref_d = ref
+    assert st == ref_st
+    k, v = state_of(t)
+    rk, rv = state_of(ref_t)
+    np.testing.assert_array_equal(k, rk)
+    np.testing.assert_array_equal(v, rv)
+    np.testing.assert_array_equal(d.params, ref_d.params)
+
+
+def seeded_day(root):
+    """base + one delta, all committed; returns the live objects and the
+    reference resume state at this consistent point."""
+    cm = CheckpointManager(root)
+    t, d = make_table(), DenseStub()
+    d.init_params()
+    mutate(t, 1)
+    d.bump(1.0)
+    cm.save_base(DATE, t, d)
+    mutate(t, 2, lo=100, hi=500)
+    d.bump(1.0)
+    cm.save_delta(DATE, t, d)
+    return cm, t, d, resume_fresh(root)
+
+
+@pytest.mark.parametrize("hit", [1, 2, 3, 4])
+def test_base_crash_windows_keep_previous_state(tmp_path, hit):
+    """A crash in ANY window of a day-2 save_base leaves resume() on the
+    day-1 state — in particular the window between the base publish and
+    the cursor write (hits 3/4)."""
+    root = str(tmp_path / "ckpt")
+    cm, t, d, ref = seeded_day(root)
+    mutate(t, 3)
+    d.bump(2.0)
+    with inject(fail_nth("checkpoint.save", hit)):
+        with pytest.raises(InjectedFault):
+            cm.save_base(DATE2, t, d)
+    if hit <= 2:
+        # nothing published under the final name, only (at most) a .tmp
+        assert not os.path.isdir(os.path.join(root, DATE2, "base"))
+    assert cm.cursor() == {"date": DATE, "delta_idx": 1, "dense": "dense-0001.npz"}
+    assert_same_resume(root, ref)
+    # the retried save commits, and a restart then sees the live state
+    cm.save_base(DATE2, t, d)
+    st, t2, d2 = resume_fresh(root)
+    assert st == {"date": DATE2, "delta_idx": 0, "dense": "dense-0000.npz"}
+    k, v = state_of(t2)
+    lk, lv = state_of(t)
+    np.testing.assert_array_equal(k, lk)
+    np.testing.assert_array_equal(v, lv)
+    np.testing.assert_array_equal(d2.params, d.params)
+
+
+@pytest.mark.parametrize("hit", [1, 2, 3, 4])
+def test_delta_crash_windows_keep_previous_pair(tmp_path, hit):
+    """A crash in any window of save_delta — most importantly between the
+    delta sparse publish and the dense write (hit 3) — leaves resume() on
+    the previous consistent (sparse, dense) pair, and the retry commits
+    the exact same delta a never-crashed save would (the touched-key set
+    survives the crash)."""
+    root = str(tmp_path / "ckpt")
+    cm, t, d, ref = seeded_day(root)
+    mutate(t, 4, lo=200, hi=700)
+    d.bump(2.0)
+    with inject(fail_nth("checkpoint.save", hit)):
+        with pytest.raises(InjectedFault):
+            cm.save_delta(DATE, t, d)
+    if hit == 2:
+        # torn attempt is invisible: only the .tmp sibling exists
+        assert os.path.isdir(os.path.join(root, DATE, "delta-0002.tmp"))
+        assert not os.path.isdir(os.path.join(root, DATE, "delta-0002"))
+    assert cm.cursor() == {"date": DATE, "delta_idx": 1, "dense": "dense-0001.npz"}
+    assert_same_resume(root, ref)
+    # retry: same delta index, same keys (deferred touched-clear), commits
+    cm.save_delta(DATE, t, d)
+    assert not os.path.isdir(os.path.join(root, DATE, "delta-0002.tmp"))
+    st, t2, d2 = resume_fresh(root)
+    assert st == {"date": DATE, "delta_idx": 2, "dense": "dense-0002.npz"}
+    k, v = state_of(t2)
+    lk, lv = state_of(t)
+    np.testing.assert_array_equal(k, lk)
+    np.testing.assert_array_equal(v, lv)
+    np.testing.assert_array_equal(d2.params, d.params)
+
+
+def test_torn_published_delta_truncates_chain(tmp_path):
+    """Corruption of an already-published delta (bit rot / torn copy) is
+    caught by manifest verification; resume walks back to the previous
+    consistent pair and re-pairs the dense file."""
+    root = str(tmp_path / "ckpt")
+    cm, t, d, ref = seeded_day(root)
+    mutate(t, 5, lo=300, hi=900)
+    d.bump(2.0)
+    cm.save_delta(DATE, t, d)  # delta-0002, clean
+    # flip bytes in one shard of delta-0002 (size preserved: CRC must catch)
+    day = os.path.join(root, DATE)
+    shard = os.path.join(day, "delta-0002", "shard-00000.npz")
+    raw = bytearray(open(shard, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(shard, "wb").write(bytes(raw))
+    before = STAT_GET("ckpt_resume_fallbacks")
+    assert_same_resume(root, ref)  # landed on delta-0001 + dense-0001 pair
+    assert STAT_GET("ckpt_resume_fallbacks") == before + 1
+
+
+def test_torn_base_falls_back_to_prev_cursor(tmp_path):
+    """When the newest cursor's base itself is torn, resume falls back to
+    the previous cursor's day and reports it; if that is torn too, it
+    raises instead of loading garbage."""
+    root = str(tmp_path / "ckpt")
+    cm, t, d, ref = seeded_day(root)
+    mutate(t, 6)
+    d.bump(3.0)
+    cm.save_base(DATE2, t, d)  # cursor -> day2, prev cursor -> day1
+    base2 = os.path.join(root, DATE2, "base")
+    os.remove(os.path.join(base2, "shard-00001.npz"))
+    before = STAT_GET("ckpt_resume_fallbacks")
+    assert_same_resume(root, ref)  # day1's delta-0001 state
+    assert STAT_GET("ckpt_resume_fallbacks") == before + 1
+    # both candidates torn: refuse
+    os.remove(os.path.join(root, DATE, "base", "shard-00001.npz"))
+    with pytest.raises(RuntimeError, match="no consistent checkpoint"):
+        resume_fresh(root)
+
+
+def test_torn_cursor_falls_back_to_prev(tmp_path):
+    root = str(tmp_path / "ckpt")
+    cm, t, d, _ = seeded_day(root)
+    mutate(t, 7)
+    cm.save_delta(DATE, t, d)  # rotates cursor.prev.json to delta_idx=1
+    ref_prev = resume_fresh(root)  # resume of the CURRENT state...
+    with open(os.path.join(root, "cursor.json"), "w") as f:
+        f.write("{torn")  # half-written json
+    st, t2, d2 = resume_fresh(root)
+    # ...is unreachable; the prev cursor (delta_idx=1) is the landing spot
+    assert st["delta_idx"] == 1
+    assert ref_prev[0]["delta_idx"] == 2
+
+
+def test_verify_snapshot_catalogue(tmp_path):
+    root = str(tmp_path / "ckpt")
+    seeded_day(root)
+    base = os.path.join(root, DATE, "base")
+    assert verify_snapshot(base)
+    # size mismatch
+    shard = os.path.join(base, "shard-00000.npz")
+    with open(shard, "ab") as f:
+        f.write(b"x")
+    assert not verify_snapshot(base)
+    data = open(shard, "rb").read()[:-1]
+    open(shard, "wb").write(data)
+    assert verify_snapshot(base)
+    # missing file
+    os.rename(shard, shard + ".bak")
+    assert not verify_snapshot(base)
+    os.rename(shard + ".bak", shard)
+    # legacy (pre-manifest) snapshot: accepted, but a manifest can be
+    # demanded
+    os.remove(os.path.join(base, MANIFEST_NAME))
+    assert verify_snapshot(base)
+    assert not verify_snapshot(base, require_manifest=True)
+    # an empty/garbage dir is never a snapshot
+    assert not verify_snapshot(os.path.join(root, "nope"))
+
+
+def test_save_without_dense_carries_dense_name_forward(tmp_path):
+    """Sparse-only deltas (trainer=None) keep naming the last dense file
+    in the cursor, so resume still restores a consistent pair."""
+    root = str(tmp_path / "ckpt")
+    cm, t, d, _ = seeded_day(root)
+    mutate(t, 8)
+    cm.save_delta(DATE, t)  # no trainer
+    st, t2, d2 = resume_fresh(root)
+    assert st == {"date": DATE, "delta_idx": 2, "dense": "dense-0001.npz"}
+    np.testing.assert_array_equal(d2.params, d.params)
+    k, v = state_of(t2)
+    lk, lv = state_of(t)
+    np.testing.assert_array_equal(k, lk)
+    np.testing.assert_array_equal(v, lv)

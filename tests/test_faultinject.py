@@ -1,0 +1,199 @@
+"""Fault-injection framework unit tests + the retry tiers it exercises.
+
+The injection sites are only useful if their triggers are deterministic
+and hermetic — these tests pin the trigger semantics (Nth-hit, seeded
+probability, fail-once-then-heal, budget exhaustion) and then point them
+at the production retry paths (fs open retry, write retry, prefetch
+job retry) to prove a transient flake heals invisibly while a persistent
+failure still surfaces at the right place.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.utils.faultinject import (
+    InjectedFault,
+    fail_always,
+    fail_nth,
+    fail_once,
+    fail_prob,
+    fire,
+    inject,
+)
+
+
+def test_fire_without_plan_is_noop():
+    for _ in range(3):
+        fire("fs.open_read")  # nothing armed: must never raise
+
+
+def test_fail_nth_hits_exactly_once():
+    with inject(fail_nth("site.a", 3)) as plan:
+        fire("site.a")
+        fire("site.a")
+        with pytest.raises(InjectedFault) as ei:
+            fire("site.a")
+        assert ei.value.site == "site.a" and ei.value.hit == 3
+        # healed: the rule's budget (times=1) is spent
+        for _ in range(5):
+            fire("site.a")
+        assert plan.hits("site.a") == 8
+        assert plan.failures("site.a") == 1
+    fire("site.a")  # hermetic: plan uninstalled on exit
+
+
+def test_fail_once_then_heal():
+    with inject(fail_once("site.b")) as plan:
+        with pytest.raises(InjectedFault):
+            fire("site.b")
+        fire("site.b")
+        assert plan.failures("site.b") == 1
+
+
+def test_sites_are_independent():
+    with inject(fail_once("site.a")):
+        fire("site.b")  # other sites unaffected
+        with pytest.raises(InjectedFault):
+            fire("site.a")
+
+
+def test_fail_prob_deterministic_and_budgeted():
+    def run(seed, times):
+        fails = []
+        with inject(fail_prob("site.p", 0.5, seed=seed, times=times)):
+            for i in range(20):
+                try:
+                    fire("site.p")
+                except InjectedFault:
+                    fails.append(i)
+        return fails
+
+    a, b = run(7, None), run(7, None)
+    assert a == b and 0 < len(a) < 20  # seeded: same schedule both runs
+    capped = run(7, 2)
+    assert capped == a[:2]  # the budget truncates the same schedule
+
+
+def test_injected_fault_is_oserror():
+    # the fs retry tier treats OSError as transient; the injected fault
+    # must ride that exact classification
+    assert issubclass(InjectedFault, OSError)
+
+
+def test_scope_restores_previous_plan():
+    with inject(fail_always("site.x")):
+        with inject():  # inner empty plan masks the outer
+            fire("site.x")
+        with pytest.raises(InjectedFault):
+            fire("site.x")
+
+
+# ---- production retry paths under injection -----------------------------
+
+
+@pytest.fixture()
+def fast_backoff():
+    prev = config.get_flag("fs_open_backoff_s")
+    config.set_flag("fs_open_backoff_s", 0.0)
+    yield
+    config.set_flag("fs_open_backoff_s", prev)
+
+
+def test_fs_open_read_retry_absorbs_flake(tmp_path, fast_backoff):
+    from paddlebox_tpu.utils.fs import fs_open_read_retry
+
+    p = tmp_path / "d.txt"
+    p.write_text("hello\n")
+    with inject(fail_once("fs.open_read")) as plan:
+        with fs_open_read_retry(str(p)) as f:
+            assert f.read() == "hello\n"
+        assert plan.failures("fs.open_read") == 1
+
+
+def test_fs_open_read_retry_persistent_failure_surfaces(tmp_path, fast_backoff):
+    from paddlebox_tpu.utils.fs import fs_open_read_retry
+
+    p = tmp_path / "d.txt"
+    p.write_text("hello\n")
+    with inject(fail_always("fs.open_read")):
+        with pytest.raises(InjectedFault):
+            fs_open_read_retry(str(p))
+
+
+def test_fs_read_bytes_retry_absorbs_flake(tmp_path, fast_backoff):
+    from paddlebox_tpu.utils.fs import fs_read_bytes_retry
+
+    p = tmp_path / "d.bin"
+    p.write_bytes(b"\x01\x02")
+    with inject(fail_once("fs.open_read")):
+        assert fs_read_bytes_retry(str(p)) == b"\x01\x02"
+
+
+def test_fs_open_write_retry_absorbs_flake(tmp_path, fast_backoff):
+    from paddlebox_tpu.utils.fs import fs_open_read, fs_open_write_retry
+
+    p = tmp_path / "out" / "w.txt"
+    with inject(fail_once("fs.open_write")) as plan:
+        with fs_open_write_retry(str(p)) as f:
+            f.write("payload\n")
+        assert plan.failures("fs.open_write") == 1
+    with fs_open_read(str(p)) as f:
+        assert f.read() == "payload\n"
+
+
+def test_fs_open_write_retry_persistent_failure_surfaces(tmp_path, fast_backoff):
+    from paddlebox_tpu.utils.fs import fs_open_write_retry
+
+    with inject(fail_always("fs.open_write")):
+        with pytest.raises(InjectedFault):
+            fs_open_write_retry(str(tmp_path / "w.txt"))
+
+
+def test_prefetch_retries_transient_job_and_keeps_order():
+    from paddlebox_tpu.data.pipeline import prefetch
+
+    with inject(fail_nth("pipeline.prefetch_job", 8)) as plan:
+        out = list(prefetch(range(20), lambda x: x * x, workers=4, depth=5))
+    # the flaky job healed on its in-place retry; order is untouched
+    assert out == [x * x for x in range(20)]
+    assert plan.failures("pipeline.prefetch_job") == 1
+
+
+def test_prefetch_persistent_failure_surfaces_in_position():
+    """Regression: the exception position contract survives the retry
+    layer — a job that fails every attempt surfaces exactly at its
+    delivery position, after every earlier result."""
+    from paddlebox_tpu.data.pipeline import prefetch
+
+    def boom(x):
+        if x == 7:
+            raise ValueError("boom")
+        return x
+
+    got = []
+    with pytest.raises(ValueError):
+        for v in prefetch(range(20), boom, workers=4, depth=5):
+            got.append(v)
+    assert got == list(range(7))
+
+
+def test_prefetch_retry_budget_configurable():
+    from paddlebox_tpu.data.pipeline import prefetch
+
+    calls = {}
+
+    def flaky(x):
+        c = calls[x] = calls.get(x, 0) + 1
+        if x == 5 and c <= 2:
+            raise ValueError("flaky")
+        return x
+
+    # job 5 fails twice: the default budget (1 retry) surfaces it...
+    with pytest.raises(ValueError):
+        list(prefetch(range(10), flaky, workers=2, depth=3))
+    calls.clear()
+    # ...a budget of 2 absorbs both failures
+    out = list(prefetch(range(10), flaky, workers=2, depth=3, retries=2))
+    assert out == list(range(10))

@@ -323,7 +323,9 @@ def test_failing_pack_thread_mid_pass_surfaces_cleanly(tmp_path):
 
     def failing_pack(self, idx):
         calls["n"] += 1
-        if calls["n"] == 4:
+        # persistent death (not a one-shot hiccup, which the pipeline's
+        # retry-once would heal): every call from the 4th on fails
+        if calls["n"] >= 4:
             raise RuntimeError("pack thread died")
         return real_pack(self, idx)
 

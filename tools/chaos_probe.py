@@ -1,0 +1,206 @@
+"""Chaos probe: longer seeded fault-injection schedules through the
+PassSupervisor, as a command-line soak.
+
+tests/test_chaos.py pins one 3-pass schedule in tier-1; this probe runs
+configurable multi-day schedules with probabilistic flakes layered over
+deterministic crash windows, and reports the incident log plus an
+equality check against a clean twin run. Exit code 0 iff the injected
+run completes AND matches the clean run bitwise.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/chaos_probe.py \
+      [--days N] [--passes N] [--rows N] [--seed N] \
+      [--fs-flake-prob P] [--step-faults N] [--save-faults N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+S, B = 4, 16
+
+
+def make_schema():
+    from paddlebox_tpu.data import SlotInfo, SlotSchema
+
+    return SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(S)],
+        label_slot="label",
+    )
+
+
+def write_day_files(tmpdir, date, n_passes, rows, seed):
+    rng = np.random.default_rng(seed)
+    files = []
+    for p in range(n_passes):
+        path = os.path.join(tmpdir, f"{date}-{p}.txt")
+        lo = 1 + 40 * p
+        with open(path, "w") as f:
+            for _ in range(rows):
+                parts = [f"1 {float(rng.integers(0, 2))}"]
+                for _s in range(S):
+                    k = int(rng.integers(1, 3))
+                    parts.append(
+                        f"{k} "
+                        + " ".join(str(v) for v in rng.integers(lo, lo + 160, k))
+                    )
+                f.write(" ".join(parts) + "\n")
+        files.append(path)
+    return files
+
+
+def build_supervisor(ckpt_root):
+    import jax
+    import optax
+
+    from paddlebox_tpu.data import BoxPSDataset
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train import (
+        CheckpointManager,
+        CTRTrainer,
+        PassSupervisor,
+        RetryPolicy,
+        TrainStepConfig,
+    )
+
+    opt = SparseOptimizerConfig(
+        embedx_threshold=0.0, show_clk_decay=0.97, shrink_threshold=0.0
+    )
+    layout = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(layout, opt, n_shards=2, seed=0)
+    ds = BoxPSDataset(make_schema(), table, batch_size=B, shuffle_mode="none")
+    model = DeepFM(
+        num_slots=S, feat_width=layout.pull_width, embedx_dim=4, hidden=(8,)
+    )
+    cfg = TrainStepConfig(
+        num_slots=S, batch_size=B, layout=layout, sparse_opt=opt,
+        auc_buckets=100,
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    sup = PassSupervisor(
+        ds, tr, checkpoint=CheckpointManager(ckpt_root),
+        retry=RetryPolicy(backoff_s=0.0, sleep=lambda s: None),
+        round_to=8,
+    )
+    return table, tr, sup
+
+
+def final_state(table, tr):
+    import jax
+
+    k = np.sort(table.keys())
+    v = table.pull_or_create(k)
+    dense = [
+        np.asarray(x) for x in jax.tree.flatten((tr.params, tr.opt_state))[0]
+    ]
+    return k, v, dense
+
+
+def run_schedule(tmpdir, tag, days, rules):
+    from paddlebox_tpu.utils.faultinject import inject
+
+    table, tr, sup = build_supervisor(os.path.join(tmpdir, f"ckpt-{tag}"))
+    t0 = time.perf_counter()
+    with inject(*rules) as plan:
+        for date, files in days:
+            sup.run_day(date, [[f] for f in files])
+    wall = time.perf_counter() - t0
+    return table, tr, sup, plan, wall
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--days", type=int, default=2)
+    ap.add_argument("--passes", type=int, default=3, help="passes per day")
+    ap.add_argument("--rows", type=int, default=64, help="rows per pass file")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fs-flake-prob", type=float, default=0.05,
+                    help="iid flake probability at fs.open_read")
+    ap.add_argument("--step-faults", type=int, default=2,
+                    help="poisoned device steps across the schedule")
+    ap.add_argument("--save-faults", type=int, default=2,
+                    help="torn checkpoint-save windows across the schedule")
+    ap.add_argument("--json", action="store_true", help="machine output only")
+    args = ap.parse_args(argv)
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.utils.faultinject import fail_nth, fail_prob
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    config.set_flag("fs_open_backoff_s", 0.0)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        days = []
+        for d in range(args.days):
+            date = f"202601{d + 1:02d}"
+            days.append(
+                (date, write_day_files(
+                    tmpdir, date, args.passes, args.rows, args.seed + d))
+            )
+
+        # clean twin (an empty plan counts hits so fault schedules can be
+        # sized relative to the real hit volume)
+        table_c, tr_c, sup_c, probe, wall_c = run_schedule(
+            tmpdir, "clean", days, ()
+        )
+        n_steps = probe.hits("step.device")
+        n_saves = probe.hits("checkpoint.save")
+
+        rng = np.random.default_rng(args.seed)
+        rules = [fail_prob("fs.open_read", args.fs_flake_prob,
+                           seed=args.seed, times=None)]
+        for h in sorted(rng.choice(
+                np.arange(2, max(3, n_steps)), size=min(args.step_faults,
+                max(1, n_steps - 2)), replace=False).tolist()):
+            rules.append(fail_nth("step.device", int(h)))
+        for h in sorted(rng.choice(
+                np.arange(2, max(3, n_saves)), size=min(args.save_faults,
+                max(1, n_saves - 2)), replace=False).tolist()):
+            rules.append(fail_nth("checkpoint.save", int(h)))
+
+        table_i, tr_i, sup_i, plan, wall_i = run_schedule(
+            tmpdir, "inj", days, rules
+        )
+
+        k_c, v_c, d_c = final_state(table_c, tr_c)
+        k_i, v_i, d_i = final_state(table_i, tr_i)
+        equal = (
+            np.array_equal(k_i, k_c)
+            and np.array_equal(v_i, v_c)
+            and len(d_i) == len(d_c)
+            and all(np.array_equal(a, b) for a, b in zip(d_i, d_c))
+        )
+        report = {
+            "days": args.days,
+            "passes_per_day": args.passes,
+            "faults_injected": {
+                site: plan.failures(site)
+                for site in ("fs.open_read", "step.device", "checkpoint.save")
+            },
+            "incidents": [i.as_dict() for i in sup_i.incidents],
+            "stat_faults_injected": STAT_GET("faults_injected"),
+            "bitwise_equal_to_clean": bool(equal),
+            "wall_clean_s": round(wall_c, 2),
+            "wall_injected_s": round(wall_i, 2),
+        }
+        print(json.dumps(report if args.json else report, indent=None if args.json else 2))
+        return 0 if equal else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
