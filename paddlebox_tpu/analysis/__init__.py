@@ -1,0 +1,60 @@
+"""pbox-lint — project-specific static analysis for paddlebox_tpu.
+
+Stdlib-only (``ast`` + ``re``); deliberately importable without jax so the
+CLI (tools/run_lint.py) and CI can run it on any box. Rule catalog lives
+in docs/STATIC_ANALYSIS.md.
+"""
+
+from .core import (
+    ERROR,
+    WARNING,
+    Finding,
+    LintResult,
+    ModuleCtx,
+    Rule,
+    apply_baseline,
+    iter_py_files,
+    lint_paths,
+    load_baseline,
+    save_baseline,
+)
+from .rules_io import DurableWriteRule
+from .rules_jit import JitPurityRule
+from .rules_locks import LockDisciplineRule
+from .rules_registry import RegistryConsistencyRule
+from .rules_stats import StatNameRule
+
+ALL_RULES = [
+    JitPurityRule,
+    LockDisciplineRule,
+    RegistryConsistencyRule,
+    DurableWriteRule,
+    StatNameRule,
+]
+
+
+def default_rules():
+    """Fresh instances of every rule (rules hold per-run state)."""
+    return [cls() for cls in ALL_RULES]
+
+
+__all__ = [
+    "ALL_RULES",
+    "ERROR",
+    "WARNING",
+    "Finding",
+    "LintResult",
+    "ModuleCtx",
+    "Rule",
+    "apply_baseline",
+    "default_rules",
+    "iter_py_files",
+    "lint_paths",
+    "load_baseline",
+    "save_baseline",
+    "DurableWriteRule",
+    "JitPurityRule",
+    "LockDisciplineRule",
+    "RegistryConsistencyRule",
+    "StatNameRule",
+]

@@ -1,0 +1,280 @@
+"""pbox-lint core: rule engine, findings, inline suppressions, baseline.
+
+A zero-dependency AST linter for project-specific invariants the Python
+runtime never checks (jit trace purity, lock discipline, flag/stat
+registries, durable-write rules). Architecture:
+
+- :class:`Rule` subclasses visit one parsed module at a time
+  (``check_module``) and may aggregate across the whole scanned set
+  (``finalize``) for project-wide invariants (e.g. every ``get_flag`` name
+  must have a ``define_flag`` somewhere in the package).
+- Findings carry (rule, severity, path, line, message). Identity for
+  baseline matching is (rule, path, message) — line numbers drift with
+  unrelated edits, messages are stable because they name the symbol.
+- ``# pbox-lint: disable=RULE[,RULE2]`` (or ``disable=all``) on the
+  flagged line suppresses findings from that line.
+- A checked-in baseline (tools/lint_baseline.json) grandfathers known
+  findings: the gate fails only on NEW errors, so the linter can be
+  enforced as a tier-1 test without a flag-day cleanup.
+
+This package must stay importable with the standard library only — the
+CLI (tools/run_lint.py) loads it by path so linting never pays the
+package's jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+
+_SUPPRESS_RE = re.compile(r"#\s*pbox-lint:\s*disable=([A-Za-z0-9_,]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str  # repo-root-relative, posix separators
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: stable across line-number drift."""
+        return (self.rule, self.path, self.message)
+
+    def as_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.severity}] {self.message}"
+
+
+@dataclass
+class ModuleCtx:
+    """One parsed module plus everything rules need to report on it."""
+
+    path: str  # repo-root-relative
+    abspath: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    # line number -> set of rule ids suppressed there ("all" wildcards)
+    suppressions: Dict[int, set] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, abspath: str, relpath: str) -> "ModuleCtx":
+        with open(abspath, "r") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=relpath)
+        lines = source.splitlines()
+        sup: Dict[int, set] = {}
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                sup[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        return cls(
+            path=relpath, abspath=abspath, source=source, tree=tree,
+            lines=lines, suppressions=sup,
+        )
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+
+class Rule:
+    """Base rule: subclass, set ``id``/``severity``, implement
+    ``check_module`` and/or ``finalize``."""
+
+    id: str = "RULE000"
+    severity: str = ERROR
+    doc: str = ""
+
+    def check_module(self, ctx: ModuleCtx) -> List[Finding]:
+        return []
+
+    def finalize(self, modules: Sequence[ModuleCtx]) -> List[Finding]:
+        """Project-wide pass after every module was seen."""
+        return []
+
+    def finding(
+        self, ctx: ModuleCtx, node_or_line, message: str,
+        severity: Optional[str] = None,
+    ) -> Optional[Finding]:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        if ctx.suppressed(self.id, line):
+            return None
+        return Finding(
+            rule=self.id,
+            severity=severity or self.severity,
+            path=ctx.path,
+            line=int(line),
+            message=message,
+        )
+
+
+# ---- helpers shared by rules ----------------------------------------------
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Trailing name of the called function: ``open`` / ``config.get_flag``
+    -> ``get_flag`` / ``jax.jit`` -> ``jit``."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Full dotted path of a Name/Attribute chain (``jax.lax.psum``)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def literal_str_arg(node: ast.Call, index: int = 0) -> Optional[str]:
+    if len(node.args) > index and isinstance(node.args[index], ast.Constant):
+        v = node.args[index].value
+        if isinstance(v, str):
+            return v
+    return None
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(root, fn))
+    return out
+
+
+# ---- engine ----------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    parse_errors: List[Finding]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == WARNING]
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Sequence[Rule],
+    root: Optional[str] = None,
+) -> LintResult:
+    """Lint every .py under ``paths`` with ``rules``. ``root`` anchors the
+    relative paths used in findings (defaults to CWD)."""
+    root = os.path.abspath(root or os.getcwd())
+    modules: List[ModuleCtx] = []
+    parse_errors: List[Finding] = []
+    for abspath in iter_py_files(paths):
+        abspath = os.path.abspath(abspath)
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        try:
+            modules.append(ModuleCtx.parse(abspath, rel))
+        except SyntaxError as e:
+            parse_errors.append(
+                Finding(
+                    rule="PARSE",
+                    severity=ERROR,
+                    path=rel,
+                    line=int(e.lineno or 0),
+                    message=f"syntax error: {e.msg}",
+                )
+            )
+    findings: List[Finding] = []
+    for rule in rules:
+        for ctx in modules:
+            findings.extend(f for f in rule.check_module(ctx) if f is not None)
+        findings.extend(f for f in rule.finalize(modules) if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return LintResult(findings=findings, parse_errors=parse_errors)
+
+
+# ---- baseline ---------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Baseline file -> {(rule, path, message): grandfathered count}."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("findings", []):
+        key = (e["rule"], e["path"], e["message"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Write the baseline for ``findings`` (errors only — warnings never
+    gate, so grandfathering them would only hide them)."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        if f.severity == ERROR:
+            counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [
+        {"rule": k[0], "path": k[1], "message": k[2], "count": n}
+        for k, n in sorted(counts.items())
+    ]
+    # lint tooling output, not a durable training artifact: a torn baseline
+    # just re-runs --update-baseline  # pbox-lint: disable=IO004
+    with open(path, "w") as f:  # pbox-lint: disable=IO004
+        json.dump({"version": BASELINE_VERSION, "findings": entries}, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[Tuple[str, str, str], int]
+) -> Tuple[List[Finding], List[Finding], List[Tuple[str, str, str]]]:
+    """Split ``findings`` into (new, grandfathered) and list stale baseline
+    keys (grandfathered findings that no longer fire — candidates for
+    shrinking the baseline). Only errors consume baseline budget."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if f.severity == ERROR and budget.get(f.key(), 0) > 0:
+            budget[f.key()] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [k for k, n in sorted(budget.items()) if n > 0]
+    return new, old, stale

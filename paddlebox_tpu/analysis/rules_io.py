@@ -1,0 +1,61 @@
+"""IO004 — durable-write discipline.
+
+PR 1's crash-window work made every checkpoint artifact go through either
+the ``utils/fs`` retry/dispatch tier (``fs_open_write`` /
+``fs_open_write_retry``) or the atomic tmp+``os.replace`` publish path.
+A raw ``open(path, "w")`` write inside the package regresses exactly that:
+no remote dispatch, no retry-until-open, and a crash mid-write leaves a
+torn file under the final name.
+
+The rule flags every builtin ``open()`` call whose literal mode writes
+(``w``/``a``/``x``/``+``). The fs module itself implements the wrappers —
+its own opens carry inline ``# pbox-lint: disable=IO004`` suppressions,
+which doubles as the documentation that they are the allowed floor.
+Non-literal modes are skipped (unknowable statically); third-party writers
+(``np.savez`` given a *path*) are out of scope — hand them a file object
+from ``fs.atomic_write`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, ModuleCtx, Rule
+
+
+def _write_mode(node: ast.Call) -> str:
+    mode = None
+    if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if isinstance(mode, str) and any(c in mode for c in "wax+"):
+        return mode
+    return ""
+
+
+class DurableWriteRule(Rule):
+    id = "IO004"
+    doc = "raw open() writes must go through utils/fs wrappers"
+
+    def check_module(self, ctx: ModuleCtx) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+                continue
+            mode = _write_mode(node)
+            if not mode:
+                continue
+            f = self.finding(
+                ctx, node,
+                f'raw open(..., "{mode}") write — route through utils/fs '
+                "(fs_open_write[_retry] for streams, atomic_write for "
+                "publish-on-success artifacts)",
+            )
+            if f is not None:
+                findings.append(f)
+        return findings

@@ -1,0 +1,263 @@
+"""JIT001 — trace purity inside jitted/shard_mapped functions.
+
+A function whose body runs under `jax.jit` / `pjit` / `shard_map` executes
+at TRACE time: host syncs (`.item()`, `float()`/`int()` on traced values,
+`np.asarray` of a tracer, `jax.device_get`), wall-clock reads, and Python
+`if` branching on traced values either crash (ConcretizationTypeError) or —
+worse — silently bake one trace-time value into the compiled program and
+desync the sparse hot path (the Parallax/SparCML failure class: one stray
+host sync serializes the whole async pipeline).
+
+Detection, entirely static:
+
+- *Jitted* functions are (a) defs decorated with `jit`/`pjit`/`shard_map`
+  (dotted or wrapped in `functools.partial(jax.jit, ...)`), and (b) defs or
+  lambdas referenced by name as the first argument of a `jit`/`pjit`/
+  `shard_map` call in the same module. A def returned by a maker and jitted
+  in ANOTHER module is not resolved (documented approximation).
+- *Traced names* are the jitted function's parameters minus
+  `static_argnames`/`static_argnums`, propagated through simple assignments
+  (`y = f(x)` taints `y` if `x` is tainted).
+- Flagged: `.item()` anywhere; `jax.device_get`; `time.time()`/
+  `time.perf_counter()`/`time.monotonic()`; `float()`/`int()`/`bool()`/
+  `np.asarray`/`np.array` over an expression mentioning a traced name; a
+  Python `if` whose test mentions a traced name. Shape/structure reads
+  (`.shape`, `.ndim`, `.dtype`, `len()`, `isinstance()`, `x is None`,
+  `"k" in feed`) are trace-static and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Finding, ModuleCtx, Rule, call_name, dotted_name
+
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+_CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
+_NP_ROOTS = {"np", "numpy", "onp"}
+_CAST_FUNCS = {"float", "int", "bool"}
+# attribute reads that are static at trace time even on a tracer
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+_STATIC_FUNCS = {"isinstance", "len", "getattr", "hasattr", "type", "id"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """True if ``node`` names jit/pjit/shard_map (possibly dotted)."""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    return name.split(".")[-1] in _JIT_NAMES
+
+
+def _unwrap_partial(call: ast.Call) -> Optional[ast.AST]:
+    """functools.partial(jax.jit, ...) -> jax.jit."""
+    name = dotted_name(call.func)
+    if name and name.split(".")[-1] == "partial" and call.args:
+        return call.args[0]
+    return None
+
+
+def _static_params(call_or_dec: Optional[ast.Call], fn: ast.AST) -> Set[str]:
+    """Parameter names excluded from tracing via static_argnames/nums."""
+    out: Set[str] = set()
+    if call_or_dec is None:
+        return out
+    args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in call_or_dec.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if 0 <= n.value < len(args):
+                        out.add(args[n.value])
+    return out
+
+
+def _mentions_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``node`` read a traced name in a trace-DYNAMIC position?
+
+    Skips subtrees whose value is static at trace time: `.shape`-like
+    attribute reads, `len()`/`isinstance()` calls, `x is None` / `k in d`
+    comparisons.
+    """
+
+    def walk(n: ast.AST) -> bool:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(n, ast.Call):
+            cn = call_name(n)
+            if cn in _STATIC_FUNCS:
+                return False
+        if isinstance(n, ast.Compare):
+            ops_static = all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in n.ops
+            )
+            if ops_static:
+                return False
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+        return any(walk(c) for c in ast.iter_child_nodes(n))
+
+    return walk(node)
+
+
+class _BodyScanner(ast.NodeVisitor):
+    """Walks one jitted function body collecting purity violations."""
+
+    def __init__(self, rule: "JitPurityRule", ctx: ModuleCtx, tainted: Set[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.tainted = set(tainted)
+        self.findings: List[Finding] = []
+
+    def _emit(self, node: ast.AST, msg: str) -> None:
+        f = self.rule.finding(self.ctx, node, msg)
+        if f is not None:
+            self.findings.append(f)
+
+    # taint propagation through simple assignments
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        if _mentions_tainted(node.value, self.tainted):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        self.tainted.add(n.id)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if isinstance(node.target, ast.Name) and _mentions_tainted(
+            node.value, self.tainted
+        ):
+            self.tainted.add(node.target.id)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        full = dotted_name(node.func)
+        name = call_name(node)
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._emit(
+                node,
+                "host sync: .item() inside a jitted function forces a "
+                "device round-trip at trace time",
+            )
+            return
+        if full is not None:
+            if full.endswith("device_get") and (
+                full.split(".")[0] in ("jax",) or full == "device_get"
+            ):
+                self._emit(
+                    node, "host sync: jax.device_get() inside a jitted function"
+                )
+                return
+            if full in _CLOCK_CALLS:
+                self._emit(
+                    node,
+                    f"impure: {full}() reads the host clock at trace time — "
+                    "the value is baked into the compiled program",
+                )
+                return
+            root = full.split(".")[0]
+            if (
+                root in _NP_ROOTS
+                and name in ("asarray", "array")
+                and node.args
+                and _mentions_tainted(node.args[0], self.tainted)
+            ):
+                self._emit(
+                    node,
+                    f"host sync: {full}() materializes a traced value on "
+                    "host — use jnp inside jit",
+                )
+                return
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _CAST_FUNCS
+            and node.args
+            and _mentions_tainted(node.args[0], self.tainted)
+        ):
+            self._emit(
+                node,
+                f"host sync: {node.func.id}() on a traced value forces "
+                "concretization inside jit",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        if _mentions_tainted(node.test, self.tainted):
+            self._emit(
+                node,
+                "traced-value branch: Python `if` on a traced value inside "
+                "jit — use jnp.where / lax.cond",
+            )
+        self.generic_visit(node)
+
+
+class JitPurityRule(Rule):
+    id = "JIT001"
+    doc = "trace purity inside jax.jit/pjit/shard_map functions"
+
+    def check_module(self, ctx: ModuleCtx) -> List[Finding]:
+        # name -> def nodes (module-wide, scope-approximate)
+        defs: dict = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        jitted: List[tuple] = []  # (fn node, jit call node or None)
+        seen: Set[int] = set()
+
+        def mark(fn: ast.AST, call: Optional[ast.Call]) -> None:
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                jitted.append((fn, call))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit_ref(dec):
+                        mark(node, None)
+                    elif isinstance(dec, ast.Call):
+                        inner = _unwrap_partial(dec)
+                        if _is_jit_ref(dec.func) or (
+                            inner is not None and _is_jit_ref(inner)
+                        ):
+                            mark(node, dec)
+            elif isinstance(node, ast.Call) and _is_jit_ref(node.func):
+                if node.args:
+                    target = node.args[0]
+                    if isinstance(target, ast.Call):
+                        unwrapped = _unwrap_partial(target)
+                        if unwrapped is not None and isinstance(
+                            unwrapped, ast.Name
+                        ):
+                            target = unwrapped
+                    if isinstance(target, ast.Lambda):
+                        mark(target, node)
+                    elif isinstance(target, ast.Name):
+                        for fn in defs.get(target.id, []):
+                            mark(fn, node)
+
+        findings: List[Finding] = []
+        for fn, call in jitted:
+            args = fn.args
+            params = {
+                a.arg
+                for a in (
+                    args.posonlyargs + args.args + args.kwonlyargs
+                    + ([args.vararg] if args.vararg else [])
+                    + ([args.kwarg] if args.kwarg else [])
+                )
+            }
+            params -= {"self", "cls"}
+            params -= _static_params(call, fn)
+            scanner = _BodyScanner(self, ctx, params)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                scanner.visit(stmt)
+            findings.extend(scanner.findings)
+        return findings

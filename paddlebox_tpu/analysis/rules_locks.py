@@ -1,0 +1,313 @@
+"""THR002 — lock discipline over `# guarded-by:` annotated state.
+
+A lightweight static race detector. Shared mutable state is annotated at
+its initialization site with a trailing comment naming the lock that
+guards it:
+
+    self._params = [...]        # guarded-by: _lock         (instance attr)
+    _stats: Dict[str, int] = {} # guarded-by: _lock         (module global)
+
+The rule then checks every OTHER access in the module:
+
+- an annotated instance attribute (``self.X`` in methods of the owning
+  class, including closures defined inside them) must be read/written
+  inside a ``with self.<lock>:`` block;
+- an annotated module global must be accessed inside ``with <lock>:``.
+
+Severity is graded by a thread-reachability approximation: functions
+reachable (intra-module call graph) from a ``threading.Thread(target=...)``
+or ``executor.submit(fn)`` entry point get ERROR (two sides of a real
+race: the entry runs concurrently with everything), everything else gets
+WARNING (the annotation's contract is still violated, but no in-module
+thread proves concurrency). Initialization sites are exempt:
+``__init__``/``__post_init__`` for instance attrs, module top-level for
+globals.
+
+Locks must be held via ``with``; manual acquire()/release() is not
+recognized (and is itself the failure-prone pattern the rule nudges away
+from).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleCtx, Rule
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+_INIT_METHODS = {"__init__", "__post_init__"}
+
+
+def _guard_on_line(ctx: ModuleCtx, line: int) -> Optional[str]:
+    if 1 <= line <= len(ctx.lines):
+        m = _GUARD_RE.search(ctx.lines[line - 1])
+        if m:
+            return m.group(1)
+    return None
+
+
+class _FuncInfo:
+    """One function/method/nested-def node plus ownership metadata."""
+
+    def __init__(self, node, cls: Optional[str], qualname: str):
+        self.node = node
+        self.cls = cls  # owning class name (None for module functions)
+        self.qualname = qualname
+        self.calls: Set[Tuple[Optional[str], str]] = set()  # (cls-or-None, name)
+
+
+def _collect_functions(tree: ast.Module) -> List[_FuncInfo]:
+    """Every def in the module with its owning class (methods keep their
+    class; defs nested in methods inherit it — they close over self)."""
+    out: List[_FuncInfo] = []
+
+    def walk(node, cls: Optional[str], prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name, f"{prefix}{child.name}.")
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(_FuncInfo(child, cls, f"{prefix}{child.name}"))
+                walk(child, cls, f"{prefix}{child.name}.")
+            else:
+                walk(child, cls, prefix)
+
+    walk(tree, None, "")
+    return out
+
+
+def _direct_children_defs(fn_node) -> Set[int]:
+    """ids of def nodes nested anywhere inside ``fn_node`` (excl. itself)."""
+    out: Set[int] = set()
+    for n in ast.walk(fn_node):
+        if n is not fn_node and isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            out.add(id(n))
+    return out
+
+
+class LockDisciplineRule(Rule):
+    id = "THR002"
+    doc = "guarded-by lock discipline (static race detector)"
+
+    def check_module(self, ctx: ModuleCtx) -> List[Finding]:
+        funcs = _collect_functions(ctx.tree)
+        node_to_info = {id(f.node): f for f in funcs}
+
+        # ---- 1. collect annotations -----------------------------------
+        # (cls, attr) -> lock attr name; and module global -> lock name
+        attr_guards: Dict[Tuple[str, str], str] = {}
+        global_guards: Dict[str, str] = {}
+        for f in funcs:
+            if f.cls is None:
+                continue
+            for stmt in ast.walk(f.node):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                lock = _guard_on_line(ctx, stmt.lineno)
+                if lock is None:
+                    continue
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        attr_guards[(f.cls, t.attr)] = lock
+        for stmt in ctx.tree.body:  # module top level only
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                lock = _guard_on_line(ctx, stmt.lineno)
+                if lock is None:
+                    continue
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        global_guards[t.id] = lock
+        # class-level annotated attrs (rare): ClassDef body assigns
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                        lock = _guard_on_line(ctx, stmt.lineno)
+                        if lock is None:
+                            continue
+                        targets = (
+                            stmt.targets
+                            if isinstance(stmt, ast.Assign)
+                            else [stmt.target]
+                        )
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                attr_guards[(node.name, t.id)] = lock
+        if not attr_guards and not global_guards:
+            return []
+
+        # ---- 2. thread entries + call graph ---------------------------
+        entries: Set[int] = set()
+
+        def resolve(cls: Optional[str], name: str) -> List[_FuncInfo]:
+            hits = [f for f in funcs if f.node.name == name and f.cls == cls]
+            return hits or [f for f in funcs if f.node.name == name]
+
+        for f in funcs:
+            for n in ast.walk(f.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                fname = (
+                    n.func.attr
+                    if isinstance(n.func, ast.Attribute)
+                    else (n.func.id if isinstance(n.func, ast.Name) else None)
+                )
+                cands: List[ast.AST] = []
+                if fname == "Thread":
+                    for kw in n.keywords:
+                        if kw.arg == "target":
+                            cands.append(kw.value)
+                elif fname == "submit" and n.args:
+                    cands.append(n.args[0])
+                for c in cands:
+                    if isinstance(c, ast.Name):
+                        for hit in resolve(f.cls, c.id):
+                            entries.add(id(hit.node))
+                    elif (
+                        isinstance(c, ast.Attribute)
+                        and isinstance(c.value, ast.Name)
+                        and c.value.id == "self"
+                    ):
+                        for hit in resolve(f.cls, c.attr):
+                            entries.add(id(hit.node))
+
+        nested_of = {id(f.node): _direct_children_defs(f.node) for f in funcs}
+        for f in funcs:
+            for n in ast.walk(f.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Name):
+                    f.calls.add((f.cls, n.func.id))
+                elif isinstance(n.func, ast.Attribute) and isinstance(
+                    n.func.value, ast.Name
+                ):
+                    if n.func.value.id == "self":
+                        f.calls.add((f.cls, n.func.attr))
+
+        reachable: Set[int] = set()
+        frontier = list(entries)
+        while frontier:
+            nid = frontier.pop()
+            if nid in reachable:
+                continue
+            reachable.add(nid)
+            info = node_to_info.get(nid)
+            if info is None:
+                continue
+            # a nested def runs on the same thread as its host when called
+            for child in nested_of.get(nid, ()):
+                if child not in reachable:
+                    frontier.append(child)
+            for cls, name in info.calls:
+                for hit in resolve(cls, name):
+                    if id(hit.node) not in reachable:
+                        frontier.append(id(hit.node))
+
+        # ---- 3. scan accesses -----------------------------------------
+        findings: List[Finding] = []
+        rule = self
+
+        class Scanner(ast.NodeVisitor):
+            def __init__(self, info: _FuncInfo):
+                self.info = info
+                self.held: List[str] = []
+
+            def _check_attr(self, node: ast.Attribute) -> None:
+                if not (
+                    isinstance(node.value, ast.Name) and node.value.id == "self"
+                ):
+                    return
+                cls = self.info.cls
+                if cls is None:
+                    return
+                lock = attr_guards.get((cls, node.attr))
+                if lock is None:
+                    return
+                if self.info.node.name in _INIT_METHODS:
+                    return
+                want = f"self.{lock}"
+                if want in self.held:
+                    return
+                sev = "error" if id(self.info.node) in reachable else "warning"
+                f = rule.finding(
+                    ctx,
+                    node,
+                    f"self.{node.attr} is guarded-by {lock} but accessed "
+                    f"outside `with {want}:` in {self.info.qualname}"
+                    + (
+                        " (reachable from a thread entry point)"
+                        if sev == "error"
+                        else ""
+                    ),
+                    severity=sev,
+                )
+                if f is not None:
+                    findings.append(f)
+
+            def _check_global(self, node: ast.Name) -> None:
+                lock = global_guards.get(node.id)
+                if lock is None:
+                    return
+                if lock in self.held:
+                    return
+                sev = "error" if id(self.info.node) in reachable else "warning"
+                f = rule.finding(
+                    ctx,
+                    node,
+                    f"module global {node.id} is guarded-by {lock} but "
+                    f"accessed outside `with {lock}:` in {self.info.qualname}"
+                    + (
+                        " (reachable from a thread entry point)"
+                        if sev == "error"
+                        else ""
+                    ),
+                    severity=sev,
+                )
+                if f is not None:
+                    findings.append(f)
+
+            def visit_With(self, node: ast.With) -> None:
+                names = []
+                for item in node.items:
+                    try:
+                        names.append(ast.unparse(item.context_expr))
+                    except Exception:  # pragma: no cover
+                        pass
+                self.held.extend(names)
+                self.generic_visit(node)
+                del self.held[len(self.held) - len(names):]
+
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                self._check_attr(node)
+                self.generic_visit(node)
+
+            def visit_Name(self, node: ast.Name) -> None:
+                self._check_global(node)
+
+            def visit_FunctionDef(self, node) -> None:
+                pass  # nested defs scanned as their own _FuncInfo
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node) -> None:
+                pass  # deferred execution: lock context unknowable
+
+        for f in funcs:
+            sc = Scanner(f)
+            for stmt in f.node.body:
+                sc.visit(stmt)
+        return findings

@@ -1,0 +1,117 @@
+"""REG003 — flag and faultinject-site registry consistency (project-wide).
+
+The flag registry (paddlebox_tpu/config.py) raises ``KeyError`` on an
+undefined ``get_flag``/``set_flag`` — but only when the code path actually
+runs, which for error-handling and rarely-enabled paths can be days into a
+soak. The fault-injection harness (utils/faultinject.py) is worse: firing
+an unknown site is a silent no-op, so a typo'd site string makes a chaos
+test pass vacuously. Both are catchable at lint time:
+
+- ERROR: ``get_flag("x")``/``set_flag("x")`` with no ``define_flag("x")``
+  anywhere in the scanned set.
+- WARNING: ``define_flag("x")`` never read via ``get_flag("x")`` — dead
+  knob (or a knob only tests poke, which deserves a look either way).
+- ERROR: ``fire("site")`` / ``fail_*("site", ...)`` with a site string not
+  in ``faultinject.KNOWN_SITES`` (the declared catalog; the rule reads the
+  tuple straight out of the AST, so catalog and check can't drift).
+
+Dynamic (non-literal) names are skipped — the registry module's own
+``get_flag(n)`` loops are unknowable statically; the literal discipline
+everywhere else is exactly what makes this rule cheap and exact.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleCtx, Rule, call_name, literal_str_arg
+
+_FIRE_FUNCS = {"fire", "_fault_fire"}
+_RULE_FACTORIES = {"fail_nth", "fail_once", "fail_always", "fail_prob"}
+
+
+def _known_sites(modules: Sequence[ModuleCtx]) -> Optional[Set[str]]:
+    """KNOWN_SITES tuple parsed from utils/faultinject.py, if scanned."""
+    for ctx in modules:
+        if not ctx.path.endswith("utils/faultinject.py"):
+            continue
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                names = [
+                    t.id for t in stmt.targets if isinstance(t, ast.Name)
+                ]
+                if "KNOWN_SITES" in names and isinstance(
+                    stmt.value, (ast.Tuple, ast.List, ast.Set)
+                ):
+                    return {
+                        e.value
+                        for e in stmt.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+    return None
+
+
+class RegistryConsistencyRule(Rule):
+    id = "REG003"
+    doc = "flag get/set vs define_flag, faultinject sites vs KNOWN_SITES"
+
+    def finalize(self, modules: Sequence[ModuleCtx]) -> List[Finding]:
+        defines: Dict[str, Tuple[ModuleCtx, int]] = {}
+        reads: Set[str] = set()
+        uses: List[Tuple[str, ModuleCtx, int, str]] = []  # (name, ctx, line, fn)
+        fires: List[Tuple[str, ModuleCtx, int]] = []
+        for ctx in modules:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = call_name(node)
+                if fn == "define_flag":
+                    name = literal_str_arg(node)
+                    if name is not None and name not in defines:
+                        defines[name] = (ctx, node.lineno)
+                elif fn in ("get_flag", "set_flag"):
+                    name = literal_str_arg(node)
+                    if name is not None:
+                        uses.append((name, ctx, node.lineno, fn))
+                        if fn == "get_flag":
+                            reads.add(name)
+                elif fn in _FIRE_FUNCS or fn in _RULE_FACTORIES:
+                    site = literal_str_arg(node)
+                    if site is not None:
+                        fires.append((site, ctx, node.lineno))
+
+        findings: List[Finding] = []
+        for name, ctx, line, fn in uses:
+            if name not in defines:
+                f = self.finding(
+                    ctx, line,
+                    f'{fn}("{name}") but no define_flag("{name}") anywhere '
+                    "in the scanned set — raises KeyError when this path runs",
+                )
+                if f is not None:
+                    findings.append(f)
+        for name, (ctx, line) in sorted(defines.items()):
+            if name not in reads:
+                f = self.finding(
+                    ctx, line,
+                    f'define_flag("{name}") is never read via get_flag — '
+                    "dead knob (wire it up or delete it)",
+                    severity="warning",
+                )
+                if f is not None:
+                    findings.append(f)
+        sites = _known_sites(modules)
+        if sites is not None:
+            for site, ctx, line in fires:
+                if site not in sites:
+                    f = self.finding(
+                        ctx, line,
+                        f'faultinject site "{site}" is not in '
+                        "faultinject.KNOWN_SITES — firing it is a silent "
+                        "no-op in every chaos schedule",
+                    )
+                    if f is not None:
+                        findings.append(f)
+        return findings

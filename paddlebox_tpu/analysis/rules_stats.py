@@ -1,0 +1,61 @@
+"""MON005 — stat-name hygiene.
+
+Dashboards and soak tooling enumerate the monitor registry by name; that
+only works if every ``STAT_ADD``/``STAT_SET`` site uses a string literal
+from the flat ``[a-z0-9_.]+`` namespace. An f-string name mints an
+unbounded metric family nothing can enumerate ahead of time; an uppercase
+or hyphenated name breaks the dashboards' parsing convention.
+
+- ERROR: first argument is not a string literal.
+- ERROR: literal doesn't fullmatch ``[a-z0-9_.]+``.
+
+``STAT_GET``/``STAT_RESET`` are exempt: programmatic sweeps over
+``all_stats()`` legitimately pass computed names there.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from .core import Finding, ModuleCtx, Rule, call_name
+
+_NAME_RE = re.compile(r"[a-z0-9_.]+")
+_STAT_FUNCS = {"STAT_ADD", "STAT_SET"}
+
+
+class StatNameRule(Rule):
+    id = "MON005"
+    doc = "STAT_ADD/STAT_SET names must be enumerable literals"
+
+    def check_module(self, ctx: ModuleCtx) -> List[Finding]:
+        if ctx.path.endswith("utils/monitor.py"):
+            return []  # the registry's own defs/internals
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _STAT_FUNCS:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if not _NAME_RE.fullmatch(arg.value):
+                    f = self.finding(
+                        ctx, node,
+                        f'stat name "{arg.value}" must match [a-z0-9_.]+ '
+                        "(dashboard enumeration convention)",
+                    )
+                    if f is not None:
+                        findings.append(f)
+            else:
+                f = self.finding(
+                    ctx, node,
+                    "stat name must be a string literal — dynamic names "
+                    "mint an unenumerable metric family",
+                )
+                if f is not None:
+                    findings.append(f)
+        return findings

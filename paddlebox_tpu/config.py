@@ -13,8 +13,8 @@ import threading
 from typing import Any, Callable, Dict
 
 _lock = threading.Lock()
-_defs: Dict[str, tuple] = {}  # name -> (type_fn, default, help)
-_values: Dict[str, Any] = {}
+_defs: Dict[str, tuple] = {}  # guarded-by: _lock  (name -> (type_fn, default, help))
+_values: Dict[str, Any] = {}  # guarded-by: _lock
 
 
 def _parse_bool(v) -> bool:
@@ -65,12 +65,8 @@ def all_flags() -> Dict[str, Any]:
 
 
 # --- data pipeline (reference: flags.cc padbox_* family) ---
-define_flag("dataset_shuffle_thread_num", 10, "threads for global shuffle")
-define_flag("dataset_merge_thread_num", 10, "threads for merge/working-set build")
-define_flag("record_pool_max_size", 50_000_000, "SlotRecord pool cap (reference: padbox_record_pool_max_size)")
-define_flag("slot_pool_thread_num", 1, "recycle threads for record pool")
-define_flag("data_read_buffer_mb", 16, "file read buffer size")
-define_flag("enable_ins_parser_file", False, "allow per-file parser plugin")
+# (knobs from the reference's padbox_* family are declared HERE only once a
+# consumer reads them — pbox-lint REG003 flags defined-never-read knobs)
 define_flag("enable_native_parser", True, "use the C++ slot parser fast path when eligible")
 define_flag("sample_rate", 1.0, "line sampling rate on read (BufferedLineFileReader parity)")
 
@@ -94,11 +90,8 @@ define_flag(
 # --- sparse table ---
 define_flag("sparse_table_shard_bits", 6, "log2 host shards in the tiered store")
 define_flag("enable_pullpush_dedup_keys", True, "dedup keys across slots before pull (reference flags.cc:603)")
-define_flag("embedx_threshold", 10.0, "show threshold before embedx becomes active (pslib semantics)")
-define_flag("pull_embedx_scale", 1.0, "scale applied to pulled embedx (reference: BoxWrapper scale)")
 
 # --- batch / device ---
-define_flag("batch_pad_quantile", 1.0, "key-bucket padding quantile for static shapes")
 define_flag(
     "batch_bucket_rounding",
     2048,
@@ -108,7 +101,6 @@ define_flag(
     "miss it (~tens of host MB per distinct shape set; measured flat RSS "
     "at fixed shapes over a 14-pass soak)",
 )
-define_flag("enable_dense_nccl_barrier", False, "barrier before dense sync (reference flags.cc:597)")
 define_flag("use_pallas_sparse", False, "Pallas prefetch-DMA kernels for sparse pull/push on TPU")
 
 # --- metrics ---

@@ -12,6 +12,7 @@ way the reference's reused pack buffers do.
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, TypeVar
@@ -21,6 +22,30 @@ R = TypeVar("R")
 
 from paddlebox_tpu import config
 from paddlebox_tpu.utils.faultinject import fire as _fault_fire
+
+# occupancy gauge over every live prefetch window in the process: how many
+# jobs are exercising the pool right now, and the deepest it ever got. The
+# high-water mark is the tuning signal for feed_pipeline_workers/depth — a
+# hwm pinned at workers*depth means the device is starved on pack/upload.
+_gauge_lock = threading.Lock()
+_inflight = 0  # guarded-by: _gauge_lock
+_inflight_hwm = 0  # guarded-by: _gauge_lock
+
+
+def prefetch_inflight() -> int:
+    """Jobs currently executing across all prefetch windows."""
+    with _gauge_lock:
+        return _inflight
+
+
+def prefetch_inflight_hwm(reset: bool = False) -> int:
+    """Deepest concurrent-job count seen so far (optionally reset)."""
+    global _inflight_hwm
+    with _gauge_lock:
+        hwm = _inflight_hwm
+        if reset:
+            _inflight_hwm = _inflight
+        return hwm
 
 config.define_flag("feed_pipeline_workers", 3, "background packer thread count")
 config.define_flag(
@@ -53,8 +78,17 @@ def prefetch(
         retries = config.get_flag("feed_pipeline_retries")
 
     def run(job: T) -> R:
-        _fault_fire("pipeline.prefetch_job")
-        return fn(job)
+        global _inflight, _inflight_hwm
+        with _gauge_lock:
+            _inflight += 1
+            if _inflight > _inflight_hwm:
+                _inflight_hwm = _inflight
+        try:
+            _fault_fire("pipeline.prefetch_job")
+            return fn(job)
+        finally:
+            with _gauge_lock:
+                _inflight -= 1
 
     it = iter(jobs)
     ex = ThreadPoolExecutor(max_workers=workers)

@@ -45,11 +45,12 @@ class ReplicaCache:
 
     def __init__(self, dim: int):
         self.dim = dim
-        self._rows: List[np.ndarray] = []
+        self._rows: List[np.ndarray] = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
-        return len(self._rows)
+        with self._lock:  # load threads append concurrently (AddItems parity)
+            return len(self._rows)
 
     def add_items(self, emb) -> int:
         """Append one row; returns its id (AddItems parity, thread-safe)."""
@@ -77,7 +78,8 @@ class ReplicaCache:
         return jnp.asarray(host)
 
     def mem_used_mb(self) -> float:
-        return len(self._rows) * self.dim * 4 / 1024.0 / 1024.0
+        with self._lock:
+            return len(self._rows) * self.dim * 4 / 1024.0 / 1024.0
 
 
 def pull_cache_value(cache: "jnp.ndarray", ids: "jnp.ndarray") -> "jnp.ndarray":
@@ -93,18 +95,20 @@ class InputTable:
 
     def __init__(self, dim: int):
         self.dim = dim
-        self._key_row = {}
-        self._rows: List[np.ndarray] = []
+        self._key_row = {}  # guarded-by: _lock
+        self._rows: List[np.ndarray] = []  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._miss = 0
+        self._miss = 0  # guarded-by: _lock
         self.add_index_data(self.DEFAULT_KEY, np.zeros(dim, np.float32))
 
     def __len__(self) -> int:
-        return len(self._key_row)
+        with self._lock:
+            return len(self._key_row)
 
     @property
     def miss(self) -> int:
-        return self._miss
+        with self._lock:  # ordered against parse-thread get_index_offset
+            return self._miss
 
     def add_index_data(self, key: str, vec) -> int:
         row = np.asarray(vec, dtype=np.float32).reshape(-1)
@@ -149,4 +153,5 @@ class InputTable:
         return jnp.asarray(host)
 
     def mem_used_mb(self) -> float:
-        return len(self._rows) * self.dim * 4 / 1024.0 / 1024.0
+        with self._lock:
+            return len(self._rows) * self.dim * 4 / 1024.0 / 1024.0

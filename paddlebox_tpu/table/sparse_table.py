@@ -40,8 +40,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddlebox_tpu import config
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
+from paddlebox_tpu.utils.fs import atomic_write
 
 _HASH_MULT = np.uint64(0x9E3779B97F4A7C15)
 
@@ -115,11 +117,14 @@ class HostSparseTable:
         self,
         layout: ValueLayout,
         opt: SparseOptimizerConfig = SparseOptimizerConfig(),
-        n_shards: int = 64,
+        n_shards: Optional[int] = None,
         seed: int = 0,
         spill_dir: Optional[str] = None,
         mem_cap_rows: Optional[int] = None,
     ):
+        if n_shards is None:
+            # flag default (6 bits) keeps the historical 64-shard layout
+            n_shards = 1 << config.get_flag("sparse_table_shard_bits")
         self.layout = layout
         self.opt = opt
         self.n_shards = n_shards
@@ -458,7 +463,7 @@ class HostSparseTable:
                 self._snapshot_shard(s, only_touched=False)
                 for s in range(self.n_shards)
             ]
-        with open(os.path.join(path, "meta.json"), "w") as f:
+        with atomic_write(os.path.join(path, "meta.json")) as f:
             json.dump(meta, f)
         for s, (keys, vals) in enumerate(snaps):
             np.savez_compressed(
@@ -490,7 +495,7 @@ class HostSparseTable:
                 os.path.join(path, f"shard-{s:05d}.npz"),
                 keys=keys, values=vals,
             )
-        with open(os.path.join(path, "meta.json"), "w") as f:
+        with atomic_write(os.path.join(path, "meta.json")) as f:
             json.dump(
                 {
                     "n_shards": self.n_shards,
@@ -566,7 +571,7 @@ class HostSparseTable:
             np.savez_compressed(
                 os.path.join(path, f"shard-{s:05d}.npz"), keys=keys, values=vals
             )
-        with open(os.path.join(path, "meta.json"), "w") as f:
+        with atomic_write(os.path.join(path, "meta.json")) as f:
             json.dump({"n_shards": self.n_shards, **meta}, f)
         return total
 

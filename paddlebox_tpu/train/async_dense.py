@@ -43,9 +43,9 @@ class AsyncDenseTable:
         queue_cap: int = 24,  # PSBufferQueue(8 * 3) parity
     ):
         leaves, self._treedef = jax.tree.flatten(params)
-        self._params = [np.array(x, dtype=np.float32) for x in leaves]
-        self._mom1 = [np.zeros_like(x) for x in self._params]
-        self._mom2 = [np.zeros_like(x) for x in self._params]
+        self._params = [np.array(x, dtype=np.float32) for x in leaves]  # guarded-by: _lock
+        self._mom1 = [np.zeros_like(x) for x in self._params]  # guarded-by: _lock
+        self._mom2 = [np.zeros_like(x) for x in self._params]  # guarded-by: _lock
         self.base_lr = float(base_lr)
         self.merge_limit = merge_limit
         # leaf lr: lr_map keys match normalized "/"-joined key paths, exact
@@ -76,9 +76,9 @@ class AsyncDenseTable:
             return self.base_lr
 
         self._leaf_lr = np.array([leaf_lr(p) for p in paths], dtype=np.float32)
-        self._lock = threading.Lock()  # guards _params/_mom*
+        self._lock = threading.Lock()  # guards _params/_mom*/_n_updates
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_cap)
-        self._n_updates = 0
+        self._n_updates = 0  # guarded-by: _lock
         self._closed = False
         self._thread = threading.Thread(target=self._update_loop, daemon=True)
         self._thread.start()
@@ -102,7 +102,11 @@ class AsyncDenseTable:
 
     @property
     def n_updates(self) -> int:
-        return self._n_updates
+        # lock, not a bare read: int reads are atomic under the GIL today,
+        # but the lock also ORDERS this against a concurrent _apply so a
+        # caller that saw n_updates == k reads params at least that fresh
+        with self._lock:
+            return self._n_updates
 
     # ---- background optimizer -------------------------------------------
 

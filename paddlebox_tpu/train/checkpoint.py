@@ -46,6 +46,7 @@ from typing import Any, Dict, Optional
 
 from paddlebox_tpu.table.sparse_table import HostSparseTable
 from paddlebox_tpu.utils.faultinject import fire as _fault_fire
+from paddlebox_tpu.utils.fs import atomic_write
 from paddlebox_tpu.utils.monitor import STAT_ADD
 
 logger = logging.getLogger(__name__)
@@ -74,10 +75,8 @@ def write_manifest(snap_dir: str) -> str:
             continue
         files[name] = {"size": os.path.getsize(p), "crc32": _file_crc32(p)}
     mpath = os.path.join(snap_dir, MANIFEST_NAME)
-    tmp = mpath + ".tmp"
-    with open(tmp, "w") as f:
+    with atomic_write(mpath) as f:
         json.dump({"files": files}, f)
-    os.replace(tmp, mpath)
     return mpath
 
 
@@ -152,14 +151,10 @@ class CheckpointManager:
         # copy), resume() can still land on the previous consistent state
         old = self.cursor()
         if old is not None and old != cur:
-            ptmp = self._prev_cursor_path() + ".tmp"
-            with open(ptmp, "w") as f:
+            with atomic_write(self._prev_cursor_path()) as f:
                 json.dump(old, f)
-            os.replace(ptmp, self._prev_cursor_path())
-        tmp = self._cursor_path() + ".tmp"
-        with open(tmp, "w") as f:
+        with atomic_write(self._cursor_path()) as f:  # crash-safe cursor
             json.dump(cur, f)
-        os.replace(tmp, self._cursor_path())  # atomic: crash-safe cursor
 
     # ---- save ------------------------------------------------------------
 

@@ -55,6 +55,7 @@ from paddlebox_tpu.train.train_step import (
 )
 from paddlebox_tpu.utils.dump import DumpWorkerPool, dump_fields, dump_param
 from paddlebox_tpu.utils.faultinject import fire as _fault_fire
+from paddlebox_tpu.utils.fs import atomic_write
 from paddlebox_tpu.utils.trace import PROFILER
 from paddlebox_tpu import config
 
@@ -182,14 +183,12 @@ class CTRTrainer:
         path = path if path.endswith(".npz") else path + ".npz"
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         leaves, treedef = jax.tree.flatten((self.params, self.opt_state))
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
+        with atomic_write(path, "wb") as f:
             np.savez_compressed(
                 f,
                 treedef=str(treedef),
                 **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
             )
-        os.replace(tmp, path)
 
     def load_dense(self, path: str) -> None:
         if self.params is None:

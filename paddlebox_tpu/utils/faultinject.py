@@ -12,6 +12,9 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
 
     fs.open_read            utils/fs.py  fs_open_read / fs_read_bytes_retry
     fs.open_write           utils/fs.py  fs_open_write
+    fs.atomic_write         utils/fs.py  atomic_write: after tmp-file write,
+                            before the os.replace publish (its own site so
+                            arming it never shifts fs.open_write hit counts)
     pipeline.prefetch_job   data/pipeline.py  each prefetch job execution
     checkpoint.save         train/checkpoint.py  each durability boundary
                             inside save_base/save_delta (multiple fires per
@@ -43,6 +46,19 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
+
+# The declared site catalog. fire()/fail_* against a name NOT listed here is
+# a silent no-op waiting to happen — pbox-lint REG003 cross-checks every
+# literal site string in the package against this tuple.
+KNOWN_SITES = (
+    "fs.open_read",
+    "fs.open_write",
+    "fs.atomic_write",
+    "pipeline.prefetch_job",
+    "checkpoint.save",
+    "checkpoint.load",
+    "step.device",
+)
 
 
 class InjectedFault(OSError):

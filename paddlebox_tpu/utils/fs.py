@@ -23,6 +23,7 @@ import os
 import shutil
 import subprocess
 import time
+from contextlib import contextmanager
 from typing import IO, Iterator, List, Optional
 
 from paddlebox_tpu import config
@@ -230,7 +231,39 @@ def fs_open_write(path: str, converter: Optional[str] = None):
         return _PipeStream(f"{converter} > '{path}'", "w")
     if path.endswith(".gz"):
         return gzip.open(path, "wt")
-    return open(path, "w")
+    return open(path, "w")  # pbox-lint: disable=IO004  (the wrapper itself)
+
+
+@contextmanager
+def atomic_write(path: str, mode: str = "w"):
+    """Crash-safe local write: stream into ``path + ".tmp"``, publish with
+    ``os.replace`` only after the block exits cleanly. A crash anywhere in
+    the window leaves the previous ``path`` intact — the torn bytes land in
+    the tmp file, which the next successful publish overwrites.
+
+    LOCAL paths only (``os.replace`` has no remote analogue; remote
+    durability goes through the manifest/publish protocol in
+    train/checkpoint.py). ``mode`` is ``"w"`` or ``"wb"``.
+
+    The fault site fires between write and publish — the narrow window the
+    atomicity claim is about — under its own name (``fs.atomic_write``), so
+    chaos schedules can target the publish without disturbing the hit
+    numbering of ``fs.open_write``.
+    """
+    if is_remote(path):
+        raise ValueError(f"atomic_write is local-only, got {path!r}")
+    if mode not in ("w", "wb"):
+        raise ValueError(f"atomic_write mode must be 'w' or 'wb', got {mode!r}")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, mode) as f:  # pbox-lint: disable=IO004  (the wrapper itself)
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+    _fault_fire("fs.atomic_write")
+    os.replace(tmp, path)
 
 
 def _run_remote(args: str) -> str:

@@ -14,7 +14,7 @@ from typing import Dict, Union
 Number = Union[int, float]
 
 _lock = threading.Lock()
-_stats: Dict[str, Number] = {}
+_stats: Dict[str, Number] = {}  # guarded-by: _lock
 
 
 def STAT_ADD(name: str, value: Number = 1) -> None:

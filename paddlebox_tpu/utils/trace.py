@@ -76,9 +76,11 @@ class Profiler:
 
     def export_chrome_trace(self, path: str) -> int:
         """Write chrome://tracing JSON (timeline.py parity). Returns #events."""
+        from paddlebox_tpu.utils.fs import atomic_write
+
         with self._lock:
             events = list(self._events)
-        with open(path, "w") as f:
+        with atomic_write(path) as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
         return len(events)
 

@@ -1,0 +1,432 @@
+"""Per-rule pbox-lint coverage: each rule fires on a violation, stays quiet
+on clean code, and honors inline suppressions; plus baseline round-trip and
+the CLI exit-code contract (docs/STATIC_ANALYSIS.md)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddlebox_tpu.analysis import (
+    ERROR,
+    WARNING,
+    apply_baseline,
+    default_rules,
+    lint_paths,
+    load_baseline,
+    save_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_source(tmp_path, source, name="mod.py", extra_files=()):
+    """Write ``source`` (and any (name, src) extras) under tmp_path, lint."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    paths = [str(p)]
+    for fname, src in extra_files:
+        q = tmp_path / fname
+        q.parent.mkdir(parents=True, exist_ok=True)
+        q.write_text(textwrap.dedent(src))
+        paths.append(str(q))
+    return lint_paths(paths, default_rules(), root=str(tmp_path))
+
+
+def rule_findings(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+# ---- JIT001 ----------------------------------------------------------------
+
+
+class TestJitPurity:
+    def test_positive(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import time
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                t = time.time()
+                y = x.item()
+                z = float(x) + int(x)
+                w = np.asarray(x)
+                if x > 0:
+                    y = 1.0
+                return y
+        """)
+        msgs = [f.message for f in rule_findings(res, "JIT001")]
+        assert any("host clock" in m for m in msgs)
+        assert any(".item()" in m for m in msgs)
+        assert any("float()" in m for m in msgs)
+        assert any("np.asarray()" in m for m in msgs)
+        assert any("Python `if`" in m for m in msgs)
+
+    def test_call_form_and_partial(self, tmp_path):
+        # jitted by reference (jax.jit(step)) and via functools.partial
+        res = lint_source(tmp_path, """
+            import functools
+            import jax
+
+            def step(x):
+                return x.item()
+
+            fast = jax.jit(step)
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def go(x, mode):
+                if mode:          # static arg: fine
+                    return x
+                return float(x)   # traced arg: flagged
+        """)
+        msgs = [f.message for f in rule_findings(res, "JIT001")]
+        assert any(".item()" in m for m in msgs)
+        assert any("float()" in m for m in msgs)
+        assert not any("Python `if`" in m for m in msgs)
+
+    def test_clean(self, tmp_path):
+        # shape reads, is-None checks, jnp use: all trace-static
+        res = lint_source(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x, mask):
+                if x.ndim != 2:
+                    raise ValueError(x.shape)
+                if mask is None:
+                    mask = jnp.ones(x.shape[0])
+                return jnp.where(mask > 0, x.sum(axis=1), 0.0)
+
+            def host_side(arr):
+                return float(arr.sum())  # not jitted: fine
+        """)
+        assert rule_findings(res, "JIT001") == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.item()  # pbox-lint: disable=JIT001
+        """)
+        assert rule_findings(res, "JIT001") == []
+
+
+# ---- THR002 ----------------------------------------------------------------
+
+
+class TestLockDiscipline:
+    def test_thread_reachable_is_error(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._data = []  # guarded-by: _lock
+                    self._lock = threading.Lock()
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self._data.append(1)
+        """)
+        errs = [f for f in rule_findings(res, "THR002") if f.severity == ERROR]
+        assert len(errs) == 1
+        assert "thread entry point" in errs[0].message
+
+    def test_unreachable_is_warning_and_locked_is_clean(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._data = []  # guarded-by: _lock
+                    self._lock = threading.Lock()
+
+                def locked(self):
+                    with self._lock:
+                        return len(self._data)
+
+                def bare(self):
+                    return self._data
+        """)
+        found = rule_findings(res, "THR002")
+        assert len(found) == 1
+        assert found[0].severity == WARNING
+        assert "Box.bare" in found[0].message
+
+    def test_module_global_and_submit_entry(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+            from concurrent.futures import ThreadPoolExecutor
+
+            _lock = threading.Lock()
+            _count = 0  # guarded-by: _lock
+
+            def worker():
+                global _count
+                _count += 1
+
+            def launch(ex: ThreadPoolExecutor):
+                ex.submit(worker)
+
+            def safe():
+                with _lock:
+                    return _count
+        """)
+        errs = [f for f in rule_findings(res, "THR002") if f.severity == ERROR]
+        assert len(errs) == 1
+        assert "worker" in errs[0].message
+
+    def test_suppressed(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._data = []  # guarded-by: _lock
+                    self._lock = threading.Lock()
+
+                def bare(self):
+                    return self._data  # pbox-lint: disable=THR002
+        """)
+        assert rule_findings(res, "THR002") == []
+
+
+# ---- REG003 ----------------------------------------------------------------
+
+FAULTINJECT_STUB = """
+    KNOWN_SITES = ("good.site",)
+
+    def fire(site):
+        pass
+"""
+
+
+class TestRegistryConsistency:
+    def test_undefined_read_and_dead_define(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu import config
+
+            config.define_flag("lonely_knob", 1, "never read")
+
+            def use():
+                return config.get_flag("phantom_knob")
+        """)
+        errs = [f for f in rule_findings(res, "REG003") if f.severity == ERROR]
+        warns = [f for f in rule_findings(res, "REG003") if f.severity == WARNING]
+        assert len(errs) == 1 and "phantom_knob" in errs[0].message
+        assert len(warns) == 1 and "lonely_knob" in warns[0].message
+
+    def test_unknown_fault_site(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from paddlebox_tpu.utils.faultinject import fire
+
+            def f():
+                fire("good.site")
+                fire("typo.site")
+            """,
+            extra_files=[("utils/faultinject.py", FAULTINJECT_STUB)],
+        )
+        errs = [f for f in rule_findings(res, "REG003") if f.severity == ERROR]
+        assert len(errs) == 1
+        assert "typo.site" in errs[0].message
+
+    def test_clean_and_dynamic_names_skipped(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu import config
+
+            config.define_flag("real_knob", 2, "read below")
+
+            def use(name):
+                config.get_flag(name)  # dynamic: not checkable
+                return config.get_flag("real_knob")
+        """)
+        assert rule_findings(res, "REG003") == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu import config
+
+            def use():
+                return config.get_flag("phantom")  # pbox-lint: disable=REG003
+        """)
+        assert rule_findings(res, "REG003") == []
+
+
+# ---- IO004 -----------------------------------------------------------------
+
+
+class TestDurableWrite:
+    def test_positive_all_write_modes(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def bad(p):
+                open(p, "w").write("x")
+                open(p, "wb").write(b"x")
+                open(p, "a").write("x")
+                open(p, mode="r+").write("x")
+        """)
+        assert len(rule_findings(res, "IO004")) == 4
+
+    def test_clean(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def good(p, m):
+                open(p).read()
+                open(p, "rb").read()
+                open(p, m).read()  # non-literal mode: skipped
+        """)
+        assert rule_findings(res, "IO004") == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def wrapper(p):
+                return open(p, "w")  # pbox-lint: disable=IO004
+        """)
+        assert rule_findings(res, "IO004") == []
+
+
+# ---- MON005 ----------------------------------------------------------------
+
+
+class TestStatNames:
+    def test_positive(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
+
+            def f(kind):
+                STAT_ADD("Bad-Name")
+                STAT_SET(f"dyn_{kind}", 1)
+        """)
+        msgs = [f.message for f in rule_findings(res, "MON005")]
+        assert len(msgs) == 2
+        assert any("Bad-Name" in m for m in msgs)
+        assert any("string literal" in m for m in msgs)
+
+    def test_clean(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_GET
+
+            def f(name):
+                STAT_ADD("pass.auc_updates", 2)
+                STAT_GET(name)  # reads may be programmatic
+        """)
+        assert rule_findings(res, "MON005") == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu.utils.monitor import STAT_ADD
+
+            def f(kind):
+                STAT_ADD(f"sup_{kind}")  # pbox-lint: disable=MON005
+        """)
+        assert rule_findings(res, "MON005") == []
+
+
+# ---- baseline round-trip ---------------------------------------------------
+
+
+class TestBaseline:
+    def test_add_then_remove_round_trip(self, tmp_path):
+        src = """
+            def bad(p):
+                open(p, "w").write("x")
+        """
+        res = lint_source(tmp_path, src)
+        assert len(res.errors) == 1
+
+        bl_path = str(tmp_path / "baseline.json")
+        save_baseline(bl_path, res.findings)
+        bl = load_baseline(bl_path)
+        assert len(bl) == 1
+
+        # grandfathered: same finding no longer gates
+        new, old, stale = apply_baseline(res.findings, bl)
+        assert [f for f in new if f.severity == ERROR] == []
+        assert len(old) == 1 and stale == []
+
+        # a SECOND identical violation exceeds the budget and gates
+        res2 = lint_source(
+            tmp_path,
+            """
+            def bad(p):
+                open(p, "w").write("x")
+                open(p, "w").write("y")
+            """,
+        )
+        new2, old2, _ = apply_baseline(res2.findings, bl)
+        assert len([f for f in new2 if f.severity == ERROR]) == 1
+        assert len(old2) == 1
+
+        # violation fixed -> baseline entry reported stale
+        res3 = lint_source(tmp_path, "def ok():\n    return 1\n")
+        new3, old3, stale3 = apply_baseline(res3.findings, bl)
+        assert new3 == [] and old3 == [] and len(stale3) == 1
+
+    def test_warnings_never_consume_budget(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu import config
+
+            config.define_flag("dead_knob", 1, "warned, not gated")
+        """)
+        assert res.errors == []
+        save_baseline(str(tmp_path / "b.json"), res.findings)
+        assert load_baseline(str(tmp_path / "b.json")) == {}
+
+
+# ---- CLI contract ----------------------------------------------------------
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_lint.py"), *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+class TestCli:
+    def test_exit_codes_and_json(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text('def f(p):\n    open(p, "w")\n')
+        bl = str(tmp_path / "bl.json")
+
+        r = run_cli(str(bad), "--baseline", bl)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "IO004" in r.stdout
+
+        r = run_cli(str(bad), "--baseline", bl, "--format=json")
+        assert r.returncode == 1
+        payload = json.loads(r.stdout)
+        assert payload["ok"] is False
+        assert payload["new_errors"][0]["rule"] == "IO004"
+
+        # baseline the finding -> clean exit; then fix -> stale reported
+        r = run_cli(str(bad), "--baseline", bl, "--update-baseline")
+        assert r.returncode == 0
+        r = run_cli(str(bad), "--baseline", bl)
+        assert r.returncode == 0
+        assert "baseline" in r.stdout
+
+        bad.write_text("def f(p):\n    return p\n")
+        r = run_cli(str(bad), "--baseline", bl, "--format=json")
+        assert r.returncode == 0
+        payload = json.loads(r.stdout)
+        assert payload["ok"] is True
+        assert len(payload["stale_baseline"]) == 1
+
+        r = run_cli(str(tmp_path / "no_such_dir"))
+        assert r.returncode == 2
+
+    def test_syntax_error_gates(self, tmp_path):
+        broken = tmp_path / "broken.py"
+        broken.write_text("def f(:\n")
+        r = run_cli(str(broken), "--no-baseline")
+        assert r.returncode == 1
+        assert "syntax error" in r.stdout

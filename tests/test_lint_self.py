@@ -1,0 +1,75 @@
+"""pbox-lint as a tier-1 self-check: the package must lint clean against
+the checked-in baseline, and the gate must actually be live (a synthetic
+violation fails). This is the enforcement point — a PR that introduces a
+new lint error fails HERE, not in some optional side tool."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+from paddlebox_tpu.analysis import (
+    ERROR,
+    apply_baseline,
+    default_rules,
+    lint_paths,
+    load_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddlebox_tpu")
+BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def lint_package(root=REPO, pkg=PKG, baseline=BASELINE):
+    result = lint_paths([pkg], default_rules(), root=root)
+    new, grandfathered, stale = apply_baseline(
+        result.findings, load_baseline(baseline)
+    )
+    return result, [f for f in new if f.severity == ERROR], stale
+
+
+def test_package_lints_clean():
+    result, new_errors, stale = lint_package()
+    assert result.parse_errors == [], result.parse_errors
+    assert new_errors == [], "\n" + "\n".join(f.render() for f in new_errors)
+    # a stale entry means a grandfathered finding was fixed but the baseline
+    # kept its budget — shrink it so the debt can't silently regrow
+    assert stale == [], (
+        "baseline entries no longer fire — run "
+        "`python tools/run_lint.py paddlebox_tpu/ --update-baseline`: "
+        f"{stale}"
+    )
+
+
+def test_baseline_is_small():
+    # the baseline exists to demonstrate grandfathering, not to hoard debt
+    assert len(load_baseline(BASELINE)) <= 5
+
+
+def test_synthetic_violation_fails(tmp_path):
+    # copy a real module tree shape: package root + one doctored file
+    pkg = tmp_path / "paddlebox_tpu"
+    pkg.mkdir()
+    shutil.copy(os.path.join(PKG, "config.py"), pkg / "config.py")
+    (pkg / "doctored.py").write_text(
+        "from paddlebox_tpu.utils.monitor import STAT_ADD\n"
+        "def f(p):\n"
+        "    open(p, 'w').write('x')\n"
+        "    STAT_ADD('Not-A-Valid-Name')\n"
+    )
+    _, new_errors, _ = lint_package(
+        root=str(tmp_path), pkg=str(pkg), baseline=BASELINE
+    )
+    rules = {f.rule for f in new_errors}
+    assert "IO004" in rules and "MON005" in rules
+
+
+def test_cli_gate_green_on_package():
+    # the exact invocation CI/developers run
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_lint.py"),
+         os.path.join(REPO, "paddlebox_tpu")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr

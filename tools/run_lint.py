@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""pbox-lint CLI — run the project linter without importing the package.
+
+``paddlebox_tpu/__init__`` pulls in jax; the analysis subpackage is
+stdlib-only by design, so this driver loads it by path with importlib and
+never pays that import (works on boxes with no jax at all).
+
+Exit codes:
+  0  no new errors (warnings and baseline-grandfathered errors are OK)
+  1  new errors found (or syntax errors in scanned files)
+  2  usage / internal error
+
+Typical invocations:
+  python tools/run_lint.py paddlebox_tpu/
+  python tools/run_lint.py paddlebox_tpu/ --format=json
+  python tools/run_lint.py paddlebox_tpu/ --update-baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_BASELINE = os.path.join(_REPO, "tools", "lint_baseline.json")
+
+
+def _load_analysis():
+    """Import paddlebox_tpu.analysis by path, skipping the package root."""
+    pkg_dir = os.path.join(_REPO, "paddlebox_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "pbox_analysis",
+        os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["pbox_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="pbox-lint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: paddlebox_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
+                    help="baseline file (default: tools/lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every error gates")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current errors and exit 0")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress warnings and grandfathered findings")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.join(_REPO, "paddlebox_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"pbox-lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    try:
+        analysis = _load_analysis()
+    except Exception as e:  # loading the linter itself failed
+        print(f"pbox-lint: failed to load analysis package: {e}",
+              file=sys.stderr)
+        return 2
+
+    result = analysis.lint_paths(paths, analysis.default_rules(), root=_REPO)
+
+    if args.update_baseline:
+        analysis.save_baseline(args.baseline, result.findings)
+        n = sum(1 for f in result.findings if f.severity == analysis.ERROR)
+        print(f"pbox-lint: baseline rewritten with {n} error(s) -> "
+              f"{os.path.relpath(args.baseline, _REPO)}")
+        return 0
+
+    baseline = {} if args.no_baseline else analysis.load_baseline(args.baseline)
+    new, grandfathered, stale = analysis.apply_baseline(
+        result.findings, baseline
+    )
+    new_errors = [f for f in new if f.severity == analysis.ERROR]
+    new_warnings = [f for f in new if f.severity == analysis.WARNING]
+
+    if args.format == "json":
+        print(json.dumps({
+            "new_errors": [f.as_dict() for f in new_errors],
+            "warnings": [f.as_dict() for f in new_warnings],
+            "grandfathered": [f.as_dict() for f in grandfathered],
+            "stale_baseline": [
+                {"rule": r, "path": p, "message": m} for r, p, m in stale
+            ],
+            "parse_errors": [f.as_dict() for f in result.parse_errors],
+            "ok": not new_errors and not result.parse_errors,
+        }, indent=2))
+    else:
+        for f in result.parse_errors:
+            print(f.render())
+        for f in new_errors:
+            print(f.render())
+        if not args.quiet:
+            for f in new_warnings:
+                print(f.render())
+            for f in grandfathered:
+                print(f"{f.render()}  (baseline)")
+            for r, p, m in stale:
+                print(f"stale baseline entry (no longer fires — run "
+                      f"--update-baseline to drop): {r} {p} {m}")
+        print(
+            f"pbox-lint: {len(new_errors)} new error(s), "
+            f"{len(new_warnings)} warning(s), "
+            f"{len(grandfathered)} baselined, {len(stale)} stale"
+        )
+
+    if result.parse_errors or new_errors:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
