@@ -103,6 +103,36 @@ define_flag(
 )
 define_flag("use_pallas_sparse", False, "Pallas prefetch-DMA kernels for sparse pull/push on TPU")
 
+# --- host transport (parallel/transport.py) ---
+define_flag(
+    "transport_send_retries",
+    3,
+    "reconnect+resend attempts after a failed host-plane send before the "
+    "error surfaces to the caller (each retry re-opens the peer connection "
+    "and replays every un-acked frame)",
+)
+define_flag(
+    "transport_backoff_s",
+    0.1,
+    "base of the exponential backoff between transport send retries "
+    "(doubles per attempt, capped at 5s)",
+)
+define_flag(
+    "transport_heartbeat_s",
+    2.0,
+    "interval of the per-peer heartbeat thread: each beat carries the "
+    "delivered-frame ack that prunes the sender's resend buffer and feeds "
+    "the failure detector; 0 disables the thread (no failure detection, "
+    "resend buffers grow until reconnect)",
+)
+define_flag(
+    "transport_peer_dead_s",
+    15.0,
+    "failure-detector horizon: a peer silent for half this is 'suspect', "
+    "for all of it 'dead' — collectives stop waiting on dead peers and "
+    "name them instead of running out the full timeout",
+)
+
 # --- metrics ---
 define_flag("auc_num_buckets", 1_000_000, "AUC wuauc bucket table size (reference box_wrapper.h:61)")
 define_flag("auc_runner_pool_size", 10_000, "AucRunner candidate reservoir capacity per pool")

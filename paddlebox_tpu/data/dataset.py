@@ -206,6 +206,10 @@ class BoxPSDataset:
 
         self.date: Optional[str] = None
         self.pass_id = 0
+        # bumped by every revert_pass: scopes the distributed working-set
+        # exchange tags so a retried pass never consumes frames from the
+        # aborted attempt (see TcpTransport.discard_epochs_below)
+        self.pass_epoch = 0
         self.current_phase = 1  # 1 join, 0 update (data_set.h:291)
         self._filelist: List[str] = []
         # pass data lives EITHER columnar (store + shuffle order — the fast
@@ -503,7 +507,10 @@ class BoxPSDataset:
             from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
 
             return DistributedWorkingSet(
-                self.transport, self.n_mesh_shards, pass_id=self.pass_id
+                self.transport,
+                self.n_mesh_shards,
+                pass_id=self.pass_id,
+                epoch=self.pass_epoch,
             )
         return PassWorkingSet(n_mesh_shards=self.n_mesh_shards)
 
@@ -783,6 +790,13 @@ class BoxPSDataset:
             )
         guard.revert()
         self._guard = None
+        # new epoch for the retrain: the aborted attempt's in-flight
+        # exchange frames (if any) must never reach the retried exchange
+        self.pass_epoch += 1
+        if self.transport is not None and hasattr(
+            self.transport, "discard_epochs_below"
+        ):
+            self.transport.discard_epochs_below(self.pass_epoch)
         # fresh working set over the same in-memory records for the retrain
         ws = self._new_working_set()
         if self.store is not None:

@@ -39,28 +39,57 @@ class RoleMaker:
     @staticmethod
     def from_env(env: Optional[dict] = None) -> "RoleMaker":
         """Resolve rank/world/coordinator from the first env dialect found:
-        JAX native -> reference PADDLE_* -> single-process default."""
+        JAX native -> reference PADDLE_* -> single-process default.
+
+        Every malformed resolution raises ValueError NAMING the offending
+        environment variable — a bad scheduler env must fail at role
+        resolution, not minutes later inside socket/rendezvous code."""
         e = os.environ if env is None else env
 
         def first(*names, default=None):
+            """Returns (source_var_name, value) of the first set variable."""
             for n in names:
                 if e.get(n) not in (None, ""):
-                    return e[n]
-            return default
+                    return n, e[n]
+            return None, default
 
-        rank = int(first("JAX_PROCESS_ID", "PADDLE_TRAINER_ID", default="0"))
-        world = int(first("JAX_NUM_PROCESSES", "PADDLE_TRAINERS_NUM", default="1"))
-        coord = first("JAX_COORDINATOR_ADDRESS")
+        def as_int(src, raw, what):
+            try:
+                return int(raw)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{src}={raw!r} is not a valid integer {what}"
+                ) from None
+
+        rank_src, rank_raw = first("JAX_PROCESS_ID", "PADDLE_TRAINER_ID", default="0")
+        world_src, world_raw = first(
+            "JAX_NUM_PROCESSES", "PADDLE_TRAINERS_NUM", default="1"
+        )
+        rank = as_int(rank_src or "JAX_PROCESS_ID (default)", rank_raw, "rank")
+        world = as_int(
+            world_src or "JAX_NUM_PROCESSES (default)", world_raw, "world size"
+        )
+        if world <= 0:
+            raise ValueError(
+                f"{world_src or 'JAX_NUM_PROCESSES'}={world_raw!r}: world "
+                "size must be >= 1"
+            )
+        if not (0 <= rank < world):
+            raise ValueError(
+                f"{rank_src or 'JAX_PROCESS_ID'}={rank_raw!r}: rank {rank} "
+                f"out of range for world {world} "
+                f"(from {world_src or 'default'})"
+            )
+        _, coord = first("JAX_COORDINATOR_ADDRESS")
         if coord is None:
             ip, port = e.get("POD_IP"), e.get("PADDLE_PORT")
             if ip and port:
                 coord = f"{ip}:{port}"
-        if not (0 <= rank < world):
-            raise ValueError(f"rank {rank} out of range for world {world}")
         if world > 1 and coord is None:
             raise ValueError(
-                "multi-process role needs a coordinator (set "
-                "JAX_COORDINATOR_ADDRESS or POD_IP+PADDLE_PORT)"
+                f"{world_src}={world_raw!r} declares a multi-process role "
+                "but no coordinator is set (set JAX_COORDINATOR_ADDRESS or "
+                "POD_IP+PADDLE_PORT)"
             )
         return RoleMaker(rank=rank, world=world, coordinator=coord)
 

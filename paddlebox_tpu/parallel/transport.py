@@ -18,18 +18,65 @@ provides over plain TCP:
 
 Tags scope rounds (e.g. ``shuffle:3``): a fast rank's frames for round
 N+1 queue in the inbox without corrupting a slow rank's round N collect.
+
+Fault tolerance (the MPICluster resilience the reference delegates to the
+closed boxps tier, rebuilt in the open — see docs/ROBUSTNESS.md,
+"Distributed plane"):
+
+- Every connection opens with a versioned HELLO handshake; the accepting
+  side replies with the count of data frames it has already delivered
+  from that peer, so a reconnecting sender resumes exactly where the
+  receiver left off.
+- Every frame carries a per-destination sequence number and a CRC32 over
+  tag+payload. The receiver drops duplicates (``seq <= delivered``) and
+  kills the connection on checksum mismatch — the sender's resync replays
+  the lost tail, so a frame is delivered exactly once or the send fails
+  loudly.
+- The send path keeps un-acked frames in a per-destination resend buffer
+  and heals dropped connections with bounded exponential backoff
+  (``transport_send_retries`` x ``transport_backoff_s``).
+- A heartbeat thread (``transport_heartbeat_s``) beats every peer; beats
+  carry the delivered-count ack that prunes the peer's resend buffer, and
+  received traffic feeds a per-peer failure detector (silent for
+  ``transport_peer_dead_s``/2 -> suspect, for the full horizon -> dead).
+- Collectives are deadline-aware: a timeout names exactly which ranks and
+  tags are missing (straggler report), and a peer the detector declares
+  dead fails the collective immediately instead of running out the clock.
+- Tags may carry an epoch suffix ``@e<N>`` (the DistributedWorkingSet
+  rounds do). ``discard_epochs_below`` raises a floor below which frames
+  are dropped — in the inbox now, and on delivery for late arrivals — so
+  a coordinated pass retry can never consume a stale attempt's frames.
 """
 
 from __future__ import annotations
 
+import re
 import socket
 import struct
 import threading
+import time
+import zlib
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-_HDR = struct.Struct("<III")  # src_rank, tag_len, payload_len
-
 from paddlebox_tpu import config
+from paddlebox_tpu.utils.faultinject import fire
+from paddlebox_tpu.utils.monitor import STAT_ADD
+from paddlebox_tpu.utils.trace import PROFILER
+
+_MAGIC = b"PBTX"
+_VERSION = 2
+# connection handshake: magic, protocol version, sender rank
+_HELLO = struct.Struct("<4sHH")
+# handshake reply / heartbeat ack payload: delivered data-frame count
+_ACK = struct.Struct("<Q")
+# frame header: seq, kind, tag_len, payload_len, crc32(tag+payload)
+_FRAME = struct.Struct("<QBHII")
+
+_KIND_DATA = 0
+_KIND_HEARTBEAT = 1
+
+_EPOCH_RE = re.compile(r"@e(\d+)$")
 
 config.define_flag(
     "shuffle_chunk_bytes",
@@ -38,6 +85,11 @@ config.define_flag(
     "serialization RAM and keeps frames flowing so the receive timeout "
     "paces per-chunk gaps, not whole-pass serialization",
 )
+
+
+def _tag_epoch(tag: str) -> Optional[int]:
+    m = _EPOCH_RE.search(tag)
+    return int(m.group(1)) if m else None
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -50,8 +102,50 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+class TransportTimeout(TimeoutError):
+    """A collective/recv deadline expired; ``missing`` names the
+    still-absent (tag, src) pairs — the straggler report."""
+
+    def __init__(self, msg: str, missing: List[Tuple[str, int]]):
+        super().__init__(msg)
+        self.missing = missing
+
+
+class PeerDeadError(ConnectionError):
+    """The failure detector declared a peer dead while a collective was
+    waiting on it."""
+
+    def __init__(self, msg: str, dead: List[int]):
+        super().__init__(msg)
+        self.dead = dead
+
+
+class ProtocolError(ConnectionError):
+    """Handshake magic/version mismatch — incompatible peer."""
+
+
+class _SendLink:
+    """Sender-side state for one destination.
+
+    Every field is guarded by the owning transport's per-destination send
+    lock (``_send_locks[dst]``): ``sock`` (live connection or None),
+    ``next_seq`` (last data seq assigned), ``acked`` (highest seq the peer
+    confirmed via heartbeat ack or handshake), and ``retained`` — the
+    in-order deque of (seq, frame_bytes) not yet acked, replayed after a
+    reconnect so the receiver's stream resumes gaplessly."""
+
+    __slots__ = ("sock", "next_seq", "acked", "retained", "was_connected")
+
+    def __init__(self) -> None:
+        self.sock: Optional[socket.socket] = None
+        self.next_seq = 0
+        self.acked = 0
+        self.retained: deque = deque()
+        self.was_connected = False
+
+
 class TcpTransport:
-    """Tagged rank-to-rank byte transport over TCP."""
+    """Tagged rank-to-rank byte transport over TCP (fault-tolerant)."""
 
     def __init__(self, rank: int, endpoints: List[str], timeout: float = 120.0):
         self.rank = rank
@@ -61,11 +155,16 @@ class TcpTransport:
         # (tag, src) -> FIFO of frames: a duplicate tag from one peer queues
         # behind the unconsumed first frame instead of overwriting it (a
         # dataset driven without set_date reuses pass-id-derived tags)
-        self._inbox: Dict[Tuple[str, int], List[bytes]] = {}
         self._cond = threading.Condition()
-        self._send_socks: Dict[int, socket.socket] = {}
+        self._inbox: Dict[Tuple[str, int], List[bytes]] = {}  # guarded-by: _cond
+        self._delivered: Dict[int, int] = {}  # guarded-by: _cond
+        self._last_seen: Dict[int, float] = {}  # guarded-by: _cond
+        self._epoch_min = 0  # guarded-by: _cond
         self._send_locks: Dict[int, threading.Lock] = {
             r: threading.Lock() for r in range(self.n_ranks)
+        }
+        self._links: Dict[int, _SendLink] = {
+            r: _SendLink() for r in range(self.n_ranks)
         }
         self._closed = False
         # listener
@@ -78,6 +177,16 @@ class TcpTransport:
         self._server.listen(self.n_ranks * 4)
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
+        # heartbeat: acks + failure detection; off when flag is 0 or the
+        # "cluster" is a single rank
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        hb = float(config.get_flag("transport_heartbeat_s"))
+        if hb > 0 and self.n_ranks > 1:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(hb,), daemon=True
+            )
+            self._hb_thread.start()
 
     @staticmethod
     def _parse(ep: str) -> Tuple[str, int]:
@@ -87,6 +196,15 @@ class TcpTransport:
     @property
     def port(self) -> int:
         return self._endpoints[self.rank][1]
+
+    @staticmethod
+    def _close_sock(sock: socket.socket) -> None:
+        """Counted close — a failed close is rare but never silent."""
+        try:
+            sock.close()
+        except OSError as e:
+            STAT_ADD("transport.close_errors")
+            PROFILER.instant("transport:close_error", {"error": repr(e)})
 
     # ---- receive side ----------------------------------------------------
 
@@ -101,91 +219,369 @@ class TcpTransport:
             ).start()
 
     def _reader(self, conn: socket.socket) -> None:
+        src = -1
         try:
+            # handshake under the transport timeout so a wedged peer can't
+            # pin this reader forever; the frame loop then blocks freely
+            conn.settimeout(self.timeout)
+            magic, version, src = _HELLO.unpack(_recv_exact(conn, _HELLO.size))
+            if magic != _MAGIC or version != _VERSION:
+                STAT_ADD("transport.protocol_errors")
+                PROFILER.instant(
+                    "transport:protocol_error",
+                    {"magic": repr(magic), "version": version},
+                )
+                return
+            with self._cond:
+                delivered = self._delivered.get(src, 0)
+                self._last_seen[src] = time.monotonic()
+            # resync point: the peer replays every frame after this count
+            conn.sendall(_ACK.pack(delivered))
+            conn.settimeout(None)
             while True:
-                hdr = _recv_exact(conn, _HDR.size)
-                src, tag_len, n = _HDR.unpack(hdr)
-                tag = _recv_exact(conn, tag_len).decode()
-                payload = _recv_exact(conn, n)
+                fire("transport.recv_frame")
+                seq, kind, tag_len, n, crc = _FRAME.unpack(
+                    _recv_exact(conn, _FRAME.size)
+                )
+                body = _recv_exact(conn, tag_len + n)
                 with self._cond:
-                    self._inbox.setdefault((tag, src), []).append(payload)
-                    self._cond.notify_all()
+                    self._last_seen[src] = time.monotonic()
+                if zlib.crc32(body) != crc:
+                    # corrupt frame: drop the connection; the sender's
+                    # resync replays everything un-delivered
+                    STAT_ADD("transport.crc_errors")
+                    PROFILER.instant(
+                        "transport:crc_error", {"src": src, "seq": seq}
+                    )
+                    return
+                tag = body[:tag_len].decode()
+                payload = body[tag_len:]
+                if kind == _KIND_HEARTBEAT:
+                    if len(payload) == _ACK.size:
+                        self._prune_retained(src, _ACK.unpack(payload)[0])
+                    continue
+                dup = stale = False
+                with self._cond:
+                    if seq <= self._delivered.get(src, 0):
+                        dup = True
+                    else:
+                        self._delivered[src] = seq
+                        ep = _tag_epoch(tag)
+                        if ep is not None and ep < self._epoch_min:
+                            stale = True
+                        else:
+                            self._inbox.setdefault((tag, src), []).append(payload)
+                            self._cond.notify_all()
+                if dup:
+                    STAT_ADD("transport.dup_frames_dropped")
+                if stale:
+                    STAT_ADD("transport.stale_frames_dropped")
         except (ConnectionError, OSError):
             return
+        finally:
+            self._close_sock(conn)
 
-    def _take(self, tag: str, src: int) -> bytes:
-        with self._cond:
-            ok = self._cond.wait_for(
-                lambda: (tag, src) in self._inbox, timeout=self.timeout
-            )
-            if not ok:
-                raise TimeoutError(
-                    f"rank {self.rank}: no frame tag={tag!r} from rank {src} "
-                    f"within {self.timeout}s"
-                )
+    def _pop_locked(self, tag: str, src: int) -> bytes:
+        with self._cond:  # re-entrant: callers already hold it
             q = self._inbox[(tag, src)]
             payload = q.pop(0)
             if not q:
                 del self._inbox[(tag, src)]
             return payload
 
-    def recv(self, tag: str, src: int) -> bytes:
+    def _take_all(
+        self, pairs: List[Tuple[str, int]], op: str, timeout: Optional[float]
+    ) -> List[bytes]:
+        """Wait for one frame per (tag, src); deadline-aware with a
+        straggler report, and fail-fast on detector-dead peers."""
+        budget = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + budget
+        dead_s = float(config.get_flag("transport_peer_dead_s"))
+        with self._cond:
+            while True:
+                missing = [p for p in pairs if p not in self._inbox]
+                if not missing:
+                    return [self._pop_locked(tag, src) for tag, src in pairs]
+                now = time.monotonic()
+                dead = sorted(
+                    {
+                        src
+                        for _tag, src in missing
+                        if src != self.rank
+                        and src in self._last_seen
+                        and now - self._last_seen[src] >= dead_s
+                    }
+                )
+                if dead:
+                    raise PeerDeadError(
+                        f"rank {self.rank}: {op} failed — "
+                        f"rank(s) {dead} considered dead (no traffic for "
+                        f">= {dead_s:.1f}s)",
+                        dead,
+                    )
+                if now >= deadline:
+                    report = ", ".join(
+                        f"rank {src} ({self._peer_status_locked(src, now)}, "
+                        f"tag {tag!r})"
+                        for tag, src in sorted(missing, key=lambda p: p[1])
+                    )
+                    raise TransportTimeout(
+                        f"rank {self.rank}: {op} timed out after "
+                        f"{budget:.1f}s still waiting on: {report}",
+                        missing,
+                    )
+                # short slices so dead-peer detection runs while waiting
+                self._cond.wait(min(0.25, deadline - now))
+
+    def recv(self, tag: str, src: int, timeout: Optional[float] = None) -> bytes:
         """Blocking receive of one frame (tag, src) — the public primitive
         streamed protocols (TcpShuffleRouter) build on."""
-        return self._take(tag, src)
+        return self._take_all([(tag, src)], f"recv(tag={tag!r})", timeout)[0]
+
+    # ---- failure detector ------------------------------------------------
+
+    def _peer_status_locked(self, src: int, now: float) -> str:
+        if src == self.rank:
+            return "alive"
+        with self._cond:  # re-entrant: callers already hold it
+            seen = self._last_seen.get(src)
+        if seen is None:
+            return "never seen"
+        age = now - seen
+        dead_s = float(config.get_flag("transport_peer_dead_s"))
+        if age >= dead_s:
+            return "dead"
+        if age >= dead_s / 2:
+            return "suspect"
+        return "alive"
+
+    def peer_status(self, src: int) -> str:
+        """'alive' | 'suspect' | 'dead' | 'never seen' from received
+        traffic (frames and heartbeats both count)."""
+        with self._cond:
+            return self._peer_status_locked(src, time.monotonic())
+
+    def dead_peers(self) -> List[int]:
+        with self._cond:
+            now = time.monotonic()
+            return [
+                r
+                for r in range(self.n_ranks)
+                if r != self.rank
+                and self._peer_status_locked(r, now) == "dead"
+            ]
+
+    # ---- epoch discard ---------------------------------------------------
+
+    def discard_epochs_below(self, epoch: int) -> int:
+        """Raise the stale-epoch floor: queued frames whose tag ends with
+        ``@e<k>``, k < epoch, are dropped now; late arrivals are dropped at
+        delivery. Returns the number of frames purged from the inbox."""
+        dropped = 0
+        with self._cond:
+            if epoch > self._epoch_min:
+                self._epoch_min = epoch
+            for key in list(self._inbox):
+                ep = _tag_epoch(key[0])
+                if ep is not None and ep < self._epoch_min:
+                    dropped += len(self._inbox.pop(key))
+        if dropped:
+            STAT_ADD("transport.stale_frames_dropped", dropped)
+        return dropped
 
     # ---- send side -------------------------------------------------------
 
-    def _sock_to(self, dst: int) -> socket.socket:
-        s = self._send_socks.get(dst)
-        if s is None:
-            s = socket.create_connection(self._endpoints[dst], timeout=self.timeout)
+    def _connect(self, dst: int) -> Tuple[socket.socket, int]:
+        """Open + handshake one connection; returns (socket, acked_count)."""
+        fire("transport.connect")
+        s = socket.create_connection(self._endpoints[dst], timeout=self.timeout)
+        try:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._send_socks[dst] = s
-        return s
+            s.sendall(_HELLO.pack(_MAGIC, _VERSION, self.rank))
+            acked = _ACK.unpack(_recv_exact(s, _ACK.size))[0]
+        except (ConnectionError, OSError):
+            self._close_sock(s)
+            raise
+        return s, acked
+
+    def _reopen(self, dst: int, link: _SendLink) -> None:
+        """(Re)connect and replay the un-acked tail. Caller holds the dst
+        send lock."""
+        sock, acked = self._connect(dst)
+        if acked > link.acked:
+            link.acked = acked
+            while link.retained and link.retained[0][0] <= acked:
+                link.retained.popleft()
+        if link.was_connected:
+            STAT_ADD("transport.reconnects")
+        link.was_connected = True
+        link.sock = sock
+        for _seq, frame in link.retained:
+            sock.sendall(frame)
+            STAT_ADD("transport.frames_resent")
+
+    def _prune_retained(self, dst: int, acked: int) -> None:
+        with self._send_locks[dst]:
+            link = self._links[dst]
+            if acked > link.acked:
+                link.acked = acked
+                while link.retained and link.retained[0][0] <= acked:
+                    link.retained.popleft()
+
+    def _flush(self, dst: int, link: _SendLink, frame: Optional[bytes],
+               tag: str, retries: Optional[int]) -> None:
+        """Put ``frame`` (already retained) on the wire, reconnecting with
+        bounded exponential backoff. Caller holds the dst send lock."""
+        attempts = (
+            int(config.get_flag("transport_send_retries"))
+            if retries is None
+            else retries
+        )
+        backoff = float(config.get_flag("transport_backoff_s"))
+        for attempt in range(attempts + 1):
+            try:
+                fire("transport.send")
+                if link.sock is None:
+                    # the reopen replays the retained tail, frame included
+                    self._reopen(dst, link)
+                elif frame is not None:
+                    link.sock.sendall(frame)
+                return
+            except (ConnectionError, OSError) as e:
+                if link.sock is not None:
+                    self._close_sock(link.sock)
+                    link.sock = None
+                if attempt >= attempts:
+                    if retries is None:
+                        # data-path exhaustion; heartbeat callers count
+                        # their own transport.heartbeat_errors instead
+                        STAT_ADD("transport.send_errors")
+                    PROFILER.instant(
+                        "transport:send_error",
+                        {
+                            "dst": dst,
+                            "tag": tag,
+                            "attempts": attempt + 1,
+                            "error": repr(e),
+                        },
+                    )
+                    raise ConnectionError(
+                        f"rank {self.rank}: send to rank {dst} "
+                        f"(tag={tag!r}) failed after {attempt + 1} "
+                        f"attempt(s): {e}"
+                    ) from e
+                STAT_ADD("transport.send_retries")
+                time.sleep(min(backoff * (2 ** attempt), 5.0))
 
     def send(self, dst: int, tag: str, payload: bytes) -> None:
         tb = tag.encode()
         if dst == self.rank:
+            stale = False
             with self._cond:
-                self._inbox.setdefault((tag, self.rank), []).append(payload)
-                self._cond.notify_all()
+                ep = _tag_epoch(tag)
+                if ep is not None and ep < self._epoch_min:
+                    stale = True
+                else:
+                    self._inbox.setdefault((tag, self.rank), []).append(payload)
+                    self._cond.notify_all()
+            if stale:
+                STAT_ADD("transport.stale_frames_dropped")
             return
         with self._send_locks[dst]:
-            s = self._sock_to(dst)
-            s.sendall(_HDR.pack(self.rank, len(tb), len(payload)) + tb + payload)
+            link = self._links[dst]
+            link.next_seq += 1
+            body = tb + payload
+            frame = (
+                _FRAME.pack(
+                    link.next_seq, _KIND_DATA, len(tb), len(payload),
+                    zlib.crc32(body),
+                )
+                + body
+            )
+            link.retained.append((link.next_seq, frame))
+            # the frame is retained BEFORE the first wire attempt, so every
+            # failure path (including a fault injected on the very first
+            # send) replays it through the reconnect resync
+            self._flush(dst, link, frame, tag, None)
+
+    # ---- heartbeat -------------------------------------------------------
+
+    def _heartbeat_loop(self, interval: float) -> None:
+        while not self._hb_stop.wait(interval):
+            if self._closed:
+                return
+            for dst in range(self.n_ranks):
+                if dst == self.rank:
+                    continue
+                try:
+                    fire("transport.heartbeat")
+                    self._send_heartbeat(dst)
+                except (ConnectionError, OSError):
+                    # a down peer makes beats fail by design; the detector
+                    # (driven by RECEIVED traffic) is what marks it dead
+                    STAT_ADD("transport.heartbeat_errors")
+
+    def _send_heartbeat(self, dst: int) -> None:
+        with self._cond:
+            delivered = self._delivered.get(dst, 0)
+        payload = _ACK.pack(delivered)
+        frame = (
+            _FRAME.pack(0, _KIND_HEARTBEAT, 0, len(payload), zlib.crc32(payload))
+            + payload
+        )
+        with self._send_locks[dst]:
+            link = self._links[dst]
+            # single attempt, not retained: beats are periodic and
+            # idempotent — but a beat that REOPENS a dropped connection
+            # replays the retained data tail, which is exactly how a
+            # receiver-side drop heals without waiting for the next send
+            self._flush(dst, link, frame, "heartbeat", 0)
 
     # ---- collectives -----------------------------------------------------
 
-    def alltoall(self, payloads: List[bytes], tag: str) -> List[bytes]:
+    def alltoall(
+        self, payloads: List[bytes], tag: str, timeout: Optional[float] = None
+    ) -> List[bytes]:
         """payloads[d] goes to rank d; returns what every rank sent here."""
         if len(payloads) != self.n_ranks:
             raise ValueError(f"need {self.n_ranks} payloads, got {len(payloads)}")
         for dst in range(self.n_ranks):
             self.send(dst, tag, payloads[dst])
-        return [self._take(tag, src) for src in range(self.n_ranks)]
+        return self._take_all(
+            [(tag, src) for src in range(self.n_ranks)],
+            f"alltoall(tag={tag!r})",
+            timeout,
+        )
 
-    def allgather(self, payload: bytes, tag: str) -> List[bytes]:
-        return self.alltoall([payload] * self.n_ranks, tag)
+    def allgather(
+        self, payload: bytes, tag: str, timeout: Optional[float] = None
+    ) -> List[bytes]:
+        return self.alltoall([payload] * self.n_ranks, tag, timeout=timeout)
 
-    def allreduce_max(self, value: int, tag: str) -> int:
-        vals = self.allgather(struct.pack("<q", int(value)), tag)
+    def allreduce_max(
+        self, value: int, tag: str, timeout: Optional[float] = None
+    ) -> int:
+        vals = self.allgather(struct.pack("<q", int(value)), tag, timeout=timeout)
         return max(struct.unpack("<q", v)[0] for v in vals)
 
-    def barrier(self, tag: str) -> None:
-        self.allgather(b"", "barrier:" + tag)
+    def barrier(self, tag: str, timeout: Optional[float] = None) -> None:
+        self.allgather(b"", "barrier:" + tag, timeout=timeout)
 
     def close(self) -> None:
         self._closed = True
+        self._hb_stop.set()
         try:
             self._server.close()
-        except OSError:
-            pass
-        for s in self._send_socks.values():
-            try:
-                s.close()
-            except OSError:
-                pass
+        except OSError as e:
+            STAT_ADD("transport.close_errors")
+            PROFILER.instant("transport:close_error", {"error": repr(e)})
+        for r in range(self.n_ranks):
+            with self._send_locks[r]:
+                link = self._links[r]
+                if link.sock is not None:
+                    self._close_sock(link.sock)
+                    link.sock = None
+                link.retained.clear()
 
 
 class TcpShuffleRouter:
@@ -205,6 +601,12 @@ class TcpShuffleRouter:
     receiver's inbox is intentionally UNBOUNDED — it holds at most the
     in-flight pass, exactly like the reference's shuffle_channel_
     (data_set.cc:1870-1926); chunking bounds the sender side only.
+
+    Round isolation under faults: the transport's per-destination frame
+    sequencing means a round replayed by a reconnecting sender can never
+    double-deliver a sub-chunk — duplicates are dropped by seq before the
+    inbox, so ``collect`` sees each sub-chunk exactly once
+    (tests/test_multihost.py::test_shuffle_round_no_double_delivery).
     """
 
     def __init__(self, transport: TcpTransport):

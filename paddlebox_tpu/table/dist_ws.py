@@ -35,7 +35,9 @@ class DistributedWorkingSet:
     """Pass working set across hosts; same pack-time surface as
     PassWorkingSet (n_mesh_shards / capacity / padding_row / lookup)."""
 
-    def __init__(self, transport, n_mesh_shards: int, pass_id: int = 0):
+    def __init__(
+        self, transport, n_mesh_shards: int, pass_id: int = 0, epoch: int = 0
+    ):
         self.transport = transport
         self.n_mesh_shards = n_mesh_shards
         n_hosts = transport.n_ranks
@@ -46,6 +48,10 @@ class DistributedWorkingSet:
         self.shards_per_host = n_mesh_shards // n_hosts
         self.shard_lo = transport.rank * self.shards_per_host
         self.pass_id = pass_id
+        # pass-retry epoch: tags carry ``@e<epoch>`` so the transport can
+        # discard a reverted attempt's frames instead of feeding them to
+        # the retried exchange (see TcpTransport.discard_epochs_below)
+        self.epoch = epoch
         self._key_chunks: List[np.ndarray] = []
         self._lock = threading.Lock()
         self._finalized = False
@@ -95,7 +101,7 @@ class DistributedWorkingSet:
         req_out = []
         for h in range(t.n_ranks):
             req_out.append(referenced[owners == h].tobytes())
-        req_in = t.alltoall(req_out, f"ws-req:{self.pass_id}")
+        req_in = t.alltoall(req_out, f"ws-req:{self.pass_id}@e{self.epoch}")
         req_keys = [np.frombuffer(b, dtype=np.uint64) for b in req_in]
 
         # owner side: union, per-shard rank assignment (ascending key order)
@@ -107,7 +113,7 @@ class DistributedWorkingSet:
         shard_of = key_to_shard(owned, self.n_mesh_shards) - self.shard_lo
         counts = np.bincount(shard_of, minlength=self.shards_per_host)
         local_max = int(counts.max()) + 1 if len(owned) else 1
-        cap = t.allreduce_max(local_max, f"ws-cap:{self.pass_id}")
+        cap = t.allreduce_max(local_max, f"ws-cap:{self.pass_id}@e{self.epoch}")
         cap = -(-cap // round_to) * round_to
         self.capacity = cap
 
@@ -158,7 +164,7 @@ class DistributedWorkingSet:
             else:
                 rep_out.append(b"")
             off += len(k)
-        rep_in = t.alltoall(rep_out, f"ws-rep:{self.pass_id}")
+        rep_in = t.alltoall(rep_out, f"ws-rep:{self.pass_id}@e{self.epoch}")
 
         # assemble local lookup over referenced keys
         rows = np.empty(len(referenced), dtype=np.int64)

@@ -7,6 +7,8 @@ from paddlebox_tpu.train.sharded_step import (
 from paddlebox_tpu.train.async_dense import AsyncDenseTable
 from paddlebox_tpu.train.checkpoint import CheckpointManager
 from paddlebox_tpu.train.supervisor import (
+    CoordinatedAbort,
+    EpochCoordinator,
     HealthGates,
     PassFailure,
     PassRejected,
@@ -25,6 +27,8 @@ __all__ = [
     "AsyncDenseTable",
     "CTRTrainer",
     "CheckpointManager",
+    "CoordinatedAbort",
+    "EpochCoordinator",
     "HealthGates",
     "PassFailure",
     "PassRejected",

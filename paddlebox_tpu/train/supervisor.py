@@ -36,6 +36,22 @@ operator convention):
   below the trailing mean of the last ``auc_window`` CONFIRMED passes
   (only consulted after ``auc_min_history`` confirmations, so a cold
   start can't self-reject).
+
+Distributed coordination (``transport=`` + :class:`EpochCoordinator`):
+when the supervisor drives one rank of a multi-host run, a pass must
+commit or revert GLOBALLY — one rank confirming a pass its peer reverted
+leaves the host tables permanently diverged. So before ``end_pass`` every
+rank publishes a verdict (my gates passed / my attempt raised) on a
+control tag scoped by the current pass epoch; any NO — including a peer
+that simply stopped answering, which times out the exchange — turns into
+a :class:`CoordinatedAbort` on the healthy ranks, and every rank walks
+the same revert path, bumps the same pass epoch (stale frames of the
+aborted attempt are discarded by tag), and retries in lockstep. The
+retried pass then runs over exactly the data + table state a clean run
+would see, so its result is bitwise-equal to a never-faulted run
+(tests/test_chaos_dist.py). Load failures coordinate the same way before
+anything is armed. Escalation stays lockstep for free: verdicts are
+global, every rank exhausts the same retry budget on the same attempt.
 """
 
 from __future__ import annotations
@@ -70,6 +86,58 @@ class PassRejected(RuntimeError):
 
 class PassFailure(RuntimeError):
     """The supervisor exhausted retries AND escalation for one pass."""
+
+
+class CoordinatedAbort(RuntimeError):
+    """A peer rank voted NO on this pass (its gate fired or its attempt
+    raised), or the verdict exchange itself failed — this rank's locally
+    healthy attempt must revert so the cluster retries in lockstep."""
+
+    def __init__(self, detail: str):
+        super().__init__(f"pass aborted by peer verdict: {detail}")
+        self.detail = detail
+
+
+class EpochCoordinator:
+    """Control-plane verdict exchange + pass-epoch bookkeeping for one rank.
+
+    ``exchange_verdict`` is an allgather on tag ``ctl:verdict:<key>@e<N>``
+    (payload ``b"\\x01"`` = ok, ``b"\\x00" + detail`` = abort): it returns
+    the GLOBAL verdict, and treats its own transport failure/timeout as an
+    abort vote — a rank that cannot hear its peers must not confirm.
+    ``advance`` bumps the epoch after a revert and raises the transport's
+    stale-frame floor, so nothing a reverted attempt left in flight can
+    reach the retried attempt's exchanges (the epoch suffix is the same
+    ``@e<N>`` convention DistributedWorkingSet tags carry)."""
+
+    def __init__(self, transport, timeout: Optional[float] = None):
+        self.transport = transport
+        self.timeout = timeout
+        self.epoch = 0
+
+    def exchange_verdict(self, key: str, ok: bool, detail: str = ""):
+        """Returns (global_ok, detail) after every rank has voted."""
+        payload = b"\x01" if ok else b"\x00" + detail.encode()[:512]
+        tag = f"ctl:verdict:{key}@e{self.epoch}"
+        try:
+            votes = self.transport.allgather(payload, tag, timeout=self.timeout)
+        except (OSError, TimeoutError) as e:
+            STAT_ADD("supervisor_verdict_exchange_errors")
+            return False, f"verdict exchange failed: {e!r}"
+        bad = [
+            f"rank {r}: {v[1:].decode(errors='replace') or 'aborted'}"
+            for r, v in enumerate(votes)
+            if v[:1] != b"\x01"
+        ]
+        if bad:
+            return False, "; ".join(bad)
+        return True, ""
+
+    def advance(self, epoch: Optional[int] = None) -> None:
+        """Enter the next pass epoch (or adopt the dataset's counter, which
+        revert_pass bumps — keeping the two in lockstep)."""
+        self.epoch = self.epoch + 1 if epoch is None else epoch
+        self.transport.discard_epochs_below(self.epoch)
 
 
 @dataclass
@@ -146,6 +214,7 @@ class PassSupervisor:
         round_to: int = 512,
         shrink: bool = True,
         on_give_up: str = "raise",  # raise | skip (drop the pass, keep the day)
+        transport=None,
     ):
         if on_give_up not in ("raise", "skip"):
             raise ValueError(f"on_give_up must be 'raise' or 'skip', got {on_give_up!r}")
@@ -155,6 +224,15 @@ class PassSupervisor:
         self.checkpoint = checkpoint
         self.gates = gates or HealthGates()
         self.retry = retry or RetryPolicy()
+        # multi-rank: verdict exchange + epoch bookkeeping; a single-rank
+        # transport needs no coordination
+        self.coord = (
+            EpochCoordinator(transport)
+            if transport is not None and getattr(transport, "n_ranks", 1) > 1
+            else None
+        )
+        if self.coord is not None:
+            self.coord.epoch = getattr(dataset, "pass_epoch", 0)
         self.round_to = round_to
         self.shrink = shrink
         self.on_give_up = on_give_up
@@ -230,24 +308,47 @@ class PassSupervisor:
                 )
 
     def _attempt(self, n_batches: Optional[int]) -> Dict[str, float]:
-        """One armed begin->train->gate->confirm cycle."""
-        if not self.ds._in_pass:
-            # first attempt, or a revert re-armed the in-memory data
-            self.ds.begin_pass(
-                round_to=self.round_to, enable_revert=True, trainer=self.tr
+        """One armed begin->train->gate->[global verdict]->confirm cycle."""
+        err: Optional[Exception] = None
+        out: Dict[str, float] = {}
+        try:
+            if not self.ds._in_pass:
+                # first attempt, or a revert re-armed the in-memory data
+                self.ds.begin_pass(
+                    round_to=self.round_to, enable_revert=True, trainer=self.tr
+                )
+            self.tr.prepare_pass(self.ds, n_batches)
+            out = self.tr.train_pass(self.ds, n_batches=n_batches)
+            self._gate(out)
+        except Exception as e:
+            if self.coord is None:
+                raise
+            # hold the local failure until the verdict is published: peers
+            # are (or soon will be) waiting on this rank's vote, and only
+            # a NO that every rank hears aborts the pass everywhere
+            err = e
+        if self.coord is not None:
+            ok, detail = self.coord.exchange_verdict(
+                f"pass:{self._pass_seq}", err is None, repr(err) if err else ""
             )
-        self.tr.prepare_pass(self.ds, n_batches)
-        out = self.tr.train_pass(self.ds, n_batches=n_batches)
-        self._gate(out)
+            if err is not None:
+                raise err
+            if not ok:
+                raise CoordinatedAbort(detail)
+        # confirm ONLY after the global verdict: the guard is still armed
+        # up to here, so every rank that must revert still can
         # classic (host) writeback: a guard is armed, so the carried-table
         # boundary is gated off anyway — hand over the host copy
         self.ds.end_pass(self.tr.trained_table(), shrink=self.shrink)
         return out
 
     def _revert(self, attempt: int, cause: BaseException) -> None:
-        kind = (
-            f"gate_{cause.gate}" if isinstance(cause, PassRejected) else "train_error"
-        )
+        if isinstance(cause, PassRejected):
+            kind = f"gate_{cause.gate}"
+        elif isinstance(cause, CoordinatedAbort):
+            kind = "peer_abort"
+        else:
+            kind = "train_error"
         try:
             self.ds.revert_pass()
         except Exception as e:
@@ -309,7 +410,30 @@ class PassSupervisor:
             raise ValueError("save requires a CheckpointManager")
         self._pass_seq += 1
         self._date = date if date is not None else self._date
-        self._load_with_retry(date, files)
+        if self.coord is None:
+            self._load_with_retry(date, files)
+        else:
+            # coordinate the load the same way as the pass verdict: a rank
+            # whose input never materialized must take every peer down with
+            # it NOW, not leave them hanging in the first exchange
+            load_err: Optional[PassFailure] = None
+            try:
+                self._load_with_retry(date, files)
+            except PassFailure as e:
+                load_err = e
+            ok, detail = self.coord.exchange_verdict(
+                f"load:{self._pass_seq}",
+                load_err is None,
+                repr(load_err) if load_err else "",
+            )
+            if load_err is not None:
+                raise load_err
+            if not ok:
+                # nothing armed yet — no revert, just a clean global stop
+                self._record("peer_abort", "raise", 0, detail)
+                raise PassFailure(
+                    f"pass {self._pass_seq} aborted: peer load failed: {detail}"
+                )
         escalated = False
         attempt = 0
         while True:
@@ -319,6 +443,11 @@ class PassSupervisor:
                 break
             except Exception as e:
                 self._revert(attempt, e)
+                if self.coord is not None:
+                    # revert_pass bumped ds.pass_epoch; adopt it (or bump
+                    # our own for datasets without the counter) and purge
+                    # the aborted attempt's in-flight frames
+                    self.coord.advance(getattr(self.ds, "pass_epoch", None))
                 attempt += 1
                 if attempt > self.retry.retries:
                     if not escalated and self.checkpoint is not None:

@@ -23,6 +23,18 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             and before each delta apply
     step.device             train/trainer.py  before each device-step (or
                             superstep) dispatch
+    transport.connect       parallel/transport.py  before each outbound
+                            connection attempt (first connect AND every
+                            reconnect, so a rule can keep a link down)
+    transport.send          parallel/transport.py  before each wire attempt
+                            of a data frame — an injected failure exercises
+                            the retained-frame reconnect/resend path
+    transport.recv_frame    parallel/transport.py  top of each reader-loop
+                            frame iteration; a failure drops the connection
+                            receiver-side (sender resyncs via heartbeat)
+    transport.heartbeat     parallel/transport.py  before each peer beat —
+                            suppressing beats starves acks and the peer's
+                            failure detector
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -58,6 +70,10 @@ KNOWN_SITES = (
     "checkpoint.save",
     "checkpoint.load",
     "step.device",
+    "transport.connect",
+    "transport.send",
+    "transport.recv_frame",
+    "transport.heartbeat",
 )
 
 

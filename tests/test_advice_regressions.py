@@ -230,8 +230,8 @@ def test_transport_queues_duplicate_tag_frames():
     try:
         t.send(0, "dup", b"first")
         t.send(0, "dup", b"second")
-        assert t._take("dup", 0) == b"first"
-        assert t._take("dup", 0) == b"second"
+        assert t.recv("dup", 0, timeout=5.0) == b"first"
+        assert t.recv("dup", 0, timeout=5.0) == b"second"
     finally:
         t.close()
 
@@ -243,7 +243,8 @@ def test_transport_same_tag_two_rounds_loopback():
     try:
         t.send(0, "ws-req:0", b"roundA")
         t.send(0, "ws-req:0", b"roundB")
-        got = [t._take("ws-req:0", 0), t._take("ws-req:0", 0)]
+        got = [t.recv("ws-req:0", 0, timeout=5.0),
+               t.recv("ws-req:0", 0, timeout=5.0)]
         assert got == [b"roundA", b"roundB"]
     finally:
         t.close()

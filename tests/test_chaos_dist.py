@@ -1,0 +1,575 @@
+"""Distributed chaos: the multi-rank host plane under seeded faults.
+
+The acceptance bar for the distributed-robustness tentpole: a 3-rank
+in-process cluster (threads, real localhost TCP) running a shuffled
+distributed pass — ins_id global shuffle through TcpShuffleRouter, working
+set key exchange through DistributedWorkingSet, deterministic train +
+writeback — must produce row assignment, host tables, and AUC BITWISE
+equal to a fault-free run while seeded ``inject()`` rules flake
+``transport.send`` and ``transport.recv_frame``; a deliberately hung rank
+must produce a barrier timeout naming that rank; and a PassSupervisor
+verdict abort on one rank must revert and retry the pass on EVERY rank.
+Deterministic, CPU-only, tier-1 under the ``chaos`` marker.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+import zlib
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data.dataset import shuffle_route_store
+from paddlebox_tpu.data.record_store import ColumnarRecords
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.data.slot_schema import SlotInfo, SlotSchema
+from paddlebox_tpu.parallel.transport import (
+    PeerDeadError,
+    TcpShuffleRouter,
+    TcpTransport,
+    TransportTimeout,
+    _ACK,
+    _FRAME,
+    _HELLO,
+    _KIND_DATA,
+    _MAGIC,
+    _VERSION,
+)
+from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+from paddlebox_tpu.table.sparse_table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train.supervisor import (
+    CoordinatedAbort,
+    EpochCoordinator,
+    HealthGates,
+    PassSupervisor,
+    RetryPolicy,
+)
+from paddlebox_tpu.utils.faultinject import fail_nth, fail_prob, inject
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+pytestmark = pytest.mark.chaos
+
+N_RANKS = 3
+S = 2  # sparse slots
+
+
+@pytest.fixture(autouse=True)
+def _fast_transport():
+    """Test-speed transport knobs; restored after each test.
+
+    ``transport_send_retries=6`` with a ``times``-capped fault budget below
+    7 makes send-path exhaustion IMPOSSIBLE by construction — every
+    injected schedule must heal, so equality assertions can't flake."""
+    names = (
+        "transport_heartbeat_s",
+        "transport_backoff_s",
+        "transport_send_retries",
+        "transport_peer_dead_s",
+    )
+    prev = {n: config.get_flag(n) for n in names}
+    config.set_flag("transport_heartbeat_s", 0.05)
+    config.set_flag("transport_backoff_s", 0.005)
+    config.set_flag("transport_send_retries", 6)
+    config.set_flag("transport_peer_dead_s", 60.0)
+    yield
+    for n, v in prev.items():
+        config.set_flag(n, v)
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _cluster(n=N_RANKS, timeout=30.0):
+    eps = [f"127.0.0.1:{p}" for p in _free_ports(n)]
+    return [TcpTransport(r, eps, timeout=timeout) for r in range(n)]
+
+
+def _run_ranks(fn, n=N_RANKS):
+    """Run fn(rank) on n threads; re-raise the first worker exception."""
+    results = [None] * n
+    errors = []
+
+    def wrap(r):
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=wrap, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+# ---------------------------------------------------------------------------
+# acceptance: shuffled distributed pass, faulted == clean bitwise
+# ---------------------------------------------------------------------------
+
+_SCHEMA = SlotSchema(
+    [SlotInfo("label", type="float", dense=True, dim=1)]
+    + [SlotInfo(f"s{i}") for i in range(S)],
+    label_slot="label",
+    parse_ins_id=True,
+)
+
+
+def _rank_store(rank: int) -> ColumnarRecords:
+    """Deterministic per-rank records (unequal counts across ranks)."""
+    rng = np.random.default_rng(1000 + rank)
+    recs = []
+    for i in range(24 + 8 * rank):
+        keys, offs = [], [0]
+        for _s in range(S):
+            nk = int(rng.integers(1, 4))
+            keys.extend(int(k) for k in rng.integers(1, 400, nk))
+            offs.append(offs[-1] + nk)
+        recs.append(
+            SlotRecord(
+                u64_values=np.array(keys, np.uint64),
+                u64_offsets=np.array(offs, np.uint32),
+                f_values=np.array([float(rng.integers(0, 2))], np.float32),
+                f_offsets=np.array([0, 1], np.uint32),
+                ins_id=f"ins-{rank}-{i:04d}",
+            )
+        )
+    return ColumnarRecords.from_records(recs, _SCHEMA)
+
+
+def _distributed_pass(transports, epoch=0):
+    """One full shuffled pass over the host plane (no device mesh needed:
+    the classic DistributedWorkingSet finalize is pure numpy). Returns the
+    per-rank observable state the bitwise assertions compare."""
+    routers = [TcpShuffleRouter(t) for t in transports]
+
+    def worker(rank):
+        t = transports[rank]
+        store = _rank_store(rank)
+        dest = shuffle_route_store(store, N_RANKS, "ins_id", seed=0)
+        routers[rank].exchange(
+            rank,
+            [store.select(np.nonzero(dest == d)[0]) for d in range(N_RANKS)],
+        )
+        got = [c for c in routers[rank].collect(rank) if len(c)]
+        mine = ColumnarRecords.concat(got)
+
+        layout = ValueLayout(embedx_dim=2)
+        table = HostSparseTable(
+            layout, SparseOptimizerConfig(embedx_threshold=0.0),
+            n_shards=2, seed=0,
+        )
+        ws = DistributedWorkingSet(t, N_RANKS, pass_id=7, epoch=epoch)
+        ws.add_keys(mine.u64_values)
+        dev = ws.finalize(table, round_to=8)
+        # deterministic order-independent "training" + writeback
+        dev = dev * 1.01 + 0.25
+        ws.writeback(dev)
+
+        # per-record prediction from the GLOBAL row assignment (the thing
+        # a divergent retry would corrupt), label from the record
+        rows = ws.lookup(mine.u64_values)
+        sums = np.add.reduceat(
+            rows.astype(np.int64),
+            mine.u64_base.astype(np.int64)[: len(mine)],
+        ) if len(mine) else np.zeros(0, np.int64)
+        preds = ((sums % 97) / 97.0).astype(np.float32)
+        labels = mine.f_values[: len(mine)].astype(np.float32)
+        ins = [mine.ins_id(i) for i in range(len(mine))]
+        order = np.argsort(np.array(ins))
+        t.barrier(f"pass-done@e{epoch}")
+        keys = np.sort(table.keys())
+        return dict(
+            ins=[ins[i] for i in order],
+            preds=preds[order],
+            labels=labels[order],
+            sorted_keys=ws.sorted_keys,
+            rows=ws.row_of_sorted,
+            capacity=ws.capacity,
+            host_keys=keys,
+            host_vals=table.pull_or_create(keys),
+        )
+
+    return _run_ranks(worker)
+
+
+def _auc(results):
+    """AUC over the globally shuffled pass, via the repo's metric."""
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.metrics.auc import auc_compute, auc_init, auc_update
+
+    preds = np.concatenate([r["preds"] for r in results])
+    labels = np.concatenate([r["labels"] for r in results])
+    state = auc_update(auc_init(1000), jnp.asarray(preds), jnp.asarray(labels))
+    return auc_compute(state)
+
+
+def test_faulted_pass_bitwise_equals_clean():
+    """THE acceptance test: seeded transport.send / transport.recv_frame
+    faults during a 3-rank shuffled pass; every per-rank observable (row
+    assignment, capacity, host tables, ins routing) and the global AUC is
+    bitwise-equal to the fault-free run."""
+    tps = _cluster()
+    try:
+        clean = _distributed_pass(tps, epoch=0)
+    finally:
+        for t in tps:
+            t.close()
+
+    tps = _cluster()
+    try:
+        with inject(
+            fail_prob("transport.send", 0.2, seed=11, times=6),
+            fail_nth("transport.recv_frame", 9, times=2),
+        ) as plan:
+            faulted = _distributed_pass(tps, epoch=0)
+        assert plan.failures("transport.send") + plan.failures(
+            "transport.recv_frame"
+        ) > 0, "schedule injected nothing — the test proved nothing"
+    finally:
+        for t in tps:
+            t.close()
+
+    for r in range(N_RANKS):
+        c, f = clean[r], faulted[r]
+        assert c["ins"] == f["ins"]
+        assert c["capacity"] == f["capacity"]
+        np.testing.assert_array_equal(c["sorted_keys"], f["sorted_keys"])
+        np.testing.assert_array_equal(c["rows"], f["rows"])
+        np.testing.assert_array_equal(c["preds"], f["preds"])
+        np.testing.assert_array_equal(c["host_keys"], f["host_keys"])
+        np.testing.assert_array_equal(c["host_vals"], f["host_vals"])
+    auc_c, auc_f = _auc(clean), _auc(faulted)
+    assert auc_c == auc_f
+    # shuffle actually crossed ranks (the faults had something to hit)
+    assert any(
+        i.split("-")[1] != str(r)
+        for r in range(N_RANKS)
+        for i in clean[r]["ins"]
+    )
+
+
+def test_barrier_timeout_names_hung_rank():
+    """Ranks 0 and 1 reach the barrier; rank 2 never does. The timeout
+    error must name rank 2 (and only rank 2) as the straggler."""
+    tps = _cluster()
+    try:
+        def worker(rank):
+            if rank == 2:
+                return None  # deliberately hung (never enters the barrier)
+            with pytest.raises(TransportTimeout) as ei:
+                tps[rank].barrier("hung", timeout=1.0)
+            return str(ei.value)
+
+        msgs = _run_ranks(worker)
+        for r in (0, 1):
+            assert "rank 2" in msgs[r], msgs[r]
+            assert f"rank {1 - r}" not in msgs[r], msgs[r]
+            assert "barrier:hung" in msgs[r]
+            assert "waiting on" in msgs[r]
+    finally:
+        for t in tps:
+            t.close()
+
+
+# ---------------------------------------------------------------------------
+# failure detector
+# ---------------------------------------------------------------------------
+
+def test_failure_detector_suspect_then_dead():
+    """A peer that stops beating transitions alive -> suspect -> dead, and
+    a collective waiting on it fails fast NAMING the dead rank instead of
+    running out the full timeout."""
+    config.set_flag("transport_peer_dead_s", 0.6)
+    tps = _cluster(2)
+    try:
+        tps[0].send(1, "hello", b"x")
+        assert tps[1].recv("hello", 0, timeout=5.0) == b"x"
+        deadline = time.monotonic() + 5.0
+        while tps[0].peer_status(1) != "alive":
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        tps[1].close()  # rank 1 dies: no more beats toward rank 0
+        seen = set()
+        while time.monotonic() < deadline:
+            st = tps[0].peer_status(1)
+            seen.add(st)
+            if st == "dead":
+                break
+            time.sleep(0.01)
+        assert seen >= {"suspect", "dead"}, seen
+        t0 = time.monotonic()
+        with pytest.raises(PeerDeadError) as ei:
+            tps[0].barrier("dead-peer", timeout=30.0)
+        assert time.monotonic() - t0 < 5.0  # fail-fast, not the 30s budget
+        assert ei.value.dead == [1]
+        assert "rank(s) [1]" in str(ei.value)
+    finally:
+        for t in tps:
+            t.close()
+
+
+# ---------------------------------------------------------------------------
+# epoch coordination
+# ---------------------------------------------------------------------------
+
+def test_epoch_coordinator_abort_and_lockstep_retry():
+    """Rank 1 votes NO at epoch 0: every rank sees the abort with rank 1's
+    detail; after advance() the epoch-1 exchange is clean and a straggler
+    frame from epoch 0 can no longer be delivered."""
+    tps = _cluster()
+    try:
+        coords = [EpochCoordinator(t, timeout=10.0) for t in tps]
+        # a frame the aborted attempt left in flight
+        tps[0].send(2, "ws-req:7@e0", b"stale")
+
+        def round0(rank):
+            return coords[rank].exchange_verdict(
+                "pass:1", ok=(rank != 1), detail="" if rank != 1 else "auc gate"
+            )
+
+        for ok, detail in _run_ranks(round0):
+            assert not ok
+            assert "rank 1" in detail and "auc gate" in detail
+
+        before = STAT_GET("transport.stale_frames_dropped")
+        for c in coords:
+            c.advance()
+            assert c.epoch == 1
+        # the stale epoch-0 frame was purged on rank 2
+        assert STAT_GET("transport.stale_frames_dropped") > before
+        with pytest.raises(TransportTimeout):
+            tps[2].recv("ws-req:7@e0", 0, timeout=0.3)
+
+        def round1(rank):
+            return coords[rank].exchange_verdict("pass:1", ok=True)
+
+        assert all(ok for ok, _ in _run_ranks(round1))
+    finally:
+        for t in tps:
+            t.close()
+
+
+# ---------------------------------------------------------------------------
+# PassSupervisor: coordinated revert/retry across ranks
+# ---------------------------------------------------------------------------
+
+class _FakeDS:
+    """Minimal dataset double for the supervised pass loop (the real
+    revert/rollback machinery is pinned by test_chaos.py; here the surface
+    under test is the cross-rank verdict/epoch protocol)."""
+
+    def __init__(self):
+        self.table = None
+        self._in_pass = False
+        self.pass_epoch = 0
+        self.begun = self.ended = self.reverted = 0
+
+    def set_date(self, date):
+        pass
+
+    def set_filelist(self, files):
+        pass
+
+    def load_into_memory(self):
+        pass
+
+    def begin_pass(self, round_to=512, enable_revert=False, trainer=None):
+        self._in_pass = True
+        self.begun += 1
+
+    def end_pass(self, table, shrink=True):
+        self._in_pass = False
+        self.ended += 1
+
+    def revert_pass(self):
+        self._in_pass = False
+        self.reverted += 1
+        self.pass_epoch += 1
+
+
+def _fake_trainer(aucs):
+    it = iter(aucs)
+
+    return SimpleNamespace(
+        prepare_pass=lambda ds, n: None,
+        train_pass=lambda ds, n_batches=None: {
+            "batches": 4.0,
+            "nan_batches": 0.0,
+            "auc": next(it),
+        },
+        trained_table=lambda: None,
+    )
+
+
+def test_supervisor_peer_abort_reverts_all_ranks():
+    """Rank 1's AUC gate rejects attempt 1; rank 0 (locally healthy) must
+    hear the NO, revert too, and both ranks retry in the next epoch and
+    confirm exactly once."""
+    tps = _cluster(2)
+    try:
+        sups = []
+        for r in range(2):
+            ds = _FakeDS()
+            tr = _fake_trainer([0.1, 0.9] if r == 1 else [0.9, 0.9])
+            sups.append(
+                PassSupervisor(
+                    ds, tr,
+                    gates=HealthGates(auc_absolute_floor=0.5, auc_min_history=99),
+                    retry=RetryPolicy(backoff_s=0.0, sleep=lambda s: None),
+                    transport=tps[r],
+                )
+            )
+
+        outs = _run_ranks(lambda r: sups[r].run_pass(["f"]), n=2)
+        for r, sup in enumerate(sups):
+            assert outs[r]["auc"] == 0.9
+            assert sup.ds.begun == 2 and sup.ds.reverted == 1
+            assert sup.ds.ended == 1  # confirmed exactly once, after retry
+            assert sup.coord.epoch == 1  # lockstep epoch bump
+        kinds = [[i.kind for i in sup.incidents] for sup in sups]
+        assert "peer_abort" in kinds[0], kinds[0]
+        assert "gate_auc" in kinds[1], kinds[1]
+    finally:
+        for t in tps:
+            t.close()
+
+
+def test_supervisor_peer_load_failure_aborts_cleanly():
+    """Rank 1's load dies for good: rank 0 must get a PassFailure naming
+    the peer instead of hanging in the first exchange; nothing was armed,
+    so nothing reverts."""
+    from paddlebox_tpu.train.supervisor import PassFailure
+
+    tps = _cluster(2)
+    try:
+        sups = []
+        for r in range(2):
+            ds = _FakeDS()
+            if r == 1:
+                def _boom():
+                    raise OSError("input never materialized")
+
+                ds.load_into_memory = _boom
+            sups.append(
+                PassSupervisor(
+                    ds, _fake_trainer([0.9]),
+                    retry=RetryPolicy(
+                        max_retries=1, backoff_s=0.0, sleep=lambda s: None
+                    ),
+                    transport=tps[r],
+                )
+            )
+
+        def worker(r):
+            with pytest.raises(PassFailure) as ei:
+                sups[r].run_pass(["f"])
+            return str(ei.value)
+
+        msgs = _run_ranks(worker, n=2)
+        assert "peer load failed" in msgs[0]
+        assert "load failed" in msgs[1]
+        assert sups[0].ds.reverted == 0 and sups[0].ds.ended == 0
+    finally:
+        for t in tps:
+            t.close()
+
+
+# ---------------------------------------------------------------------------
+# wire-level hardening: CRC + protocol version
+# ---------------------------------------------------------------------------
+
+def _raw_connect(port, hello):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    s.sendall(hello)
+    return s
+
+
+def _assert_closed(s):
+    """The peer hung up: EOF or a reset, never data."""
+    s.settimeout(2.0)
+    try:
+        assert s.recv(1) == b""
+    except (ConnectionError, OSError):
+        pass
+    s.close()
+
+
+def test_version_mismatch_rejected():
+    tps = _cluster(2)
+    try:
+        before = STAT_GET("transport.protocol_errors")
+        s = _raw_connect(tps[0].port, _HELLO.pack(_MAGIC, _VERSION + 1, 1))
+        deadline = time.monotonic() + 5.0
+        while STAT_GET("transport.protocol_errors") == before:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # the receiver hung up without ACKing
+        _assert_closed(s)
+    finally:
+        for t in tps:
+            t.close()
+
+
+def test_crc_corruption_drops_frame_and_connection():
+    tps = _cluster(2)
+    try:
+        s = _raw_connect(tps[0].port, _HELLO.pack(_MAGIC, _VERSION, 1))
+        s.settimeout(5.0)
+        assert _ACK.unpack(s.recv(_ACK.size))[0] == 0
+        tag, payload = b"evil", b"corrupted-payload"
+        crc = zlib.crc32(tag + payload) ^ 0xDEADBEEF
+        before = STAT_GET("transport.crc_errors")
+        s.sendall(
+            _FRAME.pack(1, _KIND_DATA, len(tag), len(payload), crc)
+            + tag
+            + payload
+        )
+        deadline = time.monotonic() + 5.0
+        while STAT_GET("transport.crc_errors") == before:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # connection was dropped, and the corrupt frame never delivered
+        _assert_closed(s)
+        with pytest.raises(TransportTimeout):
+            tps[0].recv("evil", 1, timeout=0.3)
+    finally:
+        for t in tps:
+            t.close()
+
+
+def test_send_error_counted_when_retries_exhausted():
+    """A peer that is gone for good surfaces a ConnectionError naming the
+    destination, and the failure is counted — never silently swallowed."""
+    config.set_flag("transport_send_retries", 1)
+    ports = _free_ports(2)
+    eps = [f"127.0.0.1:{p}" for p in ports]
+    t0 = TcpTransport(0, eps, timeout=5.0)
+    try:
+        before = STAT_GET("transport.send_errors")
+        with pytest.raises(ConnectionError) as ei:
+            t0.send(1, "to-nobody", b"x")
+        assert "rank 1" in str(ei.value)
+        assert STAT_GET("transport.send_errors") == before + 1
+    finally:
+        t0.close()

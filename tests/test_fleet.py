@@ -90,6 +90,45 @@ def test_role_maker_env_dialects():
                             "JAX_COORDINATOR_ADDRESS": "x:1"})
 
 
+def test_role_maker_env_validation_names_offending_variable():
+    """A malformed scheduler env must fail AT ROLE RESOLUTION with the
+    variable named — not minutes later inside socket/rendezvous code."""
+    # non-numeric rank, per dialect
+    with pytest.raises(ValueError, match="JAX_PROCESS_ID='two'"):
+        RoleMaker.from_env({"JAX_PROCESS_ID": "two", "JAX_NUM_PROCESSES": "4",
+                            "JAX_COORDINATOR_ADDRESS": "x:1"})
+    with pytest.raises(ValueError, match="PADDLE_TRAINER_ID='abc'"):
+        RoleMaker.from_env({"PADDLE_TRAINER_ID": "abc",
+                            "PADDLE_TRAINERS_NUM": "2",
+                            "POD_IP": "10.0.0.2", "PADDLE_PORT": "6170"})
+    # non-numeric world size
+    with pytest.raises(ValueError, match="JAX_NUM_PROCESSES='many'"):
+        RoleMaker.from_env({"JAX_PROCESS_ID": "0",
+                            "JAX_NUM_PROCESSES": "many",
+                            "JAX_COORDINATOR_ADDRESS": "x:1"})
+    with pytest.raises(ValueError, match="PADDLE_TRAINERS_NUM=' '"):
+        # whitespace-only is set-but-garbage, not unset
+        RoleMaker.from_env({"PADDLE_TRAINER_ID": "0",
+                            "PADDLE_TRAINERS_NUM": " "})
+    # non-positive world
+    with pytest.raises(ValueError, match="JAX_NUM_PROCESSES='0'"):
+        RoleMaker.from_env({"JAX_PROCESS_ID": "0", "JAX_NUM_PROCESSES": "0"})
+    # rank >= world names BOTH sources
+    with pytest.raises(
+        ValueError, match="PADDLE_TRAINER_ID='3'.*world 2"
+    ):
+        RoleMaker.from_env({"PADDLE_TRAINER_ID": "3",
+                            "PADDLE_TRAINERS_NUM": "2",
+                            "POD_IP": "h", "PADDLE_PORT": "1"})
+    # missing coordinator names the world-size source that demanded one
+    with pytest.raises(ValueError, match="JAX_NUM_PROCESSES='2'"):
+        RoleMaker.from_env({"JAX_PROCESS_ID": "0", "JAX_NUM_PROCESSES": "2"})
+    # POD_IP without PADDLE_PORT is still a missing coordinator
+    with pytest.raises(ValueError, match="coordinator"):
+        RoleMaker.from_env({"PADDLE_TRAINER_ID": "0",
+                            "PADDLE_TRAINERS_NUM": "2", "POD_IP": "10.0.0.2"})
+
+
 # ---- zero-1 -------------------------------------------------------------
 
 def test_zero1_chunking_roundtrip():

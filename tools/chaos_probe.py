@@ -11,6 +11,17 @@ Usage:
   JAX_PLATFORMS=cpu python tools/chaos_probe.py \
       [--days N] [--passes N] [--rows N] [--seed N] \
       [--fs-flake-prob P] [--step-faults N] [--save-faults N] [--json]
+
+``--distributed N`` switches to the multi-rank soak instead: an N-rank
+in-process cluster (threads, real localhost TCP) runs ``--passes``
+shuffled distributed passes — ins_id shuffle through TcpShuffleRouter,
+working-set key exchange through DistributedWorkingSet, deterministic
+train + writeback — under seeded ``transport.send`` /
+``transport.recv_frame`` faults, and the run must be bitwise-equal
+(row assignment, host tables, predictions) to a fault-free twin:
+
+  JAX_PLATFORMS=cpu python tools/chaos_probe.py --distributed 3 \
+      [--passes N] [--rows N] [--seed N] [--send-flake-prob P] [--json]
 """
 
 from __future__ import annotations
@@ -124,6 +135,196 @@ def run_schedule(tmpdir, tag, days, rules):
     return table, tr, sup, plan, wall
 
 
+def _dist_free_ports(n):
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _dist_rank_records(rank, rows, seed, schema, pass_idx):
+    from paddlebox_tpu.data.record_store import ColumnarRecords
+    from paddlebox_tpu.data.slot_record import SlotRecord
+
+    rng = np.random.default_rng(seed * 1009 + rank * 31 + pass_idx)
+    recs = []
+    for i in range(rows + 4 * rank):  # unequal loads across ranks
+        keys, offs = [], [0]
+        for _s in range(S):
+            nk = int(rng.integers(1, 4))
+            keys.extend(int(k) for k in rng.integers(1, 800, nk))
+            offs.append(offs[-1] + nk)
+        recs.append(
+            SlotRecord(
+                u64_values=np.array(keys, np.uint64),
+                u64_offsets=np.array(offs, np.uint32),
+                f_values=np.array([float(rng.integers(0, 2))], np.float32),
+                f_offsets=np.array([0, 1], np.uint32),
+                ins_id=f"p{pass_idx}-r{rank}-{i:05d}",
+            )
+        )
+    return ColumnarRecords.from_records(recs, schema)
+
+
+def _dist_soak_once(n_ranks, passes, rows, seed, rules):
+    """One N-rank in-process soak under the given fault rules. Returns the
+    per-rank observable digest the equality check compares."""
+    import threading
+
+    from paddlebox_tpu.data import SlotInfo, SlotSchema
+    from paddlebox_tpu.data.dataset import shuffle_route_store
+    from paddlebox_tpu.data.record_store import ColumnarRecords
+    from paddlebox_tpu.parallel.transport import TcpShuffleRouter, TcpTransport
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+    from paddlebox_tpu.utils.faultinject import inject
+
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(S)],
+        label_slot="label",
+        parse_ins_id=True,
+    )
+    eps = [f"127.0.0.1:{p}" for p in _dist_free_ports(n_ranks)]
+    tps = [TcpTransport(r, eps, timeout=60.0) for r in range(n_ranks)]
+    routers = [TcpShuffleRouter(t) for t in tps]
+    layout = ValueLayout(embedx_dim=4)
+    tables = [
+        HostSparseTable(
+            layout, SparseOptimizerConfig(embedx_threshold=0.0),
+            n_shards=2, seed=0,
+        )
+        for _ in range(n_ranks)
+    ]
+    results = [None] * n_ranks
+    errors = []
+
+    def worker(rank):
+        t = tps[rank]
+        digest = []
+        for p in range(passes):
+            store = _dist_rank_records(rank, rows, seed, schema, p)
+            dest = shuffle_route_store(store, n_ranks, "ins_id", seed=seed)
+            routers[rank].exchange(
+                rank,
+                [store.select(np.nonzero(dest == d)[0])
+                 for d in range(n_ranks)],
+            )
+            got = [c for c in routers[rank].collect(rank) if len(c)]
+            mine = ColumnarRecords.concat(got)
+            ws = DistributedWorkingSet(t, n_ranks, pass_id=p)
+            ws.add_keys(mine.u64_values)
+            dev = ws.finalize(tables[rank], round_to=8)
+            dev = dev * 1.01 + 0.25  # deterministic "training"
+            ws.writeback(dev)
+            rows_of = ws.lookup(mine.u64_values)
+            digest.append(
+                dict(
+                    n_records=len(mine),
+                    capacity=ws.capacity,
+                    rows=rows_of,
+                    sorted_keys=ws.sorted_keys,
+                )
+            )
+            t.barrier(f"probe-pass-{p}")
+        keys = np.sort(tables[rank].keys())
+        return dict(
+            digest=digest,
+            host_keys=keys,
+            host_vals=tables[rank].pull_or_create(keys),
+        )
+
+    def wrap(r):
+        try:
+            results[r] = worker(r)
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            errors.append((r, e))
+
+    t0 = time.perf_counter()
+    try:
+        with inject(*rules) as plan:
+            threads = [
+                threading.Thread(target=wrap, args=(r,))
+                for r in range(n_ranks)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(300)
+    finally:
+        for t in tps:
+            t.close()
+    if errors:
+        raise errors[0][1]
+    return results, plan, time.perf_counter() - t0
+
+
+def run_distributed(args):
+    from paddlebox_tpu import config
+    from paddlebox_tpu.utils.faultinject import fail_nth, fail_prob
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    config.set_flag("transport_heartbeat_s", 0.05)
+    config.set_flag("transport_backoff_s", 0.005)
+    # fault budget (times=) below the per-send retry budget: exhaustion is
+    # impossible by construction, every injected schedule must heal
+    config.set_flag("transport_send_retries", 6)
+    n = args.distributed
+    clean, _, wall_c = _dist_soak_once(n, args.passes, args.rows, args.seed, ())
+    rules = [
+        fail_prob("transport.send", args.send_flake_prob,
+                  seed=args.seed + 1, times=6),
+        fail_nth("transport.recv_frame", 7 + args.seed % 5, times=1),
+        fail_nth("transport.recv_frame", 23 + args.seed % 7, times=1),
+    ]
+    faulted, plan, wall_i = _dist_soak_once(
+        n, args.passes, args.rows, args.seed, rules
+    )
+
+    equal = True
+    for r in range(n):
+        c, f = clean[r], faulted[r]
+        equal &= np.array_equal(c["host_keys"], f["host_keys"])
+        equal &= np.array_equal(c["host_vals"], f["host_vals"])
+        for dc, df in zip(c["digest"], f["digest"]):
+            equal &= dc["n_records"] == df["n_records"]
+            equal &= dc["capacity"] == df["capacity"]
+            equal &= np.array_equal(dc["rows"], df["rows"])
+            equal &= np.array_equal(dc["sorted_keys"], df["sorted_keys"])
+    report = {
+        "mode": "distributed",
+        "ranks": n,
+        "passes": args.passes,
+        "faults_injected": {
+            site: plan.failures(site)
+            for site in ("transport.send", "transport.recv_frame")
+        },
+        "transport_stats": {
+            k: STAT_GET(k)
+            for k in (
+                "transport.send_retries",
+                "transport.frames_resent",
+                "transport.reconnects",
+                "transport.dup_frames_dropped",
+            )
+        },
+        "bitwise_equal_to_clean": bool(equal),
+        "wall_clean_s": round(wall_c, 2),
+        "wall_injected_s": round(wall_i, 2),
+    }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if equal else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--days", type=int, default=2)
@@ -136,8 +337,18 @@ def main(argv=None):
                     help="poisoned device steps across the schedule")
     ap.add_argument("--save-faults", type=int, default=2,
                     help="torn checkpoint-save windows across the schedule")
+    ap.add_argument("--distributed", type=int, default=0, metavar="N",
+                    help="soak an N-rank in-process cluster under seeded "
+                         "transport faults instead of the single-rank "
+                         "supervisor schedule")
+    ap.add_argument("--send-flake-prob", type=float, default=0.15,
+                    help="iid flake probability at transport.send "
+                         "(--distributed mode)")
     ap.add_argument("--json", action="store_true", help="machine output only")
     args = ap.parse_args(argv)
+
+    if args.distributed:
+        return run_distributed(args)
 
     from paddlebox_tpu import config
     from paddlebox_tpu.utils.faultinject import fail_nth, fail_prob
