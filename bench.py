@@ -326,6 +326,7 @@ def main():
         ValueLayout,
     )
     from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+    from paddlebox_tpu.utils.monitor import STAT_GET
 
     pv = pv_mode_enabled()
     rng = np.random.default_rng(0)
@@ -395,6 +396,25 @@ def main():
         _config.set_flag(
             "wire_dtype", os.environ.get("PBOX_WIRE_DTYPE", "bf16")
         )
+        # PBOX_BOUNDARY_PIPELINE=0 benches the sequential boundary (the
+        # r05-and-earlier shape: sync end_pass, then load, then finalize)
+        # so captures can ablate the pipelined handoff against it
+        _config.set_flag(
+            "boundary_pipeline",
+            int(os.environ.get("PBOX_BOUNDARY_PIPELINE", "1")),
+        )
+        pipelined = bool(_config.get_flag("boundary_pipeline"))
+
+        # next pass's input, written up front: the pipelined boundary kicks
+        # its load into the background BEFORE the timed region so read/
+        # premerge/prefetch overlap warmup + training (the overlap the
+        # supervisor's prefetch kick provides in the day loop)
+        files2, _ = write_files(
+            tmpdir, rng, reuse_pool=key_pool, prefix="p2", pv=pv
+        )
+        if pipelined:
+            ds.set_filelist(files2)
+            ds.preload_into_memory()
 
         if pv:
             # join phase: pv feeds don't wrap, so warm with one full epoch
@@ -428,20 +448,30 @@ def main():
             train_s = time.perf_counter() - t0
             timed_samples = TRAIN_BATCHES * BATCH
 
-        # pass boundary, measured as the reference experiences it: EndPass
-        # (writeback) + the NEXT pass's finalize. The device-carried
-        # boundary (table/carrier.py) keeps surviving rows in HBM — with
-        # CTR-realistic key recurrence (75% cold-key reuse) both sides
-        # shrink to the key-set delta.
-        files2, _ = write_files(
-            tmpdir, rng, reuse_pool=key_pool, prefix="p2", pv=pv
-        )
+        # pass boundary, measured as the HANDOFF BLOCKING TIME: how long
+        # end_pass + the next begin_pass actually stall the trainer. The
+        # pipelined boundary dispatches EndPass to a worker and adopts the
+        # background-staged load (premerge + host prefetch already done),
+        # so the stall shrinks to the dispatch + the splice/assemble that
+        # genuinely must run on the handoff. The sequential ablation
+        # (PBOX_BOUNDARY_PIPELINE=0) measures the r05 shape: sync end_pass
+        # + sync load + full finalize.
         pass1_keys = int(ds.stats.keys)
+        preload_join_s = 0.0
         t0 = time.perf_counter()
-        ds.end_pass(trainer.trained_table_device())
-        writeback_s = time.perf_counter() - t0
-        ds.set_filelist(files2)
-        ds.load_into_memory()
+        if pipelined:
+            ds.end_pass_async(trainer.trained_table_device())
+            writeback_s = time.perf_counter() - t0  # dispatch only
+            t0 = time.perf_counter()
+            # load time not in boundary_s (r05 didn't count it either);
+            # reported separately — near zero when the overlap worked
+            ds.wait_preload_done()
+            preload_join_s = time.perf_counter() - t0
+        else:
+            ds.end_pass(trainer.trained_table_device())
+            writeback_s = time.perf_counter() - t0
+            ds.set_filelist(files2)
+            ds.load_into_memory()
         t0 = time.perf_counter()
         ds.begin_pass(round_to=512)
         finalize2_s = time.perf_counter() - t0
@@ -505,6 +535,17 @@ def main():
         "writeback_s": round(writeback_s, 3),
         "finalize2_s": round(finalize2_s, 3),
         "boundary_s": round(writeback_s + finalize2_s, 3),
+        "preload_join_s": round(preload_join_s, 3),
+        "boundary_pipeline": int(pipelined),
+        # per-stage boundary attribution (utils/monitor gauges set by the
+        # feed stage, finalize, and the end_pass worker)
+        "boundary_stages": {
+            k: round(float(STAT_GET(f"boundary.{k}")), 4)
+            for k in (
+                "premerge_s", "prefetch_pull_s", "dedup_s", "pull_s",
+                "splice_s", "writeback_s", "overlap_hidden_s",
+            )
+        },
         "warmup_s": round(warmup_s, 3),
         # pass-prepare pad sweep (native pbx_block_stats counter sweep):
         # must stay a small fraction of train_pass_s at any pass size

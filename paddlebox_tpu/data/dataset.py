@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Sequence
@@ -40,8 +41,11 @@ from paddlebox_tpu.data.record_store import ColumnarRecords
 from paddlebox_tpu.data.slot_record import SlotBatch, SlotRecord, build_batch
 from paddlebox_tpu.data.slot_schema import SlotSchema
 from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
+from paddlebox_tpu.utils.faultinject import fire
 from paddlebox_tpu.utils.fs import fs_glob
 from paddlebox_tpu.utils.line_reader import BufferedLineFileReader
+from paddlebox_tpu.utils.monitor import STAT_SET
+from paddlebox_tpu.utils.trace import record_event
 
 config.define_flag(
     "padbox_dataset_shuffle_thread_num", 8, "default dataset reader thread count"
@@ -61,6 +65,23 @@ config.define_flag(
     "on a background thread (full-table D2H overlapping the next pass). "
     "Frees the extra HBM the lazy default pins for a whole pass — use "
     "when HBM, not transport bandwidth, is the constraint",
+)
+config.define_flag(
+    "boundary_pipeline",
+    1,
+    "pipelined pass boundary: the load thread premerges the staged pass's "
+    "key chunks (and, with boundary_prefetch_pull, prefetches host rows) "
+    "while the current pass trains, so begin_pass finds the dedup/pull "
+    "already done; 0 = classic serial boundary",
+)
+config.define_flag(
+    "boundary_prefetch_pull",
+    1,
+    "with boundary_pipeline: the feed stage pull_or_creates host rows for "
+    "staged keys NOT in the live pass (those rows cannot change before the "
+    "boundary except by decay, which the consumer compensates bitwise). "
+    "Auto-disabled when shrink_threshold != 0 or a mem_cap spill tier is "
+    "active — either could invalidate prefetched rows",
 )
 
 
@@ -226,6 +247,16 @@ class BoxPSDataset:
         self._end_pass_fut = None  # pending end_pass_async worker
         self._in_pass = False
         self._staged = None  # (records, ws, stats) loaded but not begun
+        # staged boundary prefetch {src, keys, rows, epoch} built by the
+        # feed stage alongside _staged; consumed (or dropped) by begin_pass.
+        # Same synchronization discipline as _staged: written only by the
+        # load path, read after wait_preload_done joins it.
+        self._boundary_prefetch = None
+        # stage time hidden behind training (reported via overlap_hidden_s);
+        # accumulated on the load/preload thread, settled on the trainer
+        # thread at wait_end_pass
+        self._stage_lock = threading.Lock()
+        self._stage_hidden_s = 0.0  # guarded-by: _stage_lock
         self._loading_stats = self.stats
 
     # ---- record access ---------------------------------------------------
@@ -490,11 +521,96 @@ class BoxPSDataset:
                 )
             stats.records = len(records)
         self._staged = (store, order, records, ws, stats)
+        try:
+            self._stage_boundary_prefetch(ws)
+        except BaseException:
+            # a failed feed stage must not wedge the retry loop: the next
+            # load_into_memory would refuse over the leftover staged slot
+            self.discard_staged()
+            raise
         if not self._in_pass:
             # no pass training right now: publish immediately so
             # memory_data_size()/stats match reference post-load semantics
             # (begin_pass still consumes the staged tuple)
             self._publish(self._staged)
+
+    def _stage_boundary_prefetch(self, ws) -> None:
+        """Stage 2 of the boundary feed pipeline: premerge the staged
+        pass's key chunks and (gated) prefetch its host rows, all on the
+        load/preload thread while the current pass trains.
+
+        The premerge collapses ``ws._key_chunks`` so the later finalize
+        re-merges a singleton list through merge_unique_keys' no-copy fast
+        path; the prefetch pulls rows only for keys NOT in the live pass —
+        the live pass's keys are the only host rows the boundary's
+        writeback/splice can change, so everything prefetched stays valid
+        modulo show/clk decay, which the consumer re-applies bitwise
+        (:func:`sparse_table._rows_with_prefetch`)."""
+        if not config.get_flag("boundary_pipeline"):
+            return
+        self._boundary_prefetch = None
+        fire("boundary.premerge")
+        t0 = time.perf_counter()
+        with record_event("boundary.premerge", "boundary"):
+            merged = ws.premerge(
+                int(config.get_flag("boundary_merge_threads"))
+            )
+        premerge_s = time.perf_counter() - t0
+        STAT_SET("boundary.premerge_s", premerge_s)
+        if self._in_pass:
+            with self._stage_lock:
+                self._stage_hidden_s += premerge_s
+
+        live = self.ws
+        table = self.table
+        if (
+            not config.get_flag("boundary_prefetch_pull")
+            or not self._in_pass
+            or not len(merged)
+            or not isinstance(ws, PassWorkingSet)
+            or not isinstance(live, PassWorkingSet)
+            or not live._finalized
+            or table.opt.shrink_threshold != 0
+            or table.mem_cap_rows is not None
+        ):
+            return
+        # exclude the live pass's keys: their host rows are not final
+        # until its writeback/splice lands at the boundary
+        exclude = live.sorted_keys
+        if len(exclude):
+            pos = np.minimum(
+                np.searchsorted(exclude, merged), len(exclude) - 1
+            )
+            need = merged[exclude[pos] != merged]
+        else:
+            need = merged
+        if not len(need):
+            return
+        # a departing-slice push from the PREVIOUS boundary may still be
+        # in flight and can cover keys in `need` (departed two passes ago,
+        # returning now): wait for it to land, WITHOUT consuming a failure
+        # — that stays armed for the end_pass worker's join_push
+        carrier = getattr(self, "_carrier", None)
+        if carrier is not None and not carrier.flushed:
+            carrier.wait_push()
+        fire("boundary.stage_pull")
+        t0 = time.perf_counter()
+        with record_event("boundary.stage_pull", "boundary"):
+            rows, epoch = table.prefetch_rows(need)
+        pull_s = time.perf_counter() - t0
+        STAT_SET("boundary.prefetch_pull_s", pull_s)
+        with self._stage_lock:
+            self._stage_hidden_s += pull_s
+        self._boundary_prefetch = {
+            "src": merged, "keys": need, "rows": rows, "epoch": epoch,
+        }
+
+    def discard_staged(self) -> None:
+        """Drop a staged-but-unconsumed load and its boundary prefetch
+        (supervisor cancel path: a staged pass N+1 must not survive a
+        coordinated revert of pass N)."""
+        self._staged = None
+        self._boundary_prefetch = None
 
     def _new_working_set(self):
         """Fresh (un-finalized) working set for this pass: multi-host
@@ -729,6 +845,7 @@ class BoxPSDataset:
         if self._staged is not None:
             self._publish(self._staged)
             self._staged = None
+        prefetch, self._boundary_prefetch = self._boundary_prefetch, None
         if self.ws is None:
             raise RuntimeError("load_into_memory first")
         if enable_revert:
@@ -745,7 +862,8 @@ class BoxPSDataset:
                 # DistributedWorkingSet takes a MultiHostCarrier (per-host
                 # shard-block splice) — same kwarg, same delta boundary
                 self.device_table = self.ws.finalize(
-                    self.table, round_to=round_to, carrier=carrier
+                    self.table, round_to=round_to, carrier=carrier,
+                    prefetch=prefetch,
                 )
                 if config.get_flag("carried_eager_flush"):
                     self._eager_thread = threading.Thread(
@@ -754,13 +872,11 @@ class BoxPSDataset:
                     self._eager_thread.start()
             else:
                 self.device_table = self.ws.finalize(
-                    self.table, round_to=round_to
+                    self.table, round_to=round_to, prefetch=prefetch
                 )
         self.stats.keys = self.ws.n_keys
         # monitor parity: the reference bumps STAT_total_feasign_num_in_mem
         # as passes stage into memory (box_wrapper.cc:1282)
-        from paddlebox_tpu.utils.monitor import STAT_SET
-
         STAT_SET("total_feasign_num_in_mem", self.stats.keys)
         STAT_SET("total_records_in_mem", self.memory_data_size())
         self._in_pass = True
@@ -790,6 +906,16 @@ class BoxPSDataset:
             )
         guard.revert()
         self._guard = None
+        # cancel any staged next pass: join the feed stage first (it may
+        # still be writing the staged slot), then drop it — a revert means
+        # the retried pass re-derives everything downstream of it, and the
+        # supervisor re-loads (or re-stages) pass N+1 afterwards
+        if self._preload_thread is not None:
+            try:
+                self.wait_preload_done()
+            except Exception:
+                pass  # a failed staged load is discarded with the stage
+        self.discard_staged()
         # new epoch for the retrain: the aborted attempt's in-flight
         # exchange frames (if any) must never reach the retried exchange
         self.pass_epoch += 1
@@ -938,12 +1064,16 @@ class BoxPSDataset:
         self._prev_boundary_carrier = carrier
 
         def run():
+            t_run = time.perf_counter()
+            wb_s = 0.0
             try:
+                fire("boundary.writeback")
                 if prev_carrier is not None:
                     # the previous boundary's departing-slice push must land
                     # before this boundary's decay (a late push would
                     # overwrite decayed rows with un-decayed values)
                     prev_carrier.join_push()
+                t_wb = time.perf_counter()
                 if trained_table is not None and carrier is None:
                     arr = trained_table
                     if (
@@ -978,6 +1108,8 @@ class BoxPSDataset:
                         # pass's rows; its departures just joined) — a later
                         # splice or drain of it would resurrect stale values
                         prev_carrier.supersede()
+                    wb_s = time.perf_counter() - t_wb
+                STAT_SET("boundary.writeback_s", wb_s)
                 dropped = table.decay_and_shrink() if shrink else 0
                 saved = table.save_delta(delta_dir) if need_save_delta else 0
                 # enforce the host-RAM cap: evict cold rows to the disk tier
@@ -989,7 +1121,11 @@ class BoxPSDataset:
                     guard.confirm()
                 if self._guard is guard:
                     self._guard = None
-                return {"dropped": dropped, "delta_keys": saved}
+                return {
+                    "dropped": dropped,
+                    "delta_keys": saved,
+                    "secs": time.perf_counter() - t_run,
+                }
             except BaseException:
                 # re-open the pass so the failure is recoverable
                 self.store, self._order, self._records = saved_state
@@ -1003,7 +1139,8 @@ class BoxPSDataset:
 
         def worker():
             try:
-                fut.set_result(run())
+                with record_event("boundary.end_pass_worker", "boundary"):
+                    fut.set_result(run())
             except BaseException as e:
                 fut.set_exception(e)
 
@@ -1014,9 +1151,14 @@ class BoxPSDataset:
 
     def wait_end_pass(self) -> dict:
         """Join a pending end_pass_async; returns its result dict (or the
-        last one again if already joined; {} if none ever ran)."""
+        last one again if already joined; {} if none ever ran).
+
+        Also settles the boundary overlap accounting: worker seconds not
+        spent blocking here ran behind training, and so did the feed
+        stage's premerge/prefetch — their sum is ``boundary.overlap_hidden_s``."""
         fut = self._end_pass_fut
         if fut is not None:
+            t0 = time.perf_counter()
             try:
                 self._end_pass_result = fut.result()
             except BaseException:
@@ -1025,6 +1167,13 @@ class BoxPSDataset:
                 raise
             finally:
                 self._end_pass_fut = None
+            blocked = time.perf_counter() - t0
+            hidden = max(
+                0.0, self._end_pass_result.get("secs", 0.0) - blocked
+            )
+            with self._stage_lock:
+                stage_hidden, self._stage_hidden_s = self._stage_hidden_s, 0.0
+            STAT_SET("boundary.overlap_hidden_s", hidden + stage_hidden)
         # surface an already-stored eager-flush failure HERE too: a run's
         # final pass has no next begin_pass to raise it, and exiting 0
         # with carried values still owed would hide the durability gap

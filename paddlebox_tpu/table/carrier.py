@@ -29,6 +29,7 @@ Semantic deltas vs the classic boundary, both bounded and documented:
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -62,7 +63,12 @@ class TableCarrier:
         # would have.
         self._decay_accum = 1.0 if decay is None else float(decay)
         self._flushed = False
-        self._push_fut = None  # in-flight background departure push
+        # in-flight background departure push. The lock covers the handle
+        # only (install/claim/peek); waiting on the future itself happens
+        # outside it, so wait_push (boundary prefetch thread) and
+        # join_push (end_pass worker) can block concurrently.
+        self._push_lock = threading.Lock()
+        self._push_fut = None  # guarded-by: _push_lock
         # ws-order positions already handed back to the host (departures):
         # flush must not re-push them — once a key departs, the host row is
         # live again (later passes may train it) and a re-push of this
@@ -127,7 +133,6 @@ class TableCarrier:
         later); only the host fetch + push run on the worker. Joined by
         flush(), and by the next end_pass before host decay (a late push
         landing after a decay would un-decay those rows)."""
-        import threading
         from concurrent.futures import Future
 
         from paddlebox_tpu import config
@@ -154,7 +159,8 @@ class TableCarrier:
                 fut.set_exception(e)
 
         threading.Thread(target=work, daemon=False).start()
-        self._push_fut = (fut, pos)
+        with self._push_lock:
+            self._push_fut = (fut, pos)
 
     def join_push(self) -> None:
         """Wait for an in-flight departure push (idempotent).
@@ -164,7 +170,8 @@ class TableCarrier:
         re-pushes them (drain_pending keeps this carrier registered on
         failure). Without this, the departed-exclusion in flush would
         silently drop exactly the rows whose push failed."""
-        fut_pos, self._push_fut = self._push_fut, None
+        with self._push_lock:
+            fut_pos, self._push_fut = self._push_fut, None
         if fut_pos is not None:
             fut, pos = fut_pos
             try:
@@ -176,6 +183,24 @@ class TableCarrier:
                     else None
                 )
                 raise
+
+    def wait_push(self) -> None:
+        """Block until any in-flight departure push lands, WITHOUT
+        consuming the handle or its failure.
+
+        The boundary prefetch must not read a departing key's pre-push
+        host row, so it waits here first — but error handling (un-depart +
+        raise) belongs to join_push on the end_pass path, so a failure is
+        swallowed and stays armed. (A failed push fails the boundary
+        there, and the supervisor's revert discards the staged prefetch.)
+        """
+        with self._push_lock:
+            fut_pos = self._push_fut
+        if fut_pos is not None:
+            try:
+                fut_pos[0].result()
+            except BaseException:
+                pass
 
     def flush(self, table) -> int:
         """Push every carried key's (decayed) value to the host store.
@@ -296,6 +321,10 @@ class MultiHostCarrier:
                 err = err or e
         if err is not None:
             raise err
+
+    def wait_push(self) -> None:
+        for c in self.parts:
+            c.wait_push()
 
     def flush(self, table) -> int:
         n = 0

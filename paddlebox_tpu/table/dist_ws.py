@@ -28,7 +28,12 @@ from typing import List, Optional
 
 import numpy as np
 
-from paddlebox_tpu.table.sparse_table import HostSparseTable, key_to_shard
+from paddlebox_tpu import config
+from paddlebox_tpu.table.sparse_table import (
+    HostSparseTable,
+    key_to_shard,
+    merge_unique_keys,
+)
 
 
 class DistributedWorkingSet:
@@ -69,11 +74,23 @@ class DistributedWorkingSet:
             with self._lock:
                 self._key_chunks.append(np.unique(keys.astype(np.uint64)))
 
+    def premerge(self, threads: int = 1) -> np.ndarray:
+        """Collapse accumulated key chunks now (boundary feed stage); the
+        later finalize re-merges the singleton list via the no-copy fast
+        path (see PassWorkingSet.premerge)."""
+        if self._finalized:
+            raise RuntimeError("working set already finalized")
+        with self._lock:
+            merged = merge_unique_keys(self._key_chunks, threads)
+            self._key_chunks = [merged] if len(merged) else []
+        return merged
+
     def _owner_host(self, keys: np.ndarray) -> np.ndarray:
         return key_to_shard(keys, self.n_mesh_shards) // self.shards_per_host
 
     def finalize(
-        self, table: HostSparseTable, round_to: int = 512, carrier=None
+        self, table: HostSparseTable, round_to: int = 512, carrier=None,
+        prefetch=None,
     ) -> np.ndarray:
         """Two-round exchange; returns THIS host's device slice
         ``[shards_per_host, capacity, width]`` (global row of key =
@@ -86,13 +103,18 @@ class DistributedWorkingSet:
         upload — then the per-device blocks reassemble into the global
         mesh array without any cross-host traffic (every node keeps its
         HBM cache warm, EndPass parity box_wrapper.cc:627-651). Returns a
-        global jax.Array in that case."""
+        global jax.Array in that case.
+
+        ``prefetch`` is accepted for interface parity with
+        PassWorkingSet.finalize and ignored: the dataset's boundary feed
+        stage never stages a host prefetch for a distributed pass (owned
+        keys are only known after the exchange)."""
         t = self.transport
         with self._lock:
-            if self._key_chunks:
-                referenced = np.unique(np.concatenate(self._key_chunks))
-            else:
-                referenced = np.zeros(0, dtype=np.uint64)
+            referenced = merge_unique_keys(
+                self._key_chunks,
+                int(config.get_flag("boundary_merge_threads")),
+            )
             self._key_chunks = []
         self.n_keys = len(referenced)
 
@@ -119,13 +141,11 @@ class DistributedWorkingSet:
 
         order = np.argsort(shard_of, kind="stable")  # keys sorted => rank order
         rank_in_shard = np.empty(len(owned), dtype=np.int64)
-        start = 0
-        self.owned_shard_keys = []
-        for s in range(self.shards_per_host):
-            c = int(counts[s])
-            rank_in_shard[order[start : start + c]] = np.arange(c)
-            self.owned_shard_keys.append(owned[order[start : start + c]])
-            start += c
+        starts = np.repeat(np.cumsum(counts) - counts, counts)
+        rank_in_shard[order] = np.arange(len(owned), dtype=np.int64) - starts
+        self.owned_shard_keys = np.split(
+            owned[order], np.cumsum(counts)[:-1]
+        )
         owned_rows = (
             (key_to_shard(owned, self.n_mesh_shards)) * cap + rank_in_shard
         )

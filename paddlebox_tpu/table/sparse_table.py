@@ -35,6 +35,8 @@ import functools
 import json
 import os
 import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -44,8 +46,73 @@ from paddlebox_tpu import config
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
 from paddlebox_tpu.utils.fs import atomic_write
+from paddlebox_tpu.utils.monitor import STAT_SET
+from paddlebox_tpu.utils.trace import record_event
+
+config.define_flag(
+    "boundary_merge_threads", 4,
+    "threads for the chunked pass-boundary key merge; <=1 falls back to "
+    "the serial np.unique(np.concatenate(...))",
+)
 
 _HASH_MULT = np.uint64(0x9E3779B97F4A7C15)
+
+# below this many total keys the serial merge wins (thread dispatch costs
+# more than the merge itself)
+_MERGE_SERIAL_FLOOR = 262_144
+
+
+def merge_unique_keys(
+    chunks: Sequence[np.ndarray], threads: int = 1
+) -> np.ndarray:
+    """Sorted-unique union of sorted-unique uint64 chunks.
+
+    Bitwise-identical to ``np.unique(np.concatenate(chunks))`` (the tests
+    assert this), but large merges run over deterministic key ranges in a
+    thread pool: pivots are quantiles of a sorted strided sample of the
+    chunks, every chunk is sliced at those pivots with searchsorted, each
+    range unions its slices independently, and the per-range results
+    concatenate back in ascending range order.
+
+    A single non-empty chunk is returned AS-IS (no copy): the boundary
+    prefetch's validity check is an O(1) identity test against the array a
+    premerge() stored, and this fast path is what preserves that identity
+    through finalize's re-merge of the singleton chunk list.
+    """
+    chunks = [c for c in chunks if len(c)]
+    if not chunks:
+        return np.zeros(0, dtype=np.uint64)
+    if len(chunks) == 1:
+        return chunks[0]
+    total = sum(len(c) for c in chunks)
+    threads = int(threads)
+    if threads <= 1 or total < _MERGE_SERIAL_FLOOR:
+        return np.unique(np.concatenate(chunks))
+    n_ranges = min(threads, 16)
+    sample = np.sort(
+        np.concatenate([c[:: max(1, len(c) // 64)] for c in chunks])
+    )
+    pivots = sample[(np.arange(1, n_ranges) * len(sample)) // n_ranges]
+    bounds = [np.searchsorted(c, pivots, side="left") for c in chunks]
+
+    def _one_range(r: int) -> np.ndarray:
+        parts = []
+        for ci, c in enumerate(chunks):
+            lo = int(bounds[ci][r - 1]) if r else 0
+            hi = int(bounds[ci][r]) if r < n_ranges - 1 else len(c)
+            if hi > lo:
+                parts.append(c[lo:hi])
+        if not parts:
+            return np.zeros(0, dtype=np.uint64)
+        return np.unique(np.concatenate(parts))
+
+    with ThreadPoolExecutor(
+        max_workers=n_ranges, thread_name_prefix="key-merge"
+    ) as ex:
+        ranges = [r for r in ex.map(_one_range, range(n_ranges)) if len(r)]
+    if not ranges:
+        return np.zeros(0, dtype=np.uint64)
+    return np.concatenate(ranges)
 
 
 @functools.lru_cache(maxsize=8)
@@ -317,6 +384,20 @@ class HostSparseTable:
             with self._size_lock:
                 self._size += created
         return out
+
+    def prefetch_rows(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Pull/create rows for a STAGED next pass; returns (rows, epoch).
+
+        Held under the maintenance lock so the row snapshot and the decay
+        epoch stamp agree — no concurrent ``decay_and_shrink`` (an
+        overlapped end_pass worker's) or carrier drain can land between
+        the pull and the stamp. The boundary consumer then compensates
+        exactly ``decay_epochs - epoch`` decays onto the prefetched rows;
+        rows created here have show=clk=0, so the extra decays are bitwise
+        no-ops on them.
+        """
+        with self._maintenance_lock:
+            return self.pull_or_create(keys), self.decay_epochs
 
     def push(self, keys: np.ndarray, rows: np.ndarray) -> None:
         """Write back full rows for existing keys (end-of-pass flush)."""
@@ -640,6 +721,45 @@ class HostSparseTable:
     apply_delta = load  # a delta dir has the same format; push() upserts
 
 
+def _rows_with_prefetch(
+    table: HostSparseTable, keys: np.ndarray, prefetch
+) -> np.ndarray:
+    """Host rows for sorted unique ``keys``, serving staged-prefetch hits
+    and pulling only the remainder.
+
+    Prefetched rows receive the decays the host applied since the staged
+    pull (``decay_epochs - epoch`` of them). Bitwise-equal to a fresh
+    ``pull_or_create``: rows the prefetch CREATED have show=clk=0 so the
+    catch-up decays are no-ops, and rows that already existed are — by the
+    feed stage's exclusion of the live pass's keys — untouched by any
+    writeback between the staged pull and now.
+    """
+    if prefetch is None:
+        return table.pull_or_create(keys)
+    pf_keys, pf_rows = prefetch["keys"], prefetch["rows"]
+    lay = table.layout
+    out = np.empty((len(keys), lay.width), dtype=np.float32)
+    if len(pf_keys):
+        pos = np.searchsorted(pf_keys, keys)
+        pos = np.minimum(pos, len(pf_keys) - 1)
+        hit = pf_keys[pos] == keys
+    else:
+        hit = np.zeros(len(keys), dtype=bool)
+    if hit.any():
+        rows = pf_rows[pos[hit]]  # fancy index: a fresh copy, safe to mutate
+        d = table.decay_epochs - prefetch["epoch"]
+        if d > 0:
+            dec = np.float32(table.opt.show_clk_decay)
+            for _ in range(d):
+                rows[:, lay.SHOW] *= dec
+                rows[:, lay.CLK] *= dec
+        out[hit] = rows
+    miss = ~hit
+    if miss.any():
+        out[miss] = table.pull_or_create(keys[miss])
+    return out
+
+
 class PassWorkingSet:
     """The HBM tier: dense pass-local table built from the pass's unique keys.
 
@@ -667,8 +787,28 @@ class PassWorkingSet:
             with self._lock:
                 self._key_chunks.append(np.unique(keys.astype(np.uint64)))
 
+    def premerge(self, threads: int = 1) -> np.ndarray:
+        """Collapse the accumulated key chunks to the merged array NOW.
+
+        The boundary feed stage calls this while the PREVIOUS pass trains,
+        so finalize() later re-merges a singleton chunk list through the
+        no-copy fast path of :func:`merge_unique_keys` — the object
+        returned here is the SAME object finalize sees, which is what lets
+        a staged host prefetch validate itself with an O(1) identity test.
+        ``add_keys`` after premerge still works (the merged array becomes
+        one chunk among others) but voids that identity, so a stale
+        prefetch is dropped rather than consumed.
+        """
+        if self._finalized:
+            raise RuntimeError("working set already finalized")
+        with self._lock:
+            merged = merge_unique_keys(self._key_chunks, threads)
+            self._key_chunks = [merged] if len(merged) else []
+        return merged
+
     def finalize(
-        self, table: HostSparseTable, round_to: int = 512, carrier=None
+        self, table: HostSparseTable, round_to: int = 512, carrier=None,
+        prefetch=None,
     ) -> np.ndarray:
         """Dedup keys, pull host rows, lay out [n_mesh_shards, cap, width].
 
@@ -682,13 +822,22 @@ class PassWorkingSet:
         of the departing slice only), and only NEW keys pull host rows and
         upload. Returns a jax array in that case. The reference keeps its
         HBM cache warm across passes the same way (EndPass
-        box_wrapper.cc:627-651)."""
-        with self._lock:
-            if self._key_chunks:
-                all_keys = np.unique(np.concatenate(self._key_chunks))
-            else:
-                all_keys = np.zeros(0, dtype=np.uint64)
+        box_wrapper.cc:627-651).
+
+        ``prefetch`` is the staged host-pull dict built by the dataset's
+        boundary feed stage ({src, keys, rows, epoch}); it is consumed
+        only if its ``src`` is the very array this finalize merges
+        (identity check), else silently dropped."""
+        t0 = time.perf_counter()
+        with self._lock, record_event("boundary.dedup", "boundary"):
+            all_keys = merge_unique_keys(
+                self._key_chunks,
+                int(config.get_flag("boundary_merge_threads")),
+            )
             self._key_chunks = []
+        STAT_SET("boundary.dedup_s", time.perf_counter() - t0)
+        if prefetch is not None and prefetch.get("src") is not all_keys:
+            prefetch = None  # keys landed after the staged premerge: stale
         self.n_keys = len(all_keys)
         ns = self.n_mesh_shards
         shard_ids = key_to_shard(all_keys, ns)
@@ -698,14 +847,12 @@ class PassWorkingSet:
         cap = -(-cap // round_to) * round_to
         self.capacity = cap
 
-        # stable order: group by shard, rank within shard
+        # stable order: group by shard, rank within shard — vectorized
+        # (rank of key i = position of i within its shard's sorted group)
         order = np.argsort(shard_ids, kind="stable")
         rank_in_shard = np.empty(len(all_keys), dtype=np.int64)
-        start = 0
-        for s in range(ns):
-            c = int(counts[s])
-            rank_in_shard[order[start : start + c]] = np.arange(c)
-            start += c
+        starts = np.repeat(np.cumsum(counts) - counts, counts)
+        rank_in_shard[order] = np.arange(len(all_keys), dtype=np.int64) - starts
         global_rows = shard_ids * cap + rank_in_shard
 
         self.sorted_keys = all_keys  # np.unique output is sorted
@@ -715,20 +862,30 @@ class PassWorkingSet:
 
         if carrier is not None and not carrier.flushed and carrier.ws.n_keys:
             return self._finalize_spliced(
-                table, carrier, all_keys, global_rows, ns, cap
+                table, carrier, all_keys, global_rows, ns, cap, prefetch
             )
-        rows = table.pull_or_create(all_keys) if len(all_keys) else np.zeros(
-            (0, table.layout.width), dtype=np.float32
-        )
+        t0 = time.perf_counter()
+        with record_event("boundary.pull", "boundary"):
+            rows = (
+                _rows_with_prefetch(table, all_keys, prefetch)
+                if len(all_keys)
+                else np.zeros((0, table.layout.width), dtype=np.float32)
+            )
+        STAT_SET("boundary.pull_s", time.perf_counter() - t0)
         dev = np.zeros((ns, cap, table.layout.width), dtype=np.float32)
         dev.reshape(ns * cap, -1)[global_rows] = rows
         return dev
 
     def _finalize_spliced(
-        self, table, carrier, all_keys, global_rows, ns, cap
+        self, table, carrier, all_keys, global_rows, ns, cap, prefetch=None
     ):
         """Delta boundary: splice carried rows on device, push departures,
-        upload only new keys. Returns the [ns, cap, width] jax array."""
+        upload only new keys. Returns the [ns, cap, width] jax array.
+
+        The host pull of the new keys runs on a worker thread so it
+        overlaps the device-side allocation + common splice; the two
+        scatters hit disjoint row sets, so running the common splice first
+        is bitwise-identical to the old new-then-common order."""
         import jax.numpy as jnp
 
         old_keys = carrier.ws.sorted_keys
@@ -750,30 +907,54 @@ class PassWorkingSet:
         new_mask = ~common
         new_keys = all_keys[new_mask]
         W = table.layout.width
-        new_vals = (
-            table.pull_or_create(new_keys)
-            if len(new_keys)
-            else np.zeros((0, W), dtype=np.float32)
-        )
+
+        # single-writer result cell; the join below is the only reader
+        pull = {"rows": None, "err": None, "secs": 0.0}
+
+        def _pull_new():
+            t0 = time.perf_counter()
+            try:
+                with record_event("boundary.pull", "boundary"):
+                    pull["rows"] = _rows_with_prefetch(
+                        table, new_keys, prefetch
+                    )
+            except BaseException as e:  # joined + re-raised below
+                pull["err"] = e
+            pull["secs"] = time.perf_counter() - t0
+
+        puller = None
+        if len(new_keys):
+            puller = threading.Thread(
+                target=_pull_new, name="boundary-pull", daemon=True
+            )
+            puller.start()
+
         # allocate the destination BORN under the carried table's sharding
         # (jit + out_shardings): an eager zeros (even one fed to
         # device_put) would first materialize the full next-pass table
         # unsharded on the default device — an HBM spike of full-table
         # size at exactly the boundary the carrier exists to slim down.
         # On a single device this degenerates to a plain allocation.
-        dev = _sharded_zeros(ns * cap, W, carrier.dev_flat.sharding)
-        if len(new_keys):
+        t0 = time.perf_counter()
+        with record_event("boundary.splice", "boundary"):
+            dev = _sharded_zeros(ns * cap, W, carrier.dev_flat.sharding)
+            if common.any():
+                dev = dev.at[jnp.asarray(global_rows[common])].set(
+                    carrier.rows_for(common_old)
+                )
+        STAT_SET("boundary.splice_s", time.perf_counter() - t0)
+        if puller is not None:
+            puller.join()
+            if pull["err"] is not None:
+                raise pull["err"]
+            STAT_SET("boundary.pull_s", pull["secs"])
             from paddlebox_tpu import config as _config
             from paddlebox_tpu.ops.wire_quant import send_rows
 
             up = send_rows(
-                new_vals, table.layout, str(_config.get_flag("wire_dtype"))
+                pull["rows"], table.layout, str(_config.get_flag("wire_dtype"))
             )
             dev = dev.at[jnp.asarray(global_rows[new_mask])].set(up)
-        if common.any():
-            dev = dev.at[jnp.asarray(global_rows[common])].set(
-                carrier.rows_for(common_old)
-            )
         return dev.reshape(ns, cap, W)
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:

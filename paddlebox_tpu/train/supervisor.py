@@ -178,7 +178,8 @@ class Incident:
     pass_seq: int
     date: Optional[str]
     kind: str      # load_error | train_error | gate_nan | gate_auc |
-                   # ckpt_save_error | escalate_resume | gave_up | skipped
+                   # prefetch_error | ckpt_save_error | escalate_resume |
+                   # gave_up | skipped
     action: str    # retry | revert_retry | resume | raise | skip
     attempt: int
     detail: str = ""
@@ -240,6 +241,13 @@ class PassSupervisor:
         self._auc_history: deque = deque(maxlen=self.gates.auc_window)
         self._pass_seq = 0
         self._date: Optional[str] = None
+        # (date, tuple(files)) of the pass whose load this supervisor kicked
+        # into the dataset's boundary feed stage. The marker doubles as the
+        # "set_date already consumed" record: a kicked pass's set_date runs
+        # at kick time, so the adopting (or falling-back) run_pass must NOT
+        # call it again — pass_id would double-bump and shift the load's
+        # sampling/shuffle seeds off the sequential run's.
+        self._prefetch: Optional[tuple] = None
 
     # ---- incident log ----------------------------------------------------
 
@@ -280,6 +288,62 @@ class PassSupervisor:
                 self._record("load_error", "retry", attempt, repr(e))
                 self.retry.sleep(self.retry.backoff(attempt + 1))
 
+    def _kick_prefetch(self, date: Optional[str], files: Sequence[str]) -> None:
+        """Stage the NEXT pass's load behind the live pass's training.
+
+        Kicks the dataset's boundary feed pipeline — threaded read, key
+        premerge, gated host-row prefetch (see BoxPSDataset.
+        _stage_boundary_prefetch) — on the preload thread, so by the time
+        ``run_pass`` reaches the next pass its data is already staged.
+        Opportunistic: any failure here is an incident, never an attempt
+        failure — the next ``run_pass`` falls back to a synchronous load.
+        Coordinated (multi-rank) runs don't kick: the load there is itself
+        a lockstep verdict exchange that must stay on the pass boundary.
+        """
+        if self.coord is not None or not config.get_flag("boundary_pipeline"):
+            return
+        key = (date, tuple(files))
+        try:
+            if date is not None and self._prefetch != key:
+                self.ds.set_date(date)
+            # marker set as soon as set_date is consumed: even if the kick
+            # dies right after, the fallback load must skip set_date
+            self._prefetch = key
+            self.ds.set_filelist(list(files))
+            self.ds.preload_into_memory()
+        except Exception as e:
+            self._record("prefetch_error", "deferred", 0, repr(e))
+
+    def _adopt_prefetch(self, date: Optional[str], files: Sequence[str]) -> None:
+        """Consume (or cancel) a previously kicked prefetch, then ensure the
+        pass's data is staged — falling back to the synchronous retrying
+        load when the kick failed, was reverted away, or targeted a
+        different pass."""
+        marker, self._prefetch = self._prefetch, None
+        key = (date, tuple(files))
+        if marker == key:
+            staged = False
+            try:
+                self.ds.wait_preload_done()
+                # a revert (or a failed kick) may have discarded the staged
+                # slot after the marker was set — verify before trusting it
+                staged = self.ds._staged is not None
+            except Exception as e:
+                self._record("prefetch_error", "retry", 0, repr(e))
+                self.ds.discard_staged()
+            if not staged:
+                # set_date already consumed at kick time: date=None
+                self._load_with_retry(None, files)
+            return
+        if marker is not None:
+            # stale kick — the caller changed the schedule; cancel it
+            try:
+                self.ds.wait_preload_done()
+            except Exception:
+                pass
+            self.ds.discard_staged()
+        self._load_with_retry(date, files)
+
     def _gate(self, out: Dict[str, float]) -> None:
         g = self.gates
         batches = out.get("batches", 0.0)
@@ -307,7 +371,9 @@ class PassSupervisor:
                     f"(window of {len(self._auc_history)} confirmed passes)",
                 )
 
-    def _attempt(self, n_batches: Optional[int]) -> Dict[str, float]:
+    def _attempt(
+        self, n_batches: Optional[int], prefetch: Optional[tuple] = None
+    ) -> Dict[str, float]:
         """One armed begin->train->gate->[global verdict]->confirm cycle."""
         err: Optional[Exception] = None
         out: Dict[str, float] = {}
@@ -318,6 +384,10 @@ class PassSupervisor:
                     round_to=self.round_to, enable_revert=True, trainer=self.tr
                 )
             self.tr.prepare_pass(self.ds, n_batches)
+            if prefetch is not None:
+                # training is about to occupy the device: stage the next
+                # pass's load/premerge/prefetch behind it
+                self._kick_prefetch(prefetch[0], prefetch[1])
             out = self.tr.train_pass(self.ds, n_batches=n_batches)
             self._gate(out)
         except Exception as e:
@@ -398,8 +468,15 @@ class PassSupervisor:
         date: Optional[str] = None,
         n_batches: Optional[int] = None,
         save: Optional[str] = None,  # None | "base" | "delta"
+        prefetch: Optional[tuple] = None,  # (date, files) of the NEXT pass
     ) -> Optional[Dict[str, float]]:
         """Load, train, gate, and publish one pass, healing failures.
+
+        ``prefetch`` names the pass that follows this one: once training is
+        underway its load is kicked into the dataset's boundary feed stage,
+        and the next ``run_pass`` over the same (date, files) adopts the
+        staged result instead of loading synchronously (``run_day`` threads
+        this automatically).
 
         Returns the pass metrics, or None when the pass was dropped
         (``on_give_up="skip"`` after retries AND escalation failed).
@@ -411,7 +488,7 @@ class PassSupervisor:
         self._pass_seq += 1
         self._date = date if date is not None else self._date
         if self.coord is None:
-            self._load_with_retry(date, files)
+            self._adopt_prefetch(date, files)
         else:
             # coordinate the load the same way as the pass verdict: a rank
             # whose input never materialized must take every peer down with
@@ -439,7 +516,7 @@ class PassSupervisor:
         while True:
             try:
                 with PROFILER.record_event("supervised_pass_attempt", "supervisor"):
-                    out = self._attempt(n_batches)
+                    out = self._attempt(n_batches, prefetch=prefetch)
                 break
             except Exception as e:
                 self._revert(attempt, e)
@@ -485,7 +562,15 @@ class PassSupervisor:
         do_save = publish and self.checkpoint is not None
         for p, files in enumerate(pass_files):
             mode = None if not do_save else ("base" if p == 0 else "delta")
+            nxt = (
+                (date, tuple(pass_files[p + 1]))
+                if p + 1 < len(pass_files)
+                else None
+            )
             outs.append(
-                self.run_pass(files, date=date, n_batches=n_batches, save=mode)
+                self.run_pass(
+                    files, date=date, n_batches=n_batches, save=mode,
+                    prefetch=nxt,
+                )
             )
         return outs

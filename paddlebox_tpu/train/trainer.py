@@ -658,6 +658,16 @@ class CTRTrainer:
         c = getattr(self, "_resident_cache", None)
         if c is not None and c[0] is dataset.store and c[1] is dataset.ws:
             return c[2]
+        # a rebuild over the SAME store (pass retry, warmup->timed ws swap)
+        # can keep the frozen pad-shape cache: the unique-row count of an
+        # index block depends only on the store's keys (distinct keys map
+        # to distinct rows in ANY pass working set), so re-deriving it per
+        # rebuild just re-runs the pad sweep for identical answers
+        prev_uniq = (
+            dict(c[2]._uniq_cache)
+            if c is not None and c[0] is dataset.store
+            else None
+        )
         # release the PREVIOUS pass's device arrays (and the jitted
         # supersteps whose closures pin them) BEFORE uploading the new
         # pass's set — otherwise both passes' resident arrays coexist in
@@ -676,6 +686,8 @@ class CTRTrainer:
             plan=self.plan,
             transport=dataset.transport,
         )
+        if prev_uniq:
+            rp._uniq_cache.update(prev_uniq)
         self._resident_cache = (dataset.store, dataset.ws, rp)
         return rp
 

@@ -35,6 +35,15 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
     transport.heartbeat     parallel/transport.py  before each peer beat —
                             suppressing beats starves acks and the peer's
                             failure detector
+    boundary.premerge       data/dataset.py  boundary feed stage, before the
+                            staged working set's key premerge (pipelined
+                            boundary only)
+    boundary.stage_pull     data/dataset.py  boundary feed stage, before the
+                            host pull_or_create prefetch for the staged
+                            next pass
+    boundary.writeback      data/dataset.py  top of the end_pass_async
+                            worker, before writeback/decay — a failure here
+                            exercises the saved-state restore + pass reopen
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -74,6 +83,9 @@ KNOWN_SITES = (
     "transport.send",
     "transport.recv_frame",
     "transport.heartbeat",
+    "boundary.premerge",
+    "boundary.stage_pull",
+    "boundary.writeback",
 )
 
 

@@ -1,0 +1,348 @@
+"""Pipelined pass boundary: bitwise equivalence + fault healing.
+
+The boundary pipeline (data/dataset.py feed stage, sparse_table prefetch
+consumption, supervisor prefetch kick) is a pure overlap optimization — a
+pipelined run must be BITWISE equal to the sequential boundary
+(``boundary_pipeline=0``): same host rows, same dense params, same losses.
+These tests pin that, plus the healing story for the three boundary fault
+sites (a failed feed stage or writeback must never wedge the day loop).
+Deterministic, CPU-only, tier-1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.table.sparse_table import PassWorkingSet
+from paddlebox_tpu.train import (
+    CheckpointManager,
+    CTRTrainer,
+    PassSupervisor,
+    RetryPolicy,
+    TrainStepConfig,
+)
+from paddlebox_tpu.utils.faultinject import (
+    InjectedFault,
+    fail_nth,
+    fail_once,
+    inject,
+)
+
+pytestmark = pytest.mark.chaos
+
+S, B = 4, 16
+DATE = "20260101"
+# shrink_threshold=0 keeps the host-prefetch gate open (a shrinking table
+# can drop prefetched keys at the boundary, so the gate disables the pull)
+OPT = SparseOptimizerConfig(
+    embedx_threshold=0.0, show_clk_decay=0.97, shrink_threshold=0.0
+)
+
+FLAGS = ("boundary_pipeline", "boundary_prefetch_pull", "boundary_merge_threads")
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    prev = {f: config.get_flag(f) for f in FLAGS}
+    prev_backoff = config.get_flag("fs_open_backoff_s")
+    config.set_flag("fs_open_backoff_s", 0.0)
+    yield
+    for f, v in prev.items():
+        config.set_flag(f, v)
+    config.set_flag("fs_open_backoff_s", prev_backoff)
+
+
+def _schema():
+    return SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(S)],
+        label_slot="label",
+    )
+
+
+def _write(path, seed, lo, hi, n=64):
+    rng = np.random.default_rng(seed)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        for _ in range(n):
+            parts = [f"1 {float(rng.integers(0, 2))}"]
+            for _s in range(S):
+                k = int(rng.integers(1, 3))
+                parts.append(
+                    f"{k} " + " ".join(str(v) for v in rng.integers(lo, hi, k))
+                )
+            f.write(" ".join(parts) + "\n")
+    return str(path)
+
+
+def _files(tmp_path, tag):
+    # per-pass key ranges overlap partially, so every boundary sees both
+    # carried-over keys (excluded from the prefetch) and genuinely new ones
+    return [
+        _write(tmp_path / tag / f"{DATE}-{p}.txt", p, 1 + 40 * p, 161 + 40 * p)
+        for p in range(3)
+    ]
+
+
+def _stack(tag):
+    layout = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(layout, OPT, n_shards=2, seed=0)
+    ds = BoxPSDataset(_schema(), table, batch_size=B, shuffle_mode="none")
+    model = DeepFM(
+        num_slots=S, feat_width=layout.pull_width, embedx_dim=4, hidden=(8,)
+    )
+    cfg = TrainStepConfig(
+        num_slots=S, batch_size=B, layout=layout, sparse_opt=OPT,
+        auc_buckets=100,
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    return table, ds, tr
+
+
+def _final_state(table, tr):
+    k = np.sort(table.keys())
+    v = table.pull_or_create(k)
+    dense = [np.asarray(x) for x in jax.tree.flatten((tr.params, tr.opt_state))[0]]
+    return k, v, dense
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    assert len(a[2]) == len(b[2])
+    for x, y in zip(a[2], b[2]):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---- direct two-pass flow: the prefetch is staged DETERMINISTICALLY by a
+# synchronous in-pass load (no thread race on the _in_pass gate) ----------
+
+
+def _two_pass(tmp_path, tag, pipeline):
+    config.set_flag("boundary_pipeline", 1 if pipeline else 0)
+    files = _files(tmp_path, tag)
+    table, ds, tr = _stack(tag)
+    ds.set_filelist([files[0]])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=8)
+    tr.prepare_pass(ds)
+    outs = [tr.train_pass(ds)]
+    # load pass 2 while pass 1 is live: the feed stage premerges and (gated)
+    # prefetches host rows — its epoch stamp predates end_pass's decay, so
+    # the consumer's decay compensation path is exercised for real
+    ds.set_filelist([files[1]])
+    ds.load_into_memory()
+    prefetch = ds._boundary_prefetch
+    ds.end_pass(tr.trained_table())
+    ds.begin_pass(round_to=8)
+    tr.prepare_pass(ds)
+    outs.append(tr.train_pass(ds))
+    ds.end_pass(tr.trained_table())
+    return table, tr, outs, prefetch
+
+
+def test_prefetch_consumed_bitwise_equals_sequential(tmp_path):
+    t_on, tr_on, o_on, pf = _two_pass(tmp_path, "on", pipeline=True)
+    # the pipelined run really staged a host prefetch (new keys exist in
+    # pass 2, the live pass was finalized, shrink is off)
+    assert pf is not None and len(pf["keys"]) > 0
+    t_off, tr_off, o_off, pf_off = _two_pass(tmp_path, "off", pipeline=False)
+    assert pf_off is None
+    _assert_state_equal(_final_state(t_on, tr_on), _final_state(t_off, tr_off))
+    for a, b in zip(o_on, o_off):
+        assert a["loss"] == b["loss"] and a["auc"] == b["auc"]
+
+
+def test_stage_pull_fault_heals_with_reload(tmp_path):
+    """An injected failure in the feed stage's host prefetch fails that
+    load cleanly (staged slot discarded, no wedge) and a plain reload
+    stages it again — final state bitwise equals the never-faulted run."""
+    config.set_flag("boundary_pipeline", 1)
+    files = _files(tmp_path, "sp")
+    table, ds, tr = _stack("sp")
+    ds.set_filelist([files[0]])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=8)
+    tr.prepare_pass(ds)
+    outs = [tr.train_pass(ds)]
+    ds.set_filelist([files[1]])
+    with inject(fail_once("boundary.stage_pull")) as plan:
+        with pytest.raises(InjectedFault):
+            ds.load_into_memory()
+    assert plan.failures("boundary.stage_pull") == 1
+    assert ds._staged is None and ds._boundary_prefetch is None
+    ds.load_into_memory()  # heal: plain reload re-stages load AND prefetch
+    assert ds._boundary_prefetch is not None
+    ds.end_pass(tr.trained_table())
+    ds.begin_pass(round_to=8)
+    tr.prepare_pass(ds)
+    outs.append(tr.train_pass(ds))
+    ds.end_pass(tr.trained_table())
+
+    t_c, tr_c, o_c, _ = _two_pass(tmp_path, "spc", pipeline=True)
+    _assert_state_equal(_final_state(table, tr), _final_state(t_c, tr_c))
+    for a, b in zip(outs, o_c):
+        assert a["loss"] == b["loss"]
+
+
+def test_writeback_fault_heals_on_endpass_retry(tmp_path):
+    """boundary.writeback fires at the top of the end_pass worker: the
+    failed end_pass re-opens the pass and a retried end_pass completes,
+    with the staged next pass (and its prefetch) surviving untouched."""
+    config.set_flag("boundary_pipeline", 1)
+    files = _files(tmp_path, "wb")
+    table, ds, tr = _stack("wb")
+    ds.set_filelist([files[0]])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=8)
+    tr.prepare_pass(ds)
+    outs = [tr.train_pass(ds)]
+    ds.set_filelist([files[1]])
+    ds.load_into_memory()
+    assert ds._boundary_prefetch is not None
+    with inject(fail_once("boundary.writeback")) as plan:
+        with pytest.raises(InjectedFault):
+            ds.end_pass(tr.trained_table())
+    assert plan.failures("boundary.writeback") == 1
+    assert ds._in_pass  # failed publish re-opened the pass
+    assert ds._boundary_prefetch is not None  # staged next pass survives
+    ds.end_pass(tr.trained_table())  # retry heals
+    ds.begin_pass(round_to=8)
+    tr.prepare_pass(ds)
+    outs.append(tr.train_pass(ds))
+    ds.end_pass(tr.trained_table())
+
+    t_c, tr_c, o_c, _ = _two_pass(tmp_path, "wbc", pipeline=True)
+    _assert_state_equal(_final_state(table, tr), _final_state(t_c, tr_c))
+    for a, b in zip(outs, o_c):
+        assert a["loss"] == b["loss"]
+
+
+# ---- supervised day loop: prefetch kick + adoption + revert cancel ------
+
+
+def _run_day(tmp_path, tag, pipeline, schedule=()):
+    config.set_flag("boundary_pipeline", 1 if pipeline else 0)
+    files = _files(tmp_path, tag)
+    table, ds, tr = _stack(tag)
+    cm = CheckpointManager(str(tmp_path / f"ckpt-{tag}"))
+    sup = PassSupervisor(
+        ds, tr, checkpoint=cm,
+        retry=RetryPolicy(backoff_s=0.0, sleep=lambda s: None),
+        round_to=8,
+    )
+    with inject(*schedule) as plan:
+        outs = sup.run_day(DATE, [[f] for f in files])
+    return table, ds, tr, sup, outs, plan
+
+
+def test_supervised_day_pipelined_bitwise_equals_sequential(tmp_path):
+    t_on, ds_on, tr_on, sup_on, o_on, probe = _run_day(
+        tmp_path, "don", pipeline=True
+    )
+    # the kick staged every non-first pass's load through the feed stage
+    assert probe.hits("boundary.premerge") >= 2
+    assert sup_on.incidents == []
+    assert ds_on._staged is None and ds_on._boundary_prefetch is None
+    t_off, ds_off, tr_off, sup_off, o_off, _ = _run_day(
+        tmp_path, "doff", pipeline=False
+    )
+    assert sup_off.incidents == []
+    _assert_state_equal(
+        _final_state(t_on, tr_on), _final_state(t_off, tr_off)
+    )
+    for a, b in zip(o_on, o_off):
+        assert a["loss"] == b["loss"] and a["auc"] == b["auc"]
+
+
+def test_mid_overlap_fault_cancels_staged_pass_and_retries(tmp_path):
+    """A device fault mid-pass-2 — while pass 3's load may be staged or in
+    flight — must revert pass 2, cancel the staged pass 3, retry, and
+    land bitwise on the sequential run's state."""
+    t_c, _, tr_c, _, o_c, probe = _run_day(tmp_path, "mc", pipeline=True)
+    steps_per_pass = probe.hits("step.device") // 3
+    assert steps_per_pass >= 1
+
+    t_i, ds_i, tr_i, sup_i, o_i, plan = _run_day(
+        tmp_path, "mi", pipeline=True,
+        schedule=(fail_nth("step.device", steps_per_pass + 2),),
+    )
+    assert plan.failures("step.device") == 1
+    kinds = [(i.kind, i.action) for i in sup_i.incidents]
+    assert ("train_error", "revert_retry") in kinds
+    assert all(o is not None for o in o_i)
+    assert ds_i._staged is None and ds_i._boundary_prefetch is None
+    _assert_state_equal(_final_state(t_i, tr_i), _final_state(t_c, tr_c))
+    for a, b in zip(o_i, o_c):
+        assert a["loss"] == b["loss"]
+
+    # and the whole faulted pipelined day equals the sequential day too
+    t_s, _, tr_s, _, o_s, _ = _run_day(tmp_path, "ms", pipeline=False)
+    _assert_state_equal(_final_state(t_i, tr_i), _final_state(t_s, tr_s))
+
+
+def test_premerge_fault_becomes_load_retry(tmp_path):
+    """boundary.premerge failing inside a kicked (or direct) load must
+    surface as a plain load failure the supervisor's load retry absorbs —
+    never a wedged 'staged pass not yet consumed' state."""
+    t_i, ds_i, tr_i, sup_i, o_i, plan = _run_day(
+        tmp_path, "pm", pipeline=True,
+        schedule=(fail_once("boundary.premerge"),),
+    )
+    assert plan.failures("boundary.premerge") == 1
+    assert all(o is not None for o in o_i)
+    t_c, _, tr_c, _, o_c, _ = _run_day(tmp_path, "pmc", pipeline=True)
+    _assert_state_equal(_final_state(t_i, tr_i), _final_state(t_c, tr_c))
+    for a, b in zip(o_i, o_c):
+        assert a["loss"] == b["loss"]
+
+
+# ---- working-set mechanics ----------------------------------------------
+
+
+def test_premerge_preserves_finalize_bitwise():
+    """premerge (threaded) -> finalize must produce the identical working
+    set to a finalize over the raw chunks: same keys, same row layout,
+    same device table."""
+    rng = np.random.default_rng(7)
+    chunks = [rng.integers(1, 50_000, 4096).astype(np.uint64) for _ in range(5)]
+    layout = ValueLayout(embedx_dim=4)
+
+    def build(premerge):
+        table = HostSparseTable(layout, OPT, n_shards=2, seed=0)
+        ws = PassWorkingSet(n_mesh_shards=2)
+        for c in chunks:
+            ws.add_keys(c)
+        if premerge:
+            ws.premerge(threads=4)
+        dev = ws.finalize(table, round_to=8)
+        return ws, np.asarray(dev)
+
+    ws_a, dev_a = build(premerge=False)
+    ws_b, dev_b = build(premerge=True)
+    np.testing.assert_array_equal(ws_b.sorted_keys, ws_a.sorted_keys)
+    np.testing.assert_array_equal(ws_b.row_of_sorted, ws_a.row_of_sorted)
+    assert ws_b.capacity == ws_a.capacity
+    np.testing.assert_array_equal(dev_b, dev_a)
+
+
+def test_premerge_after_finalize_rejected():
+    ws = PassWorkingSet(n_mesh_shards=2)
+    ws.add_keys(np.arange(1, 100, dtype=np.uint64))
+    table = HostSparseTable(ValueLayout(embedx_dim=4), OPT, n_shards=2, seed=0)
+    ws.finalize(table, round_to=8)
+    with pytest.raises(RuntimeError, match="finalized"):
+        ws.premerge()

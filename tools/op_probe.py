@@ -45,7 +45,35 @@ def timed_loop(name, body, init):
     return dt
 
 
+SWEEP_WIDTHS = (8, 16, 21, 24, 32, 64, 128)
+
+
+def sweep_point_names():
+    """Addressable scatter-sweep probe points, in run order. Drivers (see
+    tools/tpu_capture.py) give each point its own subprocess + timeout so
+    one wedged point can't eat the whole sweep budget."""
+    return [f"w{w}" for w in SWEEP_WIDTHS] + [
+        "hints", "gather_set", "bf16", "pallas",
+    ]
+
+
 def main():
+    if "--list-sweep-points" in sys.argv:
+        print("\n".join(sweep_point_names()))
+        return
+    only = None
+    for a in sys.argv[1:]:
+        if a.startswith("--scatter-sweep="):
+            only = a.split("=", 1)[1]
+    if only is not None:
+        # single-point mode: skip the baseline probes so the per-point
+        # subprocess pays backend init + ONE probe, nothing else
+        if only not in sweep_point_names():
+            print(f"unknown sweep point {only!r}; known: "
+                  + " ".join(sweep_point_names()), file=sys.stderr)
+            sys.exit(2)
+        scatter_sweep(np.random.default_rng(0), only=only)
+        return
     rng = np.random.default_rng(0)
     table = jnp.asarray(rng.standard_normal((ROWS, W)).astype(np.float32) * 0.01)
     rows_u = jnp.asarray(rng.integers(0, ROWS, U).astype(np.int32))
@@ -148,18 +176,29 @@ def main():
         scatter_sweep(rng)
 
 
-def scatter_sweep(rng):
+def scatter_sweep(rng, only=None):
     """Candidate strategies against the ~16 ms scatter-add floor at
     U=131k/W=21 (VERDICT r4 item 5; box_wrapper.cu:31-456 PushCopy is the
     reference's hand-written answer to the same problem). Run on a HEALTHY
-    chip; each row prints device ms/op. Interpretation notes inline."""
-    print("\n--- scatter strategy sweep (U=131k unique rows) ---")
+    chip; each row prints device ms/op. Interpretation notes inline.
+
+    ``only`` restricts the run to one point of :func:`sweep_point_names`
+    — the per-point subprocess mode tools/tpu_capture.py uses so a single
+    wedged probe costs its own timeout, not the whole sweep."""
+
+    def want(name):
+        return only is None or only == name
+
+    if only is None:
+        print("\n--- scatter strategy sweep (U=131k unique rows) ---")
     rows_np = np.sort(rng.choice(ROWS, U, replace=False).astype(np.int32))
     rows_s = jnp.asarray(rows_np)
 
     # width variants: the known non-monotonicity (W=8 fast, W=21 slow,
     # W=128 medium). A padded-width TABLE trades HBM for scatter speed.
-    for w in (8, 16, 21, 24, 32, 64, 128):
+    for w in SWEEP_WIDTHS:
+        if not want(f"w{w}"):
+            continue
         t = jnp.zeros((ROWS, w), jnp.float32)
         g = jnp.asarray(rng.standard_normal((U, w)).astype(np.float32))
         timed_loop(
@@ -168,61 +207,69 @@ def scatter_sweep(rng):
             (t, g),
         )
 
+    if want("hints") or want("gather_set") or want("bf16"):
+        t21 = jnp.zeros((ROWS, W), jnp.float32)
+        g21 = jnp.asarray(rng.standard_normal((U, W)).astype(np.float32))
+
     # sorted + hint combos at W=21 (hints measured no-op before; re-check)
-    t21 = jnp.zeros((ROWS, W), jnp.float32)
-    g21 = jnp.asarray(rng.standard_normal((U, W)).astype(np.float32))
-    timed_loop(
-        "scatter-add W=21 hints(sorted+unique)",
-        lambda c, i: (
-            c[0].at[rows_s].add(
-                c[1] * 1e-6, indices_are_sorted=True, unique_indices=True
+    if want("hints"):
+        timed_loop(
+            "scatter-add W=21 hints(sorted+unique)",
+            lambda c, i: (
+                c[0].at[rows_s].add(
+                    c[1] * 1e-6, indices_are_sorted=True, unique_indices=True
+                ),
+                c[1],
             ),
-            c[1],
-        ),
-        (t21, g21),
-    )
+            (t21, g21),
+        )
 
     # gather-modify-SET (unique rows): scatter with set semantics instead
     # of add — different lowering, sometimes different cost
-    timed_loop(
-        "gather+set W=21 (set semantics)",
-        lambda c, i: (
-            c[0].at[rows_s].set(jnp.take(c[0], rows_s, axis=0) + c[1] * 1e-6),
-            c[1],
-        ),
-        (t21, g21),
-    )
+    if want("gather_set"):
+        timed_loop(
+            "gather+set W=21 (set semantics)",
+            lambda c, i: (
+                c[0].at[rows_s].set(jnp.take(c[0], rows_s, axis=0) + c[1] * 1e-6),
+                c[1],
+            ),
+            (t21, g21),
+        )
 
     # bf16 update payload into an f32 table (half the update bytes; the
     # read-modify-write of the table itself is unchanged)
-    timed_loop(
-        "scatter-add W=21 bf16 updates",
-        lambda c, i: (
-            c[0].at[rows_s].add((c[1] * 1e-6).astype(jnp.bfloat16).astype(jnp.float32)),
-            c[1],
-        ),
-        (t21, g21),
-    )
+    if want("bf16"):
+        timed_loop(
+            "scatter-add W=21 bf16 updates",
+            lambda c, i: (
+                c[0].at[rows_s].add((c[1] * 1e-6).astype(jnp.bfloat16).astype(jnp.float32)),
+                c[1],
+            ),
+            (t21, g21),
+        )
 
     # Pallas per-row DMA set on a lane-aligned (W=128) table: the write
     # path the flag-gated kernel family already implements — viable only
     # if the padded table's HBM cost is acceptable
-    try:
-        from paddlebox_tpu.ops.pallas_kernels import (
-            backend_is_tpu,
-            write_rows_pallas,
-        )
-
-        if backend_is_tpu():
-            t128 = jnp.zeros((ROWS, 128), jnp.float32)
-            g128 = jnp.asarray(rng.standard_normal((U, 128)).astype(np.float32))
-            timed_loop(
-                "pallas write_rows W=128 (set)",
-                lambda c, i: (write_rows_pallas(c[0], rows_s, c[1]), c[1]),
-                (t128, g128),
+    if want("pallas"):
+        try:
+            from paddlebox_tpu.ops.pallas_kernels import (
+                backend_is_tpu,
+                write_rows_pallas,
             )
-    except Exception as e:  # pragma: no cover
-        print(f"pallas W=128 probe skipped: {e}")
+
+            if backend_is_tpu():
+                t128 = jnp.zeros((ROWS, 128), jnp.float32)
+                g128 = jnp.asarray(rng.standard_normal((U, 128)).astype(np.float32))
+                timed_loop(
+                    "pallas write_rows W=128 (set)",
+                    lambda c, i: (write_rows_pallas(c[0], rows_s, c[1]), c[1]),
+                    (t128, g128),
+                )
+            else:
+                print("pallas W=128 probe skipped: backend is not tpu")
+        except Exception as e:  # pragma: no cover
+            print(f"pallas W=128 probe skipped: {e}")
 
 
 if __name__ == "__main__":

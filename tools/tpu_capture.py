@@ -126,19 +126,48 @@ def _main_locked(quick: bool) -> int:
     # only item with ZERO hardware measurements across five rounds runs
     # right after the headline ------------------------------------------
     print("[capture] scatter sweep...", file=sys.stderr, flush=True)
+    # One subprocess + timeout PER probe point, artifact saved after each:
+    # the r05 all-or-nothing 900s sweep lost every measurement when a
+    # single point wedged the chip — now a wedge costs its own slice and
+    # the completed points survive in the artifact.
+    point_timeout = float(os.environ.get("PBOX_CAPTURE_POINT_TIMEOUT", "180"))
+    points = []
     try:
         p = subprocess.run(
-            [sys.executable, "tools/op_probe.py", "--scatter-sweep"],
-            cwd=REPO, capture_output=True, text=True, timeout=900,
+            [sys.executable, "tools/op_probe.py", "--list-sweep-points"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
         )
-        cap["scatter_sweep"] = {
-            "rc": p.returncode,
-            "stdout": p.stdout[-8000:],
-            "stderr": p.stderr[-2000:],
-        }
+        if p.returncode == 0:
+            points = [ln.strip() for ln in p.stdout.splitlines() if ln.strip()]
     except subprocess.TimeoutExpired:
-        cap["scatter_sweep"] = {"error": "op_probe timed out after 900s"}
-    _save(cap)
+        pass
+    if not points:  # listing wedged/failed: fall back to the known set
+        points = ["w8", "w16", "w21", "w24", "w32", "w64", "w128",
+                  "hints", "gather_set", "bf16", "pallas"]
+    sweep_points = {}
+    cap["scatter_sweep"] = {
+        "point_timeout_s": point_timeout, "points": sweep_points,
+    }
+    for pt in points:
+        try:
+            p = subprocess.run(
+                [sys.executable, "tools/op_probe.py", f"--scatter-sweep={pt}"],
+                cwd=REPO, capture_output=True, text=True,
+                timeout=point_timeout,
+            )
+            sweep_points[pt] = {
+                "rc": p.returncode,
+                "stdout": p.stdout[-2000:].strip(),
+                "stderr": p.stderr[-800:].strip(),
+            }
+        except subprocess.TimeoutExpired:
+            sweep_points[pt] = {
+                "error": f"timed out after {point_timeout:.0f}s"
+            }
+        _save(cap)  # partial sweep survives a later wedge
+        print(f"[capture]   point {pt}: "
+              f"{sweep_points[pt].get('error', 'ok')}",
+              file=sys.stderr, flush=True)
 
     # -- 3. ablations at default knobs (the VERDICT-required sub-fields:
     # carrier / wire / pv — each one bench run) --------------------------
