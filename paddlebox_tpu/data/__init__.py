@@ -2,6 +2,11 @@ from paddlebox_tpu.data.slot_schema import SlotSchema, SlotInfo
 from paddlebox_tpu.data.slot_record import SlotRecord, SlotBatch, build_batch
 from paddlebox_tpu.data.parser import parse_line, parse_logkey
 from paddlebox_tpu.data.dataset import BoxPSDataset, LocalShuffleRouter
+from paddlebox_tpu.data.quarantine import (
+    DataPoisonedError,
+    QuarantineLog,
+    read_dead_letter,
+)
 from paddlebox_tpu.data.data_generator import DataGenerator, MultiSlotDataGenerator
 from paddlebox_tpu.data.pv_instance import (
     PvInstance,
@@ -23,6 +28,9 @@ __all__ = [
     "parse_logkey",
     "BoxPSDataset",
     "LocalShuffleRouter",
+    "DataPoisonedError",
+    "QuarantineLog",
+    "read_dead_letter",
     "PvInstance",
     "build_rank_offset",
     "flatten_pv_instances",

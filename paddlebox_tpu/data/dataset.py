@@ -24,13 +24,18 @@ import itertools
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass
-from typing import Callable, Iterator, List, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from paddlebox_tpu import config
 from paddlebox_tpu.data.parser import parse_line
+from paddlebox_tpu.data.quarantine import (
+    DataPoisonedError,
+    QuarantineLog,
+    resolve_quarantine_dir,
+)
 from paddlebox_tpu.data.pv_instance import (
     PvInstance,
     flatten_pv_instances,
@@ -44,7 +49,7 @@ from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
 from paddlebox_tpu.utils.faultinject import fire
 from paddlebox_tpu.utils.fs import fs_glob
 from paddlebox_tpu.utils.line_reader import BufferedLineFileReader
-from paddlebox_tpu.utils.monitor import STAT_SET
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
 from paddlebox_tpu.utils.trace import record_event
 
 config.define_flag(
@@ -175,10 +180,27 @@ class LocalShuffleRouter:
 
 @dataclass
 class PassStats:
+    """Per-load accounting, consistent across the native and Python tiers:
+
+    ``lines``          every non-empty line seen (parsed + benign + bad)
+    ``parsed``         lines that produced a record
+    ``skipped_benign`` parser returned None legitimately (all-zero record,
+                       '#' cache line) — the native tier's nstats["skipped"]
+    ``bad_lines``      quarantined parse failures (0 unless data_quarantine)
+    ``bad_files``      whole part files skipped (unreadable / truncated /
+                       converter death)
+    """
+
     files: int = 0
     lines: int = 0
     records: int = 0
     keys: int = 0
+    parsed: int = 0
+    skipped_benign: int = 0
+    bad_lines: int = 0
+    bad_files: int = 0
+    bad_by_file: Dict[str, int] = field(default_factory=dict)
+    dead_letter: Optional[str] = None
 
 
 class BoxPSDataset:
@@ -205,6 +227,7 @@ class BoxPSDataset:
         line_parser: Optional[Callable[[str, SlotSchema], Optional[SlotRecord]]] = None,
         drop_remainder: bool = True,
         seed: int = 0,
+        quarantine_dir: Optional[str] = None,
     ):
         self.schema = schema
         self.table = table
@@ -224,6 +247,11 @@ class BoxPSDataset:
         self.line_parser = line_parser or parse_line
         self.drop_remainder = drop_remainder
         self.seed = seed
+        # where dead-letter files land (None -> data_quarantine_dir flag ->
+        # tempdir fallback); the supervisor wires <checkpoint_root>/quarantine
+        self.quarantine_dir = quarantine_dir
+        self._dead_letter_seq = 0
+        self._loading_qlog: Optional[QuarantineLog] = None
 
         self.date: Optional[str] = None
         self.pass_id = 0
@@ -437,34 +465,111 @@ class BoxPSDataset:
 
     # ---- load ------------------------------------------------------------
 
-    def _read_one(self, path: str):
-        # native fast path: whole-file columnar parse in C++ when nothing
-        # needs the line-by-line machinery (pipe converter, sampling, custom
-        # parser). Returns a ColumnarRecords chunk (no per-record Python
-        # objects). Falls back to the Python tier otherwise/on build failure.
-        if (
+    def _native_eligible(self, path: str) -> bool:
+        # native fast path applies when nothing needs the line-by-line
+        # machinery (pipe converter, sampling, custom parser)
+        return (
             self.pipe_command is None
             and self.line_parser is parse_line
             and config.get_flag("sample_rate") >= 1.0
             and config.get_flag("enable_native_parser")
             and not path.startswith(("hdfs:", "afs:"))  # fs dispatch tier
             and not path.endswith(".gz")
-        ):
+        )
+
+    def _parse_lines(self, path: str, numbered_lines, qlog) -> list:
+        """Parse (line_no, line) pairs with per-line quarantine; the one
+        line-accounting path for the Python tier AND the native tier's
+        corrupt-buffer fallback (so both report identically)."""
+        out = []
+        n_lines = n_parsed = n_benign = 0
+        for line_no, line in numbered_lines:
+            if not line:
+                continue
+            n_lines += 1
+            try:
+                rec = self.line_parser(line, self.schema)
+            except Exception as e:  # noqa: BLE001 — quarantined + counted
+                if qlog is None:  # strict mode: first bad line is fatal
+                    raise
+                qlog.quarantine_line(path, line_no, line, e)
+                continue
+            if rec is None:
+                n_benign += 1
+            else:
+                n_parsed += 1
+                out.append(rec)
+        with self._stats_lock:
+            st = self._loading_stats
+            st.lines += n_lines
+            st.parsed += n_parsed
+            st.skipped_benign += n_benign
+        return out
+
+    def _read_one(self, path: str):
+        """Read one part file -> ColumnarRecords chunk (native tier) or
+        SlotRecord list (Python tier).
+
+        File-level failures (unreadable, truncated gz, pipe-converter death,
+        decode errors) quarantine the WHOLE file in data_quarantine mode —
+        except FileNotFoundError: a missing input is a transient fault (late
+        upstream drop) the fs/load-retry tier owns, and healing it by
+        dropping the file would silently starve the pass."""
+        qlog = self._loading_qlog
+        try:
+            fire("data.file_read")
+            return self._read_one_inner(path, qlog)
+        except FileNotFoundError:
+            raise
+        except Exception as e:  # noqa: BLE001 — quarantined + counted
+            if qlog is None:
+                raise
+            qlog.quarantine_file(path, e)
+            # empty columnar chunk when the pass could have gone columnar,
+            # so one quarantined file never knocks the pass off the fast path
+            if self._native_eligible(path):
+                return ColumnarRecords.empty(
+                    self.schema.num_sparse, self.schema.num_float
+                )
+            return []
+
+    def _read_one_inner(self, path: str, qlog):
+        if self._native_eligible(path):
             from paddlebox_tpu.utils import native
 
             if native.available():
                 from paddlebox_tpu.utils.fs import fs_read_bytes_retry
 
+                data = fs_read_bytes_retry(path)
                 nstats: dict = {}
-                chunk = native.parse_buffer_columnar(
-                    fs_read_bytes_retry(path), self.schema, nstats
-                )
+                try:
+                    chunk = native.parse_buffer_columnar(
+                        data, self.schema, nstats
+                    )
+                except ValueError:
+                    if qlog is None:
+                        raise
+                    # the native parser rejects the whole buffer on its
+                    # first corrupt line; re-parse per line so each bad
+                    # line quarantines individually, and re-wrap columnar
+                    # so the pass stays on the fast path
+                    recs = self._parse_lines(
+                        path,
+                        enumerate(
+                            data.decode("utf-8", errors="replace").splitlines(),
+                            1,
+                        ),
+                        qlog,
+                    )
+                    return ColumnarRecords.from_records(recs, self.schema)
                 with self._stats_lock:
-                    self._loading_stats.lines += len(chunk) + nstats.get("skipped", 0)
+                    st = self._loading_stats
+                    skipped = nstats.get("skipped", 0)
+                    st.lines += len(chunk) + skipped
+                    st.parsed += len(chunk)
+                    st.skipped_benign += skipped
                 return chunk
 
-        out = []
-        n_lines = 0
         # per-file seed decorrelates sampling across part files (same-seeded
         # readers would keep/drop identical line indices)
         seed = hash((self.seed, self.pass_id, path)) & 0x7FFFFFFF
@@ -472,16 +577,11 @@ class BoxPSDataset:
         if begin_file is not None:  # per-file parser state (e.g. cache lines)
             begin_file(path)
         reader = BufferedLineFileReader(path, converter=self.pipe_command, seed=seed)
-        for line in reader:
-            if not line:
-                continue
-            n_lines += 1
-            rec = self.line_parser(line, self.schema)
-            if rec is not None:
-                out.append(rec)
-        with self._stats_lock:
-            self._loading_stats.lines += n_lines
-        return out
+        # lines_read is incremented before the reader yields, so it IS the
+        # 1-based number of the line in flight
+        return self._parse_lines(
+            path, ((reader.lines_read, line) for line in reader), qlog
+        )
 
     def load_into_memory(self) -> None:
         """Threaded read -> (optional shuffle) -> staged records + key set.
@@ -498,11 +598,21 @@ class BoxPSDataset:
         self._stats_lock = threading.Lock()
         stats = PassStats(files=len(self._filelist))
         self._loading_stats = stats
+        self._loading_qlog = (
+            QuarantineLog() if config.get_flag("data_quarantine") else None
+        )
         ws = self._new_working_set()
         parts: list = []
-        if self._filelist:
-            with ThreadPoolExecutor(max_workers=self.read_threads) as pool:
-                parts = list(pool.map(self._read_one, self._filelist))
+        try:
+            if self._filelist:
+                with ThreadPoolExecutor(max_workers=self.read_threads) as pool:
+                    parts = list(pool.map(self._read_one, self._filelist))
+            qlog, self._loading_qlog = self._loading_qlog, None
+        except BaseException:
+            self._loading_qlog = None
+            raise
+        if qlog is not None:
+            self._settle_quarantine(stats, qlog)
 
         store, order, records = self._normalize_and_shuffle(parts)
 
@@ -611,6 +721,105 @@ class BoxPSDataset:
         coordinated revert of pass N)."""
         self._staged = None
         self._boundary_prefetch = None
+
+    # ---- quarantine / admission -----------------------------------------
+
+    def _settle_quarantine(self, stats: PassStats, qlog: QuarantineLog) -> None:
+        """Fold the load's quarantine log into its PassStats, write the
+        dead-letter file when anything was quarantined, and publish the
+        data.quarantine.* gauges."""
+        qlog.settle(stats)
+        if qlog.total:
+            self._dead_letter_seq += 1
+            name = (
+                f"pass-{self.date or 'na'}-{self.pass_id:04d}"
+                f"-r{self.rank}-{self._dead_letter_seq:03d}"
+            )
+            with record_event("data.quarantine.dead_letter", "data"):
+                stats.dead_letter = qlog.write(
+                    resolve_quarantine_dir(self.quarantine_dir),
+                    name,
+                    meta={
+                        "date": self.date,
+                        "pass_id": self.pass_id,
+                        "rank": self.rank,
+                        "files": stats.files,
+                        "lines": stats.lines,
+                    },
+                )
+            STAT_ADD("data.quarantine.dead_letter_files")
+        STAT_SET("data.quarantine.bad_lines", stats.bad_lines)
+        STAT_SET("data.quarantine.bad_files", stats.bad_files)
+        if stats.bad_lines:
+            STAT_ADD("data.quarantine.bad_lines_total", stats.bad_lines)
+        if stats.bad_files:
+            STAT_ADD("data.quarantine.bad_files_total", stats.bad_files)
+
+    def admission_report(self) -> Dict:
+        """Bounded-loss admission verdict for the pass about to begin.
+
+        Computed over the STAGED load when one is pending (the pass
+        ``begin_pass`` would consume), else the live stats. ``poisoned``
+        is True when quarantine is on and either corrupt fraction exceeds
+        its threshold — the caller (begin_pass, or the supervisor's
+        poison-aware pre-check) decides fail/skip/degrade."""
+        st = self._staged[4] if self._staged is not None else self.stats
+        max_lf = float(config.get_flag("max_bad_line_fraction"))
+        max_ff = float(config.get_flag("max_bad_file_fraction"))
+        lf = st.bad_lines / max(1, st.lines)
+        ff = st.bad_files / max(1, st.files)
+        poisoned = bool(config.get_flag("data_quarantine")) and (
+            lf > max_lf or ff > max_ff
+        )
+        parts = []
+        if lf > max_lf:
+            parts.append(
+                f"{st.bad_lines}/{st.lines} lines quarantined "
+                f"({lf:.5f} > max_bad_line_fraction {max_lf:.5f})"
+            )
+        if ff > max_ff:
+            parts.append(
+                f"{st.bad_files}/{st.files} part files quarantined "
+                f"({ff:.5f} > max_bad_file_fraction {max_ff:.5f})"
+            )
+        detail = ""
+        if poisoned:
+            detail = "pass data poisoned: " + "; ".join(parts)
+            if st.dead_letter:
+                detail += f"; dead-letter: {st.dead_letter}"
+        return {
+            "poisoned": poisoned,
+            "detail": detail,
+            "line_fraction": lf,
+            "file_fraction": ff,
+            "bad_lines": st.bad_lines,
+            "bad_files": st.bad_files,
+            "lines": st.lines,
+            "files": st.files,
+            "dead_letter": st.dead_letter,
+        }
+
+    def check_admission(self) -> Dict:
+        """Raise DataPoisonedError when the pending pass is over the
+        bounded-loss thresholds; returns the report otherwise."""
+        rep = self.admission_report()
+        if rep["poisoned"]:
+            raise DataPoisonedError(
+                rep["detail"], report=rep, dead_letter=rep["dead_letter"]
+            )
+        return rep
+
+    def drop_pass_data(self) -> None:
+        """Abandon the loaded-but-unbegun pass data (supervisor
+        on_poisoned_pass="skip_pass"): staged slot, published records, and
+        the un-finalized working set all go; the table is untouched."""
+        self.discard_staged()
+        if not self._in_pass:
+            self.store = None
+            self._order = None
+            self._records = []
+            self.ws = None
+            self.stats = PassStats()
 
     def _new_working_set(self):
         """Fresh (un-finalized) working set for this pass: multi-host
@@ -820,6 +1029,7 @@ class BoxPSDataset:
         round_to: int = 512,
         enable_revert: bool = False,
         trainer=None,
+        admit_poisoned: bool = False,
     ) -> np.ndarray:
         """Consume the staged load, finalize the working set, build the device
         table (BeginFeedPass+EndFeedPass+BeginPass collapse: on TPU the HBM
@@ -829,7 +1039,14 @@ class BoxPSDataset:
         fleet_wrapper.h:319-321): the pass keys' pre-train rows (and, with
         ``trainer``, the dense params/opt state) are snapshotted so
         ``revert_pass()`` can reject everything this pass publishes;
-        ``end_pass`` confirms."""
+        ``end_pass`` confirms.
+
+        Bounded-loss admission gate: a pass whose load quarantined more
+        than ``max_bad_line_fraction`` / ``max_bad_file_fraction`` raises
+        :class:`DataPoisonedError` BEFORE anything is finalized or armed —
+        ``admit_poisoned=True`` overrides (the supervisor's
+        ``on_poisoned_pass="degrade"`` path, which trains over the pass
+        with the quarantined records dropped)."""
         # a pending async end_pass mutates the host table (writeback/decay/
         # spill); finalize must see its final state
         self.wait_end_pass()
@@ -842,6 +1059,11 @@ class BoxPSDataset:
                 "previous pass is still open — call end_pass (or, after a "
                 "failed end_pass, retry it / revert_pass) before begin_pass"
             )
+        if not admit_poisoned:
+            # gate BEFORE consuming the staged slot: a rejected pass leaves
+            # the staged data intact so the caller can still degrade
+            # (begin_pass(admit_poisoned=True)) or drop_pass_data it
+            self.check_admission()
         if self._staged is not None:
             self._publish(self._staged)
             self._staged = None

@@ -30,6 +30,7 @@ import numpy as np
 
 from paddlebox_tpu.data.slot_record import SlotRecord
 from paddlebox_tpu.data.slot_schema import SlotSchema
+from paddlebox_tpu.utils.faultinject import fire
 
 _parsers: Dict[str, Callable] = {}
 
@@ -42,15 +43,36 @@ def get_parser(name: str) -> Callable:
     return _parsers[name]
 
 
+def _hex_field(log_key: str, name: str, lo: int, hi: int) -> int:
+    try:
+        return int(log_key[lo:hi], 16)
+    except ValueError:
+        raise ValueError(
+            f"non-hex {name} field {log_key[lo:hi]!r} in log_key {log_key[:64]!r}"
+        ) from None
+
+
 def parse_logkey(log_key: str):
-    """-> (search_id, cmatch, rank). Hex sub-fields per the reference layout."""
-    search_id = int(log_key[16:32], 16)
-    cmatch = int(log_key[11:14], 16)
-    rank = int(log_key[14:16], 16)
+    """-> (search_id, cmatch, rank). Hex sub-fields per the reference layout.
+
+    A short or non-hex key raises a ValueError naming the field and the
+    offending value (quarantinable like any other parse error). The length
+    floor matches the native tier (csrc/slot_parser.cc: > 16 hex chars), so
+    both tiers reject the same keys.
+    """
+    if len(log_key) <= 16:
+        raise ValueError(
+            f"log_key too short: need > 16 hex chars, got "
+            f"{len(log_key)} ({log_key!r})"
+        )
+    search_id = _hex_field(log_key, "search_id", 16, 32)
+    cmatch = _hex_field(log_key, "cmatch", 11, 14)
+    rank = _hex_field(log_key, "rank", 14, 16)
     return search_id, cmatch, rank
 
 
 def parse_line(line: str, schema: SlotSchema) -> Optional[SlotRecord]:
+    fire("parser.parse_line")
     try:
         return _parse_line(line, schema)
     except IndexError:

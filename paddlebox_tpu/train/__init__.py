@@ -6,6 +6,7 @@ from paddlebox_tpu.train.sharded_step import (
 )
 from paddlebox_tpu.train.async_dense import AsyncDenseTable
 from paddlebox_tpu.train.checkpoint import CheckpointManager
+from paddlebox_tpu.data.quarantine import DataPoisonedError
 from paddlebox_tpu.train.supervisor import (
     CoordinatedAbort,
     EpochCoordinator,
@@ -28,6 +29,7 @@ __all__ = [
     "CTRTrainer",
     "CheckpointManager",
     "CoordinatedAbort",
+    "DataPoisonedError",
     "EpochCoordinator",
     "HealthGates",
     "PassFailure",

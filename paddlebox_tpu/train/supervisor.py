@@ -37,6 +37,19 @@ operator convention):
   (only consulted after ``auc_min_history`` confirmations, so a cold
   start can't self-reject).
 
+Poison awareness: corruption is NOT a transient fault. A load that
+quarantined data beyond the admission thresholds (data/quarantine.py)
+surfaces as ``DataPoisonedError`` — deterministic, because retrying the
+same filelist replays the same corruption — so the supervisor resolves it
+BEFORE the retry loop, without burning a single backoff retry, under the
+``on_poisoned_pass`` policy: ``fail`` (raise, with a ``data_poisoned``
+incident naming the dead-letter file), ``skip_pass`` (drop the pass's
+data, keep the day), or ``degrade`` (train the pass with the quarantined
+records dropped; the loss fraction lands in the incident and the pass
+metrics). In coordinated runs the corrupt-fraction verdict rides the
+same allgather as the pass/load verdicts, so every rank admits or
+rejects in lockstep.
+
 Distributed coordination (``transport=`` + :class:`EpochCoordinator`):
 when the supervisor drives one rank of a multi-host run, a pass must
 commit or revert GLOBALLY — one rank confirming a pass its peer reverted
@@ -56,6 +69,7 @@ global, every rank exhausts the same retry budget on the same attempt.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -64,6 +78,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from paddlebox_tpu import config
+from paddlebox_tpu.data.quarantine import DataPoisonedError
 from paddlebox_tpu.utils.monitor import STAT_ADD
 from paddlebox_tpu.utils.trace import PROFILER
 
@@ -72,6 +87,16 @@ config.define_flag(
     2,
     "revert+retry attempts per pass before the supervisor escalates to a "
     "checkpoint resume (and, failing that, gives up)",
+)
+config.define_flag(
+    "on_poisoned_pass",
+    "fail",
+    "supervisor policy when a pass's load quarantined data beyond the "
+    "admission thresholds (DataPoisonedError — deterministic, never "
+    "retried): 'fail' raises, 'skip_pass' drops the pass and continues "
+    "the day, 'degrade' trains over the pass with the quarantined "
+    "records dropped (loss fraction recorded in the incident and the "
+    "pass metrics)",
 )
 
 
@@ -179,7 +204,7 @@ class Incident:
     date: Optional[str]
     kind: str      # load_error | train_error | gate_nan | gate_auc |
                    # prefetch_error | ckpt_save_error | escalate_resume |
-                   # gave_up | skipped
+                   # gave_up | skipped | peer_abort | data_poisoned
     action: str    # retry | revert_retry | resume | raise | skip
     attempt: int
     detail: str = ""
@@ -216,9 +241,15 @@ class PassSupervisor:
         shrink: bool = True,
         on_give_up: str = "raise",  # raise | skip (drop the pass, keep the day)
         transport=None,
+        on_poisoned: Optional[str] = None,  # None -> on_poisoned_pass flag
     ):
         if on_give_up not in ("raise", "skip"):
             raise ValueError(f"on_give_up must be 'raise' or 'skip', got {on_give_up!r}")
+        if on_poisoned not in (None, "fail", "skip_pass", "degrade"):
+            raise ValueError(
+                "on_poisoned must be None, 'fail', 'skip_pass' or "
+                f"'degrade', got {on_poisoned!r}"
+            )
         self.ds = dataset
         self.tr = trainer
         self.table = dataset.table
@@ -237,6 +268,17 @@ class PassSupervisor:
         self.round_to = round_to
         self.shrink = shrink
         self.on_give_up = on_give_up
+        self._on_poisoned = on_poisoned
+        # poisoned pass admitted under the degrade policy: the next
+        # begin_pass (and any revert-retry of it) must bypass the gate
+        self._admit_poisoned = False
+        # default the dataset's dead-letter dir under the durable root so
+        # quarantined records live next to the checkpoints they shadow
+        if (
+            checkpoint is not None
+            and getattr(dataset, "quarantine_dir", "absent") is None
+        ):
+            dataset.quarantine_dir = os.path.join(checkpoint.root, "quarantine")
         self.incidents: List[Incident] = []
         self._auc_history: deque = deque(maxlen=self.gates.auc_window)
         self._pass_seq = 0
@@ -344,6 +386,51 @@ class PassSupervisor:
             self.ds.discard_staged()
         self._load_with_retry(date, files)
 
+    @property
+    def on_poisoned(self) -> str:
+        """Effective poisoned-pass policy (constructor arg wins, else the
+        on_poisoned_pass flag)."""
+        v = self._on_poisoned or str(config.get_flag("on_poisoned_pass"))
+        if v not in ("fail", "skip_pass", "degrade"):
+            raise ValueError(
+                f"on_poisoned_pass must be fail|skip_pass|degrade, got {v!r}"
+            )
+        return v
+
+    def _poison_report(self) -> Optional[Dict[str, Any]]:
+        """The dataset's admission verdict for the loaded pass (None for
+        datasets without the quarantine surface, e.g. test doubles)."""
+        rep_fn = getattr(self.ds, "admission_report", None)
+        return rep_fn() if rep_fn is not None else None
+
+    def _handle_poisoned(
+        self, detail: str, rep: Optional[Dict[str, Any]]
+    ) -> bool:
+        """Apply the on_poisoned policy to an already-global poison verdict.
+        True -> proceed with the pass (degrade), False -> drop it
+        (skip_pass); the fail policy raises DataPoisonedError."""
+        policy = self.on_poisoned
+        loss = ""
+        if rep is not None and (rep["bad_lines"] or rep["bad_files"]):
+            loss = (
+                f" (loss: {rep['bad_lines']} lines / {rep['bad_files']} "
+                f"files, line_fraction={rep['line_fraction']:.5f})"
+            )
+        if policy == "degrade":
+            self._record("data_poisoned", "degrade", 0, detail + loss)
+            self._admit_poisoned = True
+            return True
+        if policy == "skip_pass":
+            self._record("data_poisoned", "skip", 0, detail + loss)
+            drop = getattr(self.ds, "drop_pass_data", None)
+            if drop is not None:
+                drop()
+            return False
+        self._record("data_poisoned", "raise", 0, detail + loss)
+        raise DataPoisonedError(
+            detail, report=rep, dead_letter=(rep or {}).get("dead_letter")
+        )
+
     def _gate(self, out: Dict[str, float]) -> None:
         g = self.gates
         batches = out.get("batches", 0.0)
@@ -379,9 +466,14 @@ class PassSupervisor:
         out: Dict[str, float] = {}
         try:
             if not self.ds._in_pass:
-                # first attempt, or a revert re-armed the in-memory data
+                # first attempt, or a revert re-armed the in-memory data.
+                # admit_poisoned only reaches datasets that know the kwarg
+                # (and only under the degrade policy) — test doubles and
+                # older datasets keep their plain signature
+                kw = {"admit_poisoned": True} if self._admit_poisoned else {}
                 self.ds.begin_pass(
-                    round_to=self.round_to, enable_revert=True, trainer=self.tr
+                    round_to=self.round_to, enable_revert=True, trainer=self.tr,
+                    **kw,
                 )
             self.tr.prepare_pass(self.ds, n_batches)
             if prefetch is not None:
@@ -487,6 +579,7 @@ class PassSupervisor:
             raise ValueError("save requires a CheckpointManager")
         self._pass_seq += 1
         self._date = date if date is not None else self._date
+        self._admit_poisoned = False
         if self.coord is None:
             self._adopt_prefetch(date, files)
         else:
@@ -511,6 +604,25 @@ class PassSupervisor:
                 raise PassFailure(
                     f"pass {self._pass_seq} aborted: peer load failed: {detail}"
                 )
+        # poison-aware admission: DataPoisonedError is DETERMINISTIC — the
+        # same filelist replays the same corruption on every attempt, so it
+        # is resolved here, before the retry loop, under the on_poisoned
+        # policy. In coordinated runs the verdict rides the same allgather
+        # as the pass/load verdicts so every rank admits or rejects in
+        # lockstep (one rank degrading a pass its peer re-runs clean would
+        # desync the working-set exchange).
+        rep = self._poison_report()
+        poisoned = rep is not None and rep["poisoned"]
+        poison_detail = rep["detail"] if poisoned else ""
+        if self.coord is not None and rep is not None:
+            ok, gdetail = self.coord.exchange_verdict(
+                f"poison:{self._pass_seq}", not poisoned, poison_detail
+            )
+            if not ok and not poisoned:
+                poisoned = True
+                poison_detail = f"peer pass data poisoned: {gdetail}"
+        if poisoned and not self._handle_poisoned(poison_detail, rep):
+            return None
         escalated = False
         attempt = 0
         while True:
@@ -518,6 +630,13 @@ class PassSupervisor:
                 with PROFILER.record_event("supervised_pass_attempt", "supervisor"):
                     out = self._attempt(n_batches, prefetch=prefetch)
                 break
+            except DataPoisonedError as e:
+                # belt-and-braces: the pre-loop check above resolves poison
+                # before anything is armed, so reaching here means the
+                # thresholds/policy changed under a live attempt. Still
+                # deterministic — never burn backoff retries on it.
+                self._record("data_poisoned", "raise", attempt, repr(e))
+                raise
             except Exception as e:
                 self._revert(attempt, e)
                 if self.coord is not None:
@@ -541,6 +660,11 @@ class PassSupervisor:
                         + (" and checkpoint resume" if escalated else "")
                     ) from e
                 self.retry.sleep(self.retry.backoff(attempt))
+        if self._admit_poisoned and rep is not None:
+            # degrade accounting: the pass manifest records what was lost
+            out["quarantined_line_fraction"] = float(rep["line_fraction"])
+            out["quarantined_bad_lines"] = float(rep["bad_lines"])
+            out["quarantined_bad_files"] = float(rep["bad_files"])
         auc = out.get("auc")
         if auc is not None and np.isfinite(auc):
             self._auc_history.append(float(auc))

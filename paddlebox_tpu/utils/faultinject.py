@@ -44,6 +44,16 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
     boundary.writeback      data/dataset.py  top of the end_pass_async
                             worker, before writeback/decay — a failure here
                             exercises the saved-state restore + pass reopen
+    parser.parse_line       data/parser.py  top of parse_line, before each
+                            text-line parse (the Python tier and the
+                            native-fallback re-parse both route through it)
+                            — an injected failure is a synthetic corrupt
+                            line: quarantined in data_quarantine mode,
+                            fatal to the load in strict mode
+    data.file_read          data/dataset.py  _read_one, before each part
+                            file is opened/read — an injected failure is a
+                            synthetic unreadable file (quarantined whole in
+                            data_quarantine mode)
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -86,6 +96,8 @@ KNOWN_SITES = (
     "boundary.premerge",
     "boundary.stage_pull",
     "boundary.writeback",
+    "parser.parse_line",
+    "data.file_read",
 )
 
 

@@ -10,6 +10,7 @@ log. Deterministic, CPU-only, fast — these run in tier-1 under the
 
 from __future__ import annotations
 
+import os
 from types import SimpleNamespace
 
 import numpy as np
@@ -19,7 +20,13 @@ import pytest
 jax = pytest.importorskip("jax")
 
 from paddlebox_tpu import config
-from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.data import (
+    BoxPSDataset,
+    DataPoisonedError,
+    SlotInfo,
+    SlotSchema,
+    read_dead_letter,
+)
 from paddlebox_tpu.models import DeepFM
 from paddlebox_tpu.table import (
     HostSparseTable,
@@ -85,7 +92,8 @@ def _files(tmp_path, tag):
     ]
 
 
-def _sup(tmp_path, tag, gates=None, on_give_up="raise"):
+def _sup(tmp_path, tag, gates=None, on_give_up="raise", on_poisoned=None,
+         sleep=None):
     layout = ValueLayout(embedx_dim=4)
     table = HostSparseTable(layout, OPT, n_shards=2, seed=0)
     ds = BoxPSDataset(_schema(), table, batch_size=B, shuffle_mode="none")
@@ -101,8 +109,8 @@ def _sup(tmp_path, tag, gates=None, on_give_up="raise"):
     cm = CheckpointManager(str(tmp_path / f"ckpt-{tag}"))
     sup = PassSupervisor(
         ds, tr, checkpoint=cm, gates=gates,
-        retry=RetryPolicy(backoff_s=0.0, sleep=lambda s: None),
-        round_to=8, on_give_up=on_give_up,
+        retry=RetryPolicy(backoff_s=0.0, sleep=sleep or (lambda s: None)),
+        round_to=8, on_give_up=on_give_up, on_poisoned=on_poisoned,
     )
     return table, ds, tr, cm, sup
 
@@ -220,6 +228,179 @@ def test_persistent_load_failure_surfaces_as_pass_failure(tmp_path):
     kinds = [(i.kind, i.action) for i in sup.incidents]
     assert ("load_error", "retry") in kinds
     assert ("load_error", "raise") in kinds
+
+
+# ---- poisoned data: quarantine admission under the supervisor -----------
+
+# every one of these fails BOTH parser tiers (bad float / bad int / torn)
+GARBAGE = [
+    "3 zz !! this-line-is-corrupt",
+    "1 not-a-float 1 5 1 9",
+    "?? ?? ??",
+    "1 1.0 one 5",
+    "2 0.5 x",
+]
+
+
+def _poison_insert(src, dst):
+    """Copy ``src`` with garbage lines INSERTED at fixed offsets, so the
+    surviving records are exactly the original file's records (a degrade
+    run must be bitwise-equal to a run over the pre-cleaned filelist)."""
+    lines = open(src).read().splitlines()
+    out, injected = [], []
+    for i, ln in enumerate(lines):
+        if i in (3, 17, 29, 41, 57):
+            bad = GARBAGE[len(injected) % len(GARBAGE)]
+            out.append(bad)
+            injected.append(bad)
+        out.append(ln)
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text("\n".join(out) + "\n")
+    return str(dst), injected
+
+
+def test_poisoned_day_degrade_bitwise_equals_precleaned_run(tmp_path):
+    """Acceptance: a supervised day whose middle part file is corrupted
+    completes under on_poisoned='degrade' with the bad lines dead-lettered,
+    and lands bitwise-identical to the same day over the pre-cleaned
+    filelist."""
+    files = _files(tmp_path, "pdata")
+    poisoned, injected = _poison_insert(
+        files[1], tmp_path / "pdata-bad" / f"{DATE}-1.txt"
+    )
+
+    table_c, _, tr_c, _, sup_c = _sup(tmp_path, "pclean")
+    outs_c = sup_c.run_day(DATE, [[f] for f in files])
+    assert sup_c.incidents == []
+
+    table_d, _, tr_d, cm_d, sup_d = _sup(
+        tmp_path, "pdeg", on_poisoned="degrade"
+    )
+    outs_d = sup_d.run_day(DATE, [[files[0]], [poisoned], [files[2]]])
+    assert all(o is not None for o in outs_d)
+
+    k_c, v_c, d_c = _final_state(table_c, tr_c)
+    k_d, v_d, d_d = _final_state(table_d, tr_d)
+    np.testing.assert_array_equal(k_d, k_c)
+    np.testing.assert_array_equal(v_d, v_c)
+    for a, b in zip(d_d, d_c):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(
+        [o["loss"] for o in outs_d], [o["loss"] for o in outs_c], atol=1e-7
+    )
+    np.testing.assert_allclose(
+        [o["auc"] for o in outs_d], [o["auc"] for o in outs_c], atol=1e-9
+    )
+
+    # the degraded pass carries its bounded loss on the pass manifest
+    assert outs_d[1]["quarantined_bad_lines"] == float(len(injected))
+    assert 0.0 < outs_d[1]["quarantined_line_fraction"] < 0.1
+    assert "quarantined_bad_lines" not in outs_d[0]
+    assert "quarantined_bad_lines" not in outs_d[2]
+
+    # exactly one structured incident: the degrade admission, naming the
+    # dead-letter file and the loss
+    kinds = [(i.kind, i.action) for i in sup_d.incidents]
+    assert kinds == [("data_poisoned", "degrade")]
+    detail = sup_d.incidents[0].detail
+    assert "dead-letter: " in detail and "loss: 5 lines" in detail
+
+    # the named dead-letter round-trips: the injected garbage, verbatim,
+    # and it lives under the supervisor-wired <ckpt_root>/quarantine
+    dl_path = detail.split("dead-letter: ")[1].split(" (loss")[0]
+    assert dl_path.startswith(os.path.join(cm_d.root, "quarantine"))
+    dl = read_dead_letter(dl_path)
+    assert [e["line"] for e in dl["entries"]] == injected
+    assert all(e["file"] == poisoned for e in dl["entries"])
+    assert dl["summary"]["bad_lines"] == len(injected)
+
+
+def test_poisoned_pass_strict_raises_without_burning_retries(tmp_path):
+    """Acceptance: under the default on_poisoned='fail' policy a corrupt
+    pass raises DataPoisonedError after exactly one attempt — zero train
+    steps, zero backoff sleeps, no revert/retry incidents — with a
+    structured incident naming the dead-letter file."""
+    files = _files(tmp_path, "sdata")
+    poisoned, injected = _poison_insert(
+        files[1], tmp_path / "sdata-bad" / f"{DATE}-1.txt"
+    )
+    sleeps = []
+    table, ds, tr, cm, sup = _sup(tmp_path, "strict", sleep=sleeps.append)
+    assert sup.run_pass([files[0]], date=DATE, save="base") is not None
+
+    with inject() as probe:
+        with pytest.raises(DataPoisonedError) as ei:
+            sup.run_pass([poisoned], date=DATE)
+    assert probe.hits("step.device") == 0  # poison resolved before training
+    assert sleeps == []  # deterministic failure: no backoff retries burned
+    assert ei.value.report["bad_lines"] == len(injected)
+    assert ei.value.dead_letter and os.path.exists(ei.value.dead_letter)
+
+    kinds = [(i.kind, i.action) for i in sup.incidents]
+    assert kinds == [("data_poisoned", "raise")]
+    assert ei.value.dead_letter in sup.incidents[0].detail
+
+    # recovery contract: the rejected pass's staged data must be dropped
+    # explicitly before the supervisor can run the next pass
+    ds.drop_pass_data()
+    assert sup.run_pass([files[2]], date=DATE) is not None
+
+
+def test_seeded_parse_fault_strict_and_degrade(tmp_path):
+    """Satellite: a seeded parser.parse_line fault inside a supervised
+    3-pass day. Strict mode escalates without burning retries; degrade
+    mode completes bitwise-equal to the pre-cleaned filelist and the
+    dead-letter round-trips the injected-fault victim line."""
+    prev_native = config.get_flag("enable_native_parser")
+    config.set_flag("enable_native_parser", 0)  # native never calls parse_line
+    try:
+        files = _files(tmp_path, "fdata")
+        raw = open(files[0]).read().splitlines()
+        victim = raw[9]  # fail_nth(..., 10) kills 1-based line 10 of pass 0
+        cleaned0 = tmp_path / "fdata-clean" / f"{DATE}-0.txt"
+        cleaned0.parent.mkdir(parents=True, exist_ok=True)
+        cleaned0.write_text("\n".join(raw[:9] + raw[10:]) + "\n")
+
+        table_c, _, tr_c, _, sup_c = _sup(tmp_path, "fclean")
+        outs_c = sup_c.run_day(
+            DATE, [[str(cleaned0)], [files[1]], [files[2]]]
+        )
+        assert sup_c.incidents == []
+
+        # strict: the fault poisons pass 0 and the day dies immediately
+        sleeps = []
+        *_, sup_s = _sup(tmp_path, "fstrict", sleep=sleeps.append)
+        with inject(fail_nth("parser.parse_line", 10)) as plan:
+            with pytest.raises(DataPoisonedError):
+                sup_s.run_day(DATE, [[f] for f in files])
+        assert plan.failures("parser.parse_line") == 1
+        assert sleeps == []
+        assert [(i.kind, i.action) for i in sup_s.incidents] == [
+            ("data_poisoned", "raise")
+        ]
+
+        # degrade: same fault, day completes, bitwise == pre-cleaned run
+        table_d, _, tr_d, _, sup_d = _sup(
+            tmp_path, "fdeg", on_poisoned="degrade"
+        )
+        with inject(fail_nth("parser.parse_line", 10)) as plan:
+            outs_d = sup_d.run_day(DATE, [[f] for f in files])
+        assert plan.failures("parser.parse_line") == 1
+        assert all(o is not None for o in outs_d)
+        k_c, v_c, d_c = _final_state(table_c, tr_c)
+        k_d, v_d, d_d = _final_state(table_d, tr_d)
+        np.testing.assert_array_equal(k_d, k_c)
+        np.testing.assert_array_equal(v_d, v_c)
+        for a, b in zip(d_d, d_c):
+            np.testing.assert_array_equal(a, b)
+
+        detail = sup_d.incidents[0].detail
+        dl = read_dead_letter(detail.split("dead-letter: ")[1].split(" (loss")[0])
+        (entry,) = dl["entries"]
+        assert entry["line"] == victim and entry["line_no"] == 10
+        assert "injected fault" in entry["error"]
+    finally:
+        config.set_flag("enable_native_parser", prev_native)
 
 
 # ---- gate unit behavior (no training stack needed) ----------------------

@@ -14,6 +14,7 @@ Deterministic, CPU-only, tier-1 under the ``chaos`` marker.
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -25,6 +26,7 @@ import numpy as np
 import pytest
 
 from paddlebox_tpu import config
+from paddlebox_tpu.data import BoxPSDataset, DataPoisonedError, read_dead_letter
 from paddlebox_tpu.data.dataset import shuffle_route_store
 from paddlebox_tpu.data.record_store import ColumnarRecords
 from paddlebox_tpu.data.slot_record import SlotRecord
@@ -490,6 +492,200 @@ def test_supervisor_peer_load_failure_aborts_cleanly():
         assert "peer load failed" in msgs[0]
         assert "load failed" in msgs[1]
         assert sups[0].ds.reverted == 0 and sups[0].ds.ended == 0
+    finally:
+        for t in tps:
+            t.close()
+
+
+# ---------------------------------------------------------------------------
+# PassSupervisor: poison verdict rides the coordinated allgather
+# ---------------------------------------------------------------------------
+
+_POISON_DATE = "20260101"
+
+# every one of these fails BOTH parser tiers
+_GARBAGE = [
+    "3 zz !! corrupt",
+    "?? ?? ??",
+    "1 1.0 one 5",
+    "2 0.5 x",
+    "1 not-a-float 1 5",
+]
+
+
+def _write_pass_file(path, seed, poison=False):
+    """64 deterministic slot lines; with poison=True, garbage lines are
+    INSERTED so the surviving records equal the clean file's records."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(64):
+        parts = [f"1 {float(rng.integers(0, 2))}"]
+        for _s in range(S):
+            k = int(rng.integers(1, 3))
+            parts.append(
+                f"{k} " + " ".join(str(v) for v in rng.integers(1, 200, k))
+            )
+        lines.append(" ".join(parts))
+    out, injected = [], []
+    for i, ln in enumerate(lines):
+        if poison and i in (3, 17, 29, 41, 57):
+            bad = _GARBAGE[len(injected) % len(_GARBAGE)]
+            out.append(bad)
+            injected.append(bad)
+        out.append(ln)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("\n".join(out) + "\n")
+    return str(path), injected
+
+
+def _records_digest(records):
+    h = 0
+    for r in records:
+        h = zlib.crc32(np.ascontiguousarray(r.u64_values).tobytes(), h)
+        h = zlib.crc32(np.ascontiguousarray(r.f_values).tobytes(), h)
+    return float(h)
+
+
+def _digest_trainer():
+    """Trainer double over a REAL dataset: 'training' is a digest of the
+    admitted records, so lockstep admission differences are bitwise-visible.
+    params=None keeps PassGuard to sparse-only snapshots."""
+    calls = []
+
+    def train_pass(ds, n_batches=None):
+        calls.append(1)
+        return {
+            "batches": 4.0,
+            "nan_batches": 0.0,
+            "auc": 0.5,
+            "digest": _records_digest(ds.records),
+        }
+
+    tr = SimpleNamespace(
+        params=None,
+        prepare_pass=lambda ds, n: None,
+        train_pass=train_pass,
+        trained_table=lambda: None,
+    )
+    return tr, calls
+
+
+_DS_SCHEMA = SlotSchema(
+    [SlotInfo("label", type="float", dense=True, dim=1)]
+    + [SlotInfo(f"s{i}") for i in range(S)],
+    label_slot="label",
+)
+
+
+def _mk_ds(tmp_path, tag):
+    table = HostSparseTable(
+        ValueLayout(embedx_dim=2), SparseOptimizerConfig(), n_shards=2, seed=0
+    )
+    return BoxPSDataset(
+        _DS_SCHEMA, table, batch_size=16, shuffle_mode="none",
+        quarantine_dir=str(tmp_path / f"q-{tag}"),
+    )
+
+
+def _poison_cluster(tmp_path, tps, on_poisoned, sleeps):
+    """3 real datasets (rank 1's file corrupted) under coordinated
+    supervisors. Returns (sups, train-call counters, files, rank 1's
+    injected garbage lines)."""
+    sups, callss, files, injected1 = [], [], [], None
+    for r in range(N_RANKS):
+        f, injected = _write_pass_file(
+            tmp_path / f"r{r}" / "part.txt", seed=50 + r, poison=(r == 1)
+        )
+        files.append(f)
+        if r == 1:
+            injected1 = injected
+        tr, calls = _digest_trainer()
+        callss.append(calls)
+        sups.append(
+            PassSupervisor(
+                _mk_ds(tmp_path, f"r{r}"), tr,
+                retry=RetryPolicy(backoff_s=0.0, sleep=sleeps[r].append),
+                round_to=8, on_poisoned=on_poisoned, transport=tps[r],
+            )
+        )
+    return sups, callss, files, injected1
+
+
+def test_poison_verdict_lockstep_fail(tmp_path):
+    """Acceptance (3-rank, strict): rank 1's corrupt pass makes EVERY rank
+    raise DataPoisonedError after exactly one attempt — zero training, zero
+    backoff sleeps — with the clean ranks' verdict naming rank 1."""
+
+    tps = _cluster()
+    sleeps = [[] for _ in range(N_RANKS)]
+    try:
+        sups, callss, files, injected = _poison_cluster(
+            tmp_path, tps, None, sleeps
+        )
+
+        def worker(r):
+            with pytest.raises(DataPoisonedError) as ei:
+                sups[r].run_pass([files[r]], date=_POISON_DATE)
+            return ei.value
+
+        errs = _run_ranks(worker)
+        assert all(s == [] for s in sleeps)  # no backoff burned anywhere
+        assert all(c == [] for c in callss)  # nobody trained the pass
+        # the poisoned rank names its own dead-letter...
+        assert "peer" not in str(errs[1])
+        assert errs[1].report["bad_lines"] == len(injected)
+        assert errs[1].dead_letter and os.path.exists(errs[1].dead_letter)
+        # ...and the clean ranks rejected in lockstep, naming the peer
+        for r in (0, 2):
+            assert "peer pass data poisoned" in str(errs[r])
+            assert "rank 1" in str(errs[r])
+        for sup in sups:
+            kinds = [(i.kind, i.action) for i in sup.incidents]
+            assert kinds == [("data_poisoned", "raise")]
+    finally:
+        for t in tps:
+            t.close()
+
+
+def test_poison_verdict_lockstep_degrade(tmp_path):
+    """Acceptance (3-rank, degrade): the coordinated verdict admits the
+    poisoned pass on every rank; rank 1 trains exactly the surviving
+    records (digest equals a local load of the pre-cleaned file) and its
+    dead-letter round-trips the injected garbage."""
+
+    tps = _cluster()
+    sleeps = [[] for _ in range(N_RANKS)]
+    try:
+        sups, callss, files, injected = _poison_cluster(
+            tmp_path, tps, "degrade", sleeps
+        )
+        outs = _run_ranks(
+            lambda r: sups[r].run_pass([files[r]], date=_POISON_DATE)
+        )
+        assert all(o is not None for o in outs)
+        assert all(s == [] for s in sleeps)
+        assert all(c == [1] for c in callss)  # one attempt each, no retry
+        for sup in sups:
+            kinds = [(i.kind, i.action) for i in sup.incidents]
+            assert kinds == [("data_poisoned", "degrade")]
+        assert outs[1]["quarantined_bad_lines"] == float(len(injected))
+        assert outs[0]["quarantined_bad_lines"] == 0.0  # peer-voted
+        assert "rank 1" in sups[0].incidents[0].detail
+
+        st = sups[1].ds.stats
+        assert st.bad_lines == len(injected)
+        dl = read_dead_letter(st.dead_letter)
+        assert [e["line"] for e in dl["entries"]] == injected
+
+        # rank 1's admitted pass is bitwise the pre-cleaned file
+        clean_f, _ = _write_pass_file(
+            tmp_path / "ref" / "part.txt", seed=51, poison=False
+        )
+        ref = _mk_ds(tmp_path, "ref")
+        ref.set_date(_POISON_DATE)
+        ref.set_filelist([clean_f])
+        ref.load_into_memory()
+        assert outs[1]["digest"] == _records_digest(ref.records)
     finally:
         for t in tps:
             t.close()

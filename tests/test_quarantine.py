@@ -1,0 +1,256 @@
+"""Data-plane quarantine: per-line/per-file capture, dead-letter files,
+and the bounded-loss admission gate (data/quarantine.py + dataset glue).
+
+Chaos-path coverage (supervised days, poison-aware supervisor, 3-rank
+lockstep) lives in tests/test_chaos.py / tests/test_chaos_dist.py; this
+file pins the unit semantics both build on.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data import (
+    BoxPSDataset,
+    DataPoisonedError,
+    SlotInfo,
+    SlotSchema,
+    parse_logkey,
+    read_dead_letter,
+)
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.utils.faultinject import fail_nth, inject
+
+
+@pytest.fixture(autouse=True)
+def _quarantine_flags():
+    """Pin the flags this file exercises; restore whatever was set."""
+    names = (
+        "data_quarantine",
+        "max_bad_line_fraction",
+        "max_bad_file_fraction",
+        "data_quarantine_dir",
+        "fs_open_backoff_s",
+        "enable_native_parser",
+    )
+    prev = {n: config.get_flag(n) for n in names}
+    config.set_flag("fs_open_backoff_s", 0.0)
+    yield
+    for n, v in prev.items():
+        config.set_flag(n, v)
+
+
+def _schema():
+    return SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1),
+         SlotInfo("s0"), SlotInfo("s1")],
+        label_slot="label",
+    )
+
+
+def _ds(tmp_path, **kw):
+    table = HostSparseTable(
+        ValueLayout(embedx_dim=4), SparseOptimizerConfig(), n_shards=2, seed=0
+    )
+    kw.setdefault("quarantine_dir", str(tmp_path / "quarantine"))
+    return BoxPSDataset(_schema(), table, batch_size=2, **kw)
+
+
+GOOD = ["1 1.0 1 5 1 9", "1 0.5 2 6 7 1 3", "1 1.0 1 8 1 2"]
+BAD = ["garbage !! not-a-line", "1 1.0 1", "1 0.0 0 1 4"]
+BENIGN = "1 1.0 1 0 1 0"  # all-zero sparse keys -> parser returns None
+
+
+def _write(path, lines):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+# ---- parse_logkey validation (satellite) --------------------------------
+
+def test_parse_logkey_named_validation_errors():
+    ok = "0" * 11 + "0ab" + "03" + "0000000000000111"
+    assert parse_logkey(ok) == (0x111, 0xAB, 3)
+    with pytest.raises(ValueError, match="too short.*'deadbeef'"):
+        parse_logkey("deadbeef")
+    with pytest.raises(ValueError, match="non-hex cmatch field 'xyz'"):
+        parse_logkey("0" * 11 + "xyz" + "03" + "0" * 16)
+    with pytest.raises(ValueError, match="non-hex rank field"):
+        parse_logkey("0" * 11 + "0ab" + "zz" + "0" * 16)
+    with pytest.raises(ValueError, match="non-hex search_id field"):
+        parse_logkey("0" * 11 + "0ab" + "03" + "nothexnothexnoth")
+
+
+def test_parse_logkey_length_floor_matches_native():
+    # the native tier requires > 16 hex chars; 17 must parse in both
+    assert parse_logkey("0" * 17) == (0, 0, 0)
+    with pytest.raises(ValueError, match="too short"):
+        parse_logkey("0" * 16)
+
+
+# ---- per-line quarantine + dead-letter ----------------------------------
+
+def test_quarantine_counters_and_dead_letter_roundtrip(tmp_path):
+    config.set_flag("enable_native_parser", 0)
+    lines = [GOOD[0], BAD[0], GOOD[1], BENIGN, BAD[1], "", GOOD[2]]
+    f0 = _write(tmp_path / "part-0.txt", lines)
+    f1 = _write(tmp_path / "part-1.txt", GOOD)
+    ds = _ds(tmp_path, read_threads=1)
+    ds.set_date("20260101")
+    ds.set_filelist([f0, f1])
+    ds.load_into_memory()
+
+    st = ds.stats
+    assert st.files == 2
+    assert st.lines == 9  # blank line not counted
+    assert st.parsed == 6 and st.records == 6
+    assert st.skipped_benign == 1
+    assert st.bad_lines == 2 and st.bad_files == 0
+    assert st.bad_by_file == {f0: 2}
+
+    dl = read_dead_letter(st.dead_letter)
+    assert dl["summary"]["bad_lines"] == 2
+    assert dl["summary"]["truncated"] is False
+    assert [e["line"] for e in dl["entries"]] == [BAD[0], BAD[1]]
+    assert [e["line_no"] for e in dl["entries"]] == [2, 5]
+    assert all(e["file"] == f0 and e["error"] for e in dl["entries"])
+
+
+def test_native_and_python_tiers_report_identically(tmp_path):
+    """Same corrupt file through both parser tiers: identical PassStats
+    accounting and identical surviving records (the native tier's corrupt
+    buffer re-parses per line and stays columnar)."""
+    from paddlebox_tpu.utils import native
+
+    if not native.available():
+        pytest.skip("native parser unavailable")
+    lines = [GOOD[0], BAD[0], GOOD[1], BENIGN, BAD[2], GOOD[2]]
+    f = _write(tmp_path / "part-0.txt", lines)
+
+    def load(native_on):
+        config.set_flag("enable_native_parser", native_on)
+        ds = _ds(tmp_path / f"n{native_on}", read_threads=1)
+        ds.set_date("20260101")
+        ds.set_filelist([f])
+        ds.load_into_memory()
+        return ds
+
+    a, b = load(1), load(0)
+    for st in (a.stats, b.stats):
+        assert (st.lines, st.parsed, st.skipped_benign, st.bad_lines) == (6, 3, 1, 2)
+    assert a.store is not None, "corrupt file knocked the pass off columnar"
+    assert len(a.records) == len(b.records) == 3
+    for ra, rb in zip(a.records, b.records):
+        np.testing.assert_array_equal(ra.u64_values, rb.u64_values)
+        np.testing.assert_array_equal(ra.f_values, rb.f_values)
+
+
+def test_strict_mode_first_bad_line_raises(tmp_path):
+    config.set_flag("data_quarantine", 0)
+    config.set_flag("enable_native_parser", 0)
+    f = _write(tmp_path / "part-0.txt", [GOOD[0], BAD[0]])
+    ds = _ds(tmp_path)
+    ds.set_filelist([f])
+    with pytest.raises(ValueError):
+        ds.load_into_memory()
+    assert ds.stats.bad_lines == 0  # nothing was quarantined
+
+
+# ---- file-level quarantine ----------------------------------------------
+
+def test_unreadable_file_quarantined_but_missing_file_raises(tmp_path):
+    config.set_flag("enable_native_parser", 0)
+    f_ok = _write(tmp_path / "part-0.txt", GOOD)
+    # a synthetic unreadable file via the data.file_read fault site
+    ds = _ds(tmp_path, read_threads=1)
+    ds.set_date("20260101")
+    with inject(fail_nth("data.file_read", 1)):
+        ds.set_filelist([f_ok, f_ok])
+        ds.load_into_memory()
+    st = ds.stats
+    assert st.bad_files == 1 and st.records == len(GOOD)
+    rep = ds.admission_report()
+    assert rep["poisoned"] and rep["file_fraction"] == 0.5
+    dl = read_dead_letter(st.dead_letter)
+    assert dl["entries"][0]["kind"] == "file"
+    assert "injected fault" in dl["entries"][0]["error"]
+
+    # a MISSING input is transient (late upstream drop): never quarantined
+    ds2 = _ds(tmp_path)
+    ds2.set_filelist([str(tmp_path / "never.txt")])
+    with pytest.raises(FileNotFoundError):
+        ds2.load_into_memory()
+
+
+def test_truncated_gz_quarantined(tmp_path):
+    import gzip
+
+    whole = gzip.compress(("\n".join(GOOD) + "\n").encode())
+    torn = tmp_path / "part-0.txt.gz"
+    torn.write_bytes(whole[: len(whole) // 2])
+    ok = tmp_path / "part-1.txt.gz"
+    ok.write_bytes(whole)
+    ds = _ds(tmp_path, read_threads=1)
+    ds.set_filelist([str(torn), str(ok)])
+    ds.load_into_memory()
+    assert ds.stats.bad_files == 1
+    assert ds.stats.records == len(GOOD)
+
+
+# ---- admission gate ------------------------------------------------------
+
+def test_admission_gate_rejects_and_admit_poisoned_overrides(tmp_path):
+    config.set_flag("enable_native_parser", 0)
+    f = _write(tmp_path / "part-0.txt", GOOD + [BAD[0]])
+    ds = _ds(tmp_path)
+    ds.set_date("20260101")
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    with pytest.raises(DataPoisonedError) as ei:
+        ds.begin_pass(round_to=8)
+    assert ei.value.dead_letter and os.path.exists(ei.value.dead_letter)
+    assert ei.value.dead_letter in str(ei.value)
+    assert ei.value.report["bad_lines"] == 1
+    assert not ds._in_pass  # nothing armed/finalized by the rejection
+    # degrade override: same pass trains over the surviving records
+    ds.begin_pass(round_to=8, admit_poisoned=True)
+    assert ds._in_pass and ds.memory_data_size() == len(GOOD)
+    ds.end_pass(None, shrink=False)
+
+
+def test_admission_thresholds_bound_loss(tmp_path):
+    config.set_flag("enable_native_parser", 0)
+    # 1 bad line in 100: under the default 1% line threshold -> admitted
+    f = _write(tmp_path / "part-0.txt", GOOD * 33 + [BAD[0]])
+    ds = _ds(tmp_path)
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    rep = ds.admission_report()
+    assert not rep["poisoned"] and rep["bad_lines"] == 1
+    ds.begin_pass(round_to=8)
+    ds.end_pass(None, shrink=False)
+    # tightening the knob re-poisons the same stats
+    config.set_flag("max_bad_line_fraction", 0.0)
+    assert ds.admission_report()["poisoned"]
+
+
+def test_drop_pass_data_clears_unbegun_pass(tmp_path):
+    config.set_flag("enable_native_parser", 0)
+    f = _write(tmp_path / "part-0.txt", GOOD + [BAD[0]])
+    ds = _ds(tmp_path)
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    ds.drop_pass_data()
+    assert ds.memory_data_size() == 0 and ds.ws is None
+    assert not ds.admission_report()["poisoned"]  # fresh stats
+    with pytest.raises(RuntimeError, match="load_into_memory"):
+        ds.begin_pass()

@@ -176,8 +176,10 @@ def test_replica_cache_line_parser_end_to_end(tmp_path):
 
 
 def test_replica_cache_parser_file_boundary_and_dim_mismatch(tmp_path):
-    """A file without a leading '#' line must raise (no state leaking from
-    the previous file on the same thread); oversize cache lines must raise."""
+    """A file without a leading '#' line must raise in strict mode (no
+    state leaking from the previous file on the same thread); oversize
+    cache lines must raise."""
+    from paddlebox_tpu import config
     from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
     from paddlebox_tpu.data.parser import ReplicaCacheLineParser
 
@@ -197,13 +199,66 @@ def test_replica_cache_parser_file_boundary_and_dim_mismatch(tmp_path):
     )
     ds.set_date("20260101")
     ds.set_filelist([str(tmp_path / "a.txt"), str(tmp_path / "b.txt")])
-    with pytest.raises(ValueError, match="cache line"):
-        ds.load_into_memory()
+    prev = config.get_flag("data_quarantine")
+    config.set_flag("data_quarantine", 0)  # strict: first bad line is fatal
+    try:
+        with pytest.raises(ValueError, match="cache line"):
+            ds.load_into_memory()
+    finally:
+        config.set_flag("data_quarantine", prev)
 
     parser = ReplicaCacheLineParser(ReplicaCache(dim=2), "cache_idx")
     parser.begin_file("x")
     with pytest.raises(ValueError):  # 3 floats into a dim-2 cache
         parser("# 1 2 3", schema)
+
+
+def test_replica_cache_parser_quarantine_mode(tmp_path):
+    """The two ReplicaCacheLineParser failure modes — record line before
+    any '#' cache line, and a cache-dim mismatch — through
+    load_into_memory: quarantined (counted + dead-lettered) with
+    data_quarantine on, fatal with it off (covered above)."""
+    from paddlebox_tpu.data import (
+        BoxPSDataset, SlotInfo, SlotSchema, read_dead_letter,
+    )
+    from paddlebox_tpu.data.parser import ReplicaCacheLineParser
+
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1),
+         SlotInfo("cache_idx"), SlotInfo("s0")],
+        label_slot="label",
+    )
+    lines = [
+        "1 1.0 1 7 1 11",   # record BEFORE any '#' line: quarantined
+        "# 1 2 3",          # 3 floats into a dim-2 cache: quarantined
+        "# 1 2",            # good cache row 0
+        "1 1.0 1 7 1 12",   # good record, uses cache row 0
+    ]
+    p = tmp_path / "a.txt"
+    p.write_text("\n".join(lines) + "\n")
+    cache = ReplicaCache(dim=2)
+    lay = ValueLayout(embedx_dim=4)
+    table = HostSparseTable(lay, SparseOptimizerConfig(), n_shards=2)
+    ds = BoxPSDataset(
+        schema, table, batch_size=2, read_threads=1,
+        line_parser=ReplicaCacheLineParser(cache, "cache_idx"),
+        quarantine_dir=str(tmp_path / "q"),
+    )
+    ds.set_date("20260101")
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+
+    st = ds.stats
+    assert (st.lines, st.parsed, st.skipped_benign, st.bad_lines) == (4, 1, 1, 2)
+    assert st.bad_by_file == {str(p): 2}
+    assert len(cache) == 1 and ds.memory_data_size() == 1
+    # the surviving record carries cache row 0 in the cache slot
+    assert int(ds.records[0].slot_keys(0)[0]) == 0
+    dl = read_dead_letter(st.dead_letter)
+    assert dl["summary"]["bad_lines"] == 2
+    assert [e["line"] for e in dl["entries"]] == [lines[0], lines[1]]
+    assert [e["line_no"] for e in dl["entries"]] == [1, 2]
+    assert ds.admission_report()["poisoned"]  # 2/4 lines over the default
 
 
 # ---- extended pull through the train step (single device vs mesh) -------

@@ -12,6 +12,17 @@ Usage:
       [--days N] [--passes N] [--rows N] [--seed N] \
       [--fs-flake-prob P] [--step-faults N] [--save-faults N] [--json]
 
+``--corrupt-rate P`` switches to the data-poisoning soak: every data line
+is corrupted with iid probability P (a seeded token flip that defeats both
+parser tiers), the supervisor runs the dirty schedule under
+``on_poisoned='degrade'``, and the run must (a) quarantine EXACTLY the
+injected lines — ``data.quarantine.bad_lines_total`` delta == injected
+count — and (b) finish bitwise-equal to a clean twin over the pre-cleaned
+filelist (the same files with the corrupted lines removed):
+
+  JAX_PLATFORMS=cpu python tools/chaos_probe.py --corrupt-rate 0.05 \
+      [--days N] [--passes N] [--rows N] [--seed N] [--json]
+
 ``--distributed N`` switches to the multi-rank soak instead: an N-rank
 in-process cluster (threads, real localhost TCP) runs ``--passes``
 shuffled distributed passes — ins_id shuffle through TcpShuffleRouter,
@@ -70,7 +81,7 @@ def write_day_files(tmpdir, date, n_passes, rows, seed):
     return files
 
 
-def build_supervisor(ckpt_root):
+def build_supervisor(ckpt_root, on_poisoned=None):
     import jax
     import optax
 
@@ -107,7 +118,7 @@ def build_supervisor(ckpt_root):
     sup = PassSupervisor(
         ds, tr, checkpoint=CheckpointManager(ckpt_root),
         retry=RetryPolicy(backoff_s=0.0, sleep=lambda s: None),
-        round_to=8,
+        round_to=8, on_poisoned=on_poisoned,
     )
     return table, tr, sup
 
@@ -123,16 +134,117 @@ def final_state(table, tr):
     return k, v, dense
 
 
-def run_schedule(tmpdir, tag, days, rules):
+def run_schedule(tmpdir, tag, days, rules, on_poisoned=None):
     from paddlebox_tpu.utils.faultinject import inject
 
-    table, tr, sup = build_supervisor(os.path.join(tmpdir, f"ckpt-{tag}"))
+    table, tr, sup = build_supervisor(
+        os.path.join(tmpdir, f"ckpt-{tag}"), on_poisoned=on_poisoned
+    )
     t0 = time.perf_counter()
     with inject(*rules) as plan:
         for date, files in days:
             sup.run_day(date, [[f] for f in files])
     wall = time.perf_counter() - t0
     return table, tr, sup, plan, wall
+
+
+def corrupt_day_files(files, out_dirty, out_clean, rate, seed):
+    """Write a dirty twin (iid token flips at ``rate``) and a pre-cleaned
+    twin (the corrupted lines REMOVED) of each file. Every flip replaces a
+    random token with a non-numeric one, so both parser tiers reject the
+    line. Returns (dirty_files, clean_files, n_corrupted)."""
+    rng = np.random.default_rng(seed + 77)
+    dirty_files, clean_files, n_bad = [], [], 0
+    for path in files:
+        lines = open(path).read().splitlines()
+        dirty, clean = [], []
+        for ln in lines:
+            if rng.random() < rate:
+                toks = ln.split(" ")
+                toks[int(rng.integers(0, len(toks)))] = (
+                    "!x%04x" % int(rng.integers(0, 1 << 16))
+                )
+                dirty.append(" ".join(toks))
+                n_bad += 1
+            else:
+                dirty.append(ln)
+                clean.append(ln)
+        base = os.path.basename(path)
+        dp = os.path.join(out_dirty, base)
+        cp = os.path.join(out_clean, base)
+        with open(dp, "w") as f:
+            f.write("\n".join(dirty) + "\n")
+        with open(cp, "w") as f:
+            f.write("\n".join(clean) + "\n" if clean else "")
+        dirty_files.append(dp)
+        clean_files.append(cp)
+    return dirty_files, clean_files, n_bad
+
+
+def run_corrupt(args):
+    """Data-poisoning soak: dirty schedule under on_poisoned='degrade'
+    vs a clean twin over the pre-cleaned filelist. Exit 0 iff the
+    quarantine counters account for every injected line AND the final
+    state is bitwise-equal."""
+    from paddlebox_tpu import config
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    config.set_flag("fs_open_backoff_s", 0.0)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        dirty_days, clean_days, injected = [], [], 0
+        for d in range(args.days):
+            date = f"202601{d + 1:02d}"
+            src = os.path.join(tmpdir, f"src-{d}")
+            dd = os.path.join(tmpdir, f"dirty-{d}")
+            cd = os.path.join(tmpdir, f"cleaned-{d}")
+            for p in (src, dd, cd):
+                os.makedirs(p)
+            files = write_day_files(
+                src, date, args.passes, args.rows, args.seed + d
+            )
+            df, cf, nb = corrupt_day_files(
+                files, dd, cd, args.corrupt_rate, args.seed + d
+            )
+            dirty_days.append((date, df))
+            clean_days.append((date, cf))
+            injected += nb
+
+        table_c, tr_c, sup_c, _, wall_c = run_schedule(
+            tmpdir, "clean", clean_days, ()
+        )
+        before = STAT_GET("data.quarantine.bad_lines_total")
+        table_i, tr_i, sup_i, _, wall_i = run_schedule(
+            tmpdir, "dirty", dirty_days, (), on_poisoned="degrade"
+        )
+        quarantined = int(STAT_GET("data.quarantine.bad_lines_total") - before)
+
+        k_c, v_c, d_c = final_state(table_c, tr_c)
+        k_i, v_i, d_i = final_state(table_i, tr_i)
+        equal = (
+            np.array_equal(k_i, k_c)
+            and np.array_equal(v_i, v_c)
+            and len(d_i) == len(d_c)
+            and all(np.array_equal(a, b) for a, b in zip(d_i, d_c))
+        )
+        counts_match = quarantined == injected
+        report = {
+            "mode": "corrupt-soak",
+            "corrupt_rate": args.corrupt_rate,
+            "days": args.days,
+            "passes_per_day": args.passes,
+            "injected_bad_lines": injected,
+            "quarantined_bad_lines": quarantined,
+            "counts_match": counts_match,
+            "degrade_incidents": sum(
+                1 for i in sup_i.incidents if i.kind == "data_poisoned"
+            ),
+            "incidents": [i.as_dict() for i in sup_i.incidents],
+            "bitwise_equal_to_clean": bool(equal),
+            "wall_clean_s": round(wall_c, 2),
+            "wall_injected_s": round(wall_i, 2),
+        }
+        print(json.dumps(report, indent=None if args.json else 2))
+        return 0 if (equal and counts_match) else 1
 
 
 def _dist_free_ports(n):
@@ -344,11 +456,17 @@ def main(argv=None):
     ap.add_argument("--send-flake-prob", type=float, default=0.15,
                     help="iid flake probability at transport.send "
                          "(--distributed mode)")
+    ap.add_argument("--corrupt-rate", type=float, default=0.0, metavar="P",
+                    help="iid per-line data corruption probability; "
+                         "switches to the quarantine/degrade soak "
+                         "(single-rank only)")
     ap.add_argument("--json", action="store_true", help="machine output only")
     args = ap.parse_args(argv)
 
     if args.distributed:
         return run_distributed(args)
+    if args.corrupt_rate > 0:
+        return run_corrupt(args)
 
     from paddlebox_tpu import config
     from paddlebox_tpu.utils.faultinject import fail_nth, fail_prob
