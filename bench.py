@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import tempfile
 import time
@@ -101,78 +100,24 @@ def write_files(tmpdir: str, rng, reuse_pool=None, prefix="part", pv=False) -> t
     return files, np.concatenate(pool_parts)
 
 
-def probe_backend(timeout_s: float):
-    """Initialize the jax backend in a SUBPROCESS with a hard timeout.
-
-    The TPU backend in this environment can wedge forever inside
-    ``make_c_api_client`` (observed round 2: BENCH_r02 rc=1 after the driver
-    gave up on a silent hang). A hung child is killable; a hung import in
-    this process is not. Returns (info_dict, None) on success or
-    (None, reason) on failure so main() can emit a diagnostic JSON line and
-    exit nonzero fast instead of hanging the driver.
+def apply_legacy_init_env() -> None:
+    """Map the historical PBOX_BENCH_INIT_* env knobs onto the
+    backendguard flags. The probe/retry/fallback logic that grew here now
+    lives in utils/backendguard.py (shared by every entrypoint); older
+    drivers and tools/tpu_capture.py still speak the bench-era env names:
+      PBOX_BENCH_INIT_RETRIES  -> backend_init_retries   (default 6)
+      PBOX_BENCH_INIT_TIMEOUT  -> backend_init_timeout_s (default 120s)
+      PBOX_BENCH_INIT_BACKOFF  -> backend_init_backoff_s (default 30s)
     """
-    code = (
-        "import jax, json; d = jax.devices(); "
-        "print(json.dumps({'platform': d[0].platform, 'n_devices': len(d)}))"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"backend init timed out after {timeout_s:.0f}s (wedged TPU init?)"
-    if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()[-3:]
-        return None, f"backend init failed rc={proc.returncode}: " + " | ".join(tail)
-    try:
-        return json.loads(proc.stdout.strip().splitlines()[-1]), None
-    except (ValueError, IndexError):
-        return None, f"backend probe produced no JSON: {proc.stdout[-200:]!r}"
+    from paddlebox_tpu import config as _config
 
-
-def probe_backend_with_retries(timeout_s: float):
-    """Probe the backend repeatedly with backoff before giving up on TPU.
-
-    The axon backend's wedges last hours-but-not-forever; a single probe
-    maximizes the chance of recording a CPU fallback on a chip that would
-    have come back mid-run. Budget is controlled by env:
-      PBOX_BENCH_INIT_RETRIES  number of probes (default 6)
-      PBOX_BENCH_INIT_TIMEOUT  per-probe subprocess watchdog (default 120s)
-      PBOX_BENCH_INIT_BACKOFF  first sleep between probes, doubled each
-                               time and capped at 120s (default 30s)
-    Worst case with defaults ~20 min before the CPU fallback runs — inside
-    a plausible driver timeout, with per-probe stderr progress throughout.
-    Returns (info, probe_log); info is None if every probe failed. Each
-    probe_log entry is {"ts", "elapsed_s", "ok", "detail"} — the multi-probe
-    wedge evidence recorded into the output JSON when TPU never comes up.
-    """
-    retries = max(1, int(os.environ.get("PBOX_BENCH_INIT_RETRIES", "6")))
-    backoff = float(os.environ.get("PBOX_BENCH_INIT_BACKOFF", "30"))
-    probe_log = []
-    for attempt in range(retries):
-        t0 = time.time()
-        info, err = probe_backend(timeout_s)
-        entry = {
-            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
-            "elapsed_s": round(time.time() - t0, 1),
-            "ok": err is None,
-            "detail": "ok" if err is None else err,
-        }
-        probe_log.append(entry)
-        # progress to stderr as it happens: a driver with a wall-clock
-        # watchdog must see life during the (up to ~25 min) retry budget,
-        # or it kills the run before the JSON evidence is ever emitted
-        print(f"[bench] probe {attempt + 1}/{retries}: {entry['detail']}",
-              file=sys.stderr, flush=True)
-        if err is None:
-            return info, probe_log
-        if attempt + 1 < retries:
-            time.sleep(min(backoff, 120.0))
-            backoff *= 2
-    return None, probe_log
+    for env, flag in (
+        ("PBOX_BENCH_INIT_TIMEOUT", "backend_init_timeout_s"),
+        ("PBOX_BENCH_INIT_RETRIES", "backend_init_retries"),
+        ("PBOX_BENCH_INIT_BACKOFF", "backend_init_backoff_s"),
+    ):
+        if env in os.environ:
+            _config.set_flag(flag, os.environ[env])
 
 
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -242,6 +187,14 @@ def read_probe_loop_tail(n: int = 30):
     return out or None
 
 
+def _plan_source() -> str:
+    """Provenance of the active kernel plan ("builtin defaults" or the
+    artifact path), for the bench JSON record."""
+    from paddlebox_tpu.ops.kernel_plan import get_plan
+
+    return get_plan().source
+
+
 def fail_fast(reason: str) -> None:
     print(
         json.dumps(
@@ -298,25 +251,31 @@ def wait_for_capture_lock() -> None:
 def main():
     profile = "--profile" in sys.argv
     wait_for_capture_lock()
-    timeout_s = float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "120"))
-    info, probe_log = probe_backend_with_retries(timeout_s)
-    tpu_error = None
-    if info is None:
-        # Wedged/absent accelerator after the full retry budget: fall back to
-        # the CPU backend so the driver still records a real end-to-end number
-        # (clearly labeled with platform + the per-probe wedge evidence +
-        # the last measurement taken on a healthy chip) instead of nothing.
-        tpu_error = probe_log[-1]["detail"]
-        import jax
+    apply_legacy_init_env()
+    from paddlebox_tpu.utils.backendguard import ensure_backend
 
-        try:
-            jax.config.update("jax_platforms", "cpu")
-            info = {"platform": jax.devices()[0].platform, "n_devices": jax.device_count()}
-        except Exception as e:  # CPU fallback itself failed: diagnose fast
-            fail_fast(f"{tpu_error}; cpu fallback failed: {e!r}")
+    try:
+        verdict = ensure_backend()
+    except Exception as e:  # even the CPU fallback failed: diagnose fast
+        fail_fast(f"backend bring-up failed: {e!r}")
+    info = {"platform": verdict.platform, "n_devices": verdict.n_devices}
+    probe_log = verdict.probe_log
+    tpu_error = verdict.error if verdict.wedged else None
 
     import jax
     import optax
+
+    # persistent XLA compile cache: PBOX_COMPILE_CACHE_DIR (or the
+    # compile_cache_dir flag) points at a durable directory; "auto" stays
+    # off here — bench owns no checkpoint root (the supervisor resolves
+    # "auto" under its own). Enabled before any compilation so warmup_s
+    # becomes a cold-vs-warm pair across consecutive runs.
+    from paddlebox_tpu import config as _cfg
+    from paddlebox_tpu.utils import compilecache
+
+    cache_dir = compilecache.resolve_dir(str(_cfg.get_flag("compile_cache_dir")))
+    if cache_dir is not None:
+        compilecache.enable(cache_dir)
 
     from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
     from paddlebox_tpu.models import DeepFM, RankDeepFM
@@ -547,6 +506,37 @@ def main():
             )
         },
         "warmup_s": round(warmup_s, 3),
+        # backend bring-up verdict (utils/backendguard): "ok" or
+        # "fallback_cpu" — the full probe_log rides in tpu_probe_log above
+        "backend_init": {
+            k: v for k, v in verdict.as_dict().items() if k != "probe_log"
+        },
+        # persistent-compile-cache counters: a cold run shows hits == 0,
+        # the next identical run shows hits > 0 and a smaller warmup_s
+        "compile_cache": compilecache.stats(),
+        # bytes actually crossing the boundary wire this run (STAT
+        # counters at the ops/wire_quant choke points) + the compiled ICI
+        # a2a payload — the measured side of the wire_dtype claims
+        "wire": {
+            "wire_dtype": str(_config.get_flag("wire_dtype")),
+            "fetch_rows": int(STAT_GET("wire.fetch_rows_total")),
+            "fetch_bytes": int(STAT_GET("wire.fetch_bytes_total")),
+            "fetch_fp32_bytes": int(STAT_GET("wire.fetch_fp32_bytes_total")),
+            "send_rows": int(STAT_GET("wire.send_rows_total")),
+            "send_bytes": int(STAT_GET("wire.send_bytes_total")),
+            "send_fp32_bytes": int(STAT_GET("wire.send_fp32_bytes_total")),
+            "a2a_payload_bytes": int(STAT_GET("wire.a2a_payload_bytes")),
+            "a2a_fp32_bytes": int(STAT_GET("wire.a2a_fp32_bytes")),
+            "a2a_dtype_bits": int(STAT_GET("wire.a2a_dtype_bits")),
+        },
+        # which kernel plan routed pull/push this run, and how often it
+        # chose pallas (ops/kernel_plan.py; regenerate with
+        # tools/tune_kernels.py)
+        "kernel_plan": {
+            "source": _plan_source(),
+            "selects": int(STAT_GET("kernel_plan.selects")),
+            "selects_pallas": int(STAT_GET("kernel_plan.selects_pallas")),
+        },
         # pass-prepare pad sweep (native pbx_block_stats counter sweep):
         # must stay a small fraction of train_pass_s at any pass size
         "prepare_s": round(getattr(trainer, "last_prepare_s", -1.0), 3),

@@ -22,21 +22,25 @@ from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import FeatureType, ValueLayout
 
 
-def _use_pallas(table: jnp.ndarray, n_idx: int) -> bool:
-    """Pallas row-DMA kernels: opt-in, TPU-only, lane-aligned widths only
-    (see ops/pallas_kernels.py for measured XLA-vs-pallas numbers)."""
-    if not config.get_flag("use_pallas_sparse"):
-        return False
-    from paddlebox_tpu.ops.pallas_kernels import _BLK, LANE, backend_is_tpu
+def _impl_for(op: str, table: jnp.ndarray, n_idx: int, unique_rows: bool = True) -> str:
+    """KernelPlan lookup for one op instance (ops/kernel_plan.py): per-shape
+    pallas-vs-native routing, resolved at trace time from the committed plan
+    artifact (or the builtin defaults, which honor ``use_pallas_sparse``)."""
+    from paddlebox_tpu.ops.kernel_plan import current_backend, get_plan
 
-    if table.shape[1] % LANE != 0 or n_idx % _BLK != 0:
-        return False
-    return backend_is_tpu()
+    return get_plan().select(
+        op,
+        current_backend(),
+        table.shape[0],
+        table.shape[1],
+        n_idx,
+        unique_rows=unique_rows,
+    )
 
 
 def _gather_rows(table: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
-    """Row gather: XLA take, or the Pallas row-DMA kernel when eligible."""
-    if _use_pallas(table, rows.shape[0]):
+    """Row gather: XLA take, or the Pallas row-DMA kernel when planned."""
+    if _impl_for("pull", table, rows.shape[0]) == "pallas":
         from paddlebox_tpu.ops.pallas_kernels import pull_rows_pallas
 
         return pull_rows_pallas(table, rows)
@@ -137,11 +141,11 @@ def push_sparse_rows(
     new_rows = sparse_update_rows(
         old, grads, show_counts, clk_counts, layout, opt, lr_scale
     )
-    if _use_pallas(table, rows.shape[0]) and config.get_flag(
-        "enable_pullpush_dedup_keys"
-    ):
-        # dedup'd rows are unique (pad-row repeats write identical
-        # contents), so per-row set == scatter-add of deltas
+    # dedup'd rows are unique (pad-row repeats write identical contents), so
+    # the pallas per-row SET == scatter-add of deltas; without dedup the
+    # plan clamps to native (unique_rows=False makes pallas ineligible)
+    unique_rows = bool(config.get_flag("enable_pullpush_dedup_keys"))
+    if _impl_for("push", table, rows.shape[0], unique_rows=unique_rows) == "pallas":
         from paddlebox_tpu.ops.pallas_kernels import write_rows_pallas
 
         return write_rows_pallas(table, rows, new_rows)

@@ -43,6 +43,7 @@ import numpy as np
 import ml_dtypes
 
 from paddlebox_tpu import config  # flags wire_dtype / ici_wire_dtype live there
+from paddlebox_tpu.utils.monitor import STAT_ADD
 
 BF16 = ml_dtypes.bfloat16
 
@@ -89,6 +90,14 @@ def fetch_rows_start(arr, layout, mode: str):
     import jax.numpy as jnp
 
     mode = _check(mode)
+    # bytes-on-wire accounting at the choke point every boundary D2H routes
+    # through (carrier departing-slice fetch, flush, classic writeback) —
+    # the measurement the quantized-wire roadmap claim is graded against
+    STAT_ADD("wire.fetch_rows_total", arr.shape[0])
+    STAT_ADD("wire.fetch_bytes_total", row_wire_nbytes(arr.shape[0], layout, mode))
+    STAT_ADD(
+        "wire.fetch_fp32_bytes_total", row_wire_nbytes(arr.shape[0], layout, "fp32")
+    )
     if mode == "fp32":
         return {"mode": mode, "raw": arr}
     if mode == "bf16":
@@ -141,6 +150,13 @@ def send_rows(arr: np.ndarray, layout, mode: str):
     import jax.numpy as jnp
 
     mode = _check(mode)
+    # H2D twin of the fetch_rows_start accounting (carrier new-key upload,
+    # dist_ws block upload)
+    STAT_ADD("wire.send_rows_total", arr.shape[0])
+    STAT_ADD("wire.send_bytes_total", row_wire_nbytes(arr.shape[0], layout, mode))
+    STAT_ADD(
+        "wire.send_fp32_bytes_total", row_wire_nbytes(arr.shape[0], layout, "fp32")
+    )
     if mode == "fp32":
         return jnp.asarray(arr)
     if mode == "bf16":

@@ -47,8 +47,25 @@ def _compressed_a2a(recs, axis_name, head: int, sections):
     shared scale would quantize the smaller family to noise (the same
     per-block rule as the row wire, ops/wire_quant.py)."""
     from paddlebox_tpu import config as _config
+    from paddlebox_tpu.utils.monitor import STAT_SET
 
     wd = str(_config.get_flag("ici_wire_dtype"))
+    # bytes-on-wire accounting for the compiled collective. Shapes are
+    # static, so this is exact per-call payload — recorded at TRACE time
+    # (STAT_SET, not ADD: a retrace must not double-count) alongside the
+    # fp32 baseline it displaces, so bench/capture artifacts can report
+    # the measured ICI compression ratio instead of asserting it.
+    n, K, W = int(recs.shape[0]), int(recs.shape[1]), int(recs.shape[2])
+    if wd == "bf16":
+        payload = n * K * (head * 4 + (W - head) * 2)
+    elif wd == "int8":
+        q_cols = sum(b - a for a, b in sections)
+        payload = n * K * (head * 4 + q_cols + len(sections) * 4)
+    else:
+        payload = n * K * W * 4
+    STAT_SET("wire.a2a_payload_bytes", payload)
+    STAT_SET("wire.a2a_fp32_bytes", n * K * W * 4)
+    STAT_SET("wire.a2a_dtype_bits", {"bf16": 16, "int8": 8}.get(wd, 32))
     if wd == "bf16":
         counts = lax.all_to_all(recs[:, :, :head], axis_name, 0, 0, tiled=True)
         vals = lax.all_to_all(

@@ -1,0 +1,155 @@
+"""Persistent XLA compile cache: kill warmup variance across runs.
+
+``warmup_s`` swung 8-33s across bench rounds because every process paid
+full XLA compilation of the same programs (same shapes — the pad-bucket
+discipline exists precisely so shapes repeat). jax ships a persistent
+compilation cache keyed on the HLO; pointing it at a durable directory
+turns warmup into a cold-vs-warm PAIR: the first run compiles and
+populates, every later run (or process) with identical programs loads the
+compiled executable from disk.
+
+This module is the one place that enables it and counts it:
+
+- :func:`enable` wires ``jax_compilation_cache_dir`` (plus the thresholds
+  that would otherwise skip small/fast CPU programs — the tier-1 suite and
+  the CPU-fallback bench must be able to verify the machinery without a
+  TPU) and registers a ``jax.monitoring`` listener ONCE per process.
+- hit/miss counters surface as ``compile_cache.*`` stats and through
+  :func:`stats`, which bench.py embeds in its JSON so a cold run
+  (hits == 0) and a warm run (hits > 0, lower ``warmup_s``) are
+  distinguishable in the artifact record.
+
+Resolution policy (``compile_cache_dir`` flag): "auto" means "under the
+durable checkpoint root" — the trainer supervisor resolves it to
+``<ckpt_root>/compile_cache`` next to the checkpoints whose job it warms;
+entrypoints without a checkpoint root (bench.py) treat "auto" as off
+unless an explicit directory is given. "off"/"" disables.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from paddlebox_tpu import config
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_GET
+
+config.define_flag(
+    "compile_cache_dir",
+    "auto",
+    "persistent XLA compile cache directory: 'auto' resolves to "
+    "<checkpoint_root>/compile_cache when a supervisor owns a checkpoint "
+    "root (and stays off for root-less entrypoints unless set explicitly); "
+    "'off' disables; any other value is the cache directory itself",
+)
+
+_lock = threading.Lock()
+_state = {"dir": None, "listener": False}  # guarded-by: _lock
+
+def _listener(event: str, **kwargs) -> None:
+    # jax.monitoring event -> our stat, one literal per branch
+    if event == "/jax/compilation_cache/cache_hits":
+        STAT_ADD("compile_cache.hits")
+    elif event == "/jax/compilation_cache/cache_misses":
+        STAT_ADD("compile_cache.misses")
+    elif event == "/jax/compilation_cache/compile_requests_use_cache":
+        STAT_ADD("compile_cache.requests")
+
+
+def resolve_dir(flag_value: str, ckpt_root: Optional[str] = None) -> Optional[str]:
+    """compile_cache_dir flag -> concrete directory or None (disabled)."""
+    v = (flag_value or "").strip()
+    if v in ("", "off", "none"):
+        return None
+    if v == "auto":
+        if ckpt_root:
+            return os.path.join(ckpt_root, "compile_cache")
+        return None
+    return v
+
+
+def enable(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Idempotent; re-pointing at a different directory is allowed (the cache
+    is process-global, so the last enable wins — jax reads the config at
+    each compile). Returns the directory. Thresholds are dropped to zero so
+    CPU-sized programs cache too — without that, the machinery is
+    unverifiable anywhere but on a real accelerator.
+    """
+    import jax
+
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # pragma: no cover - option absent on older jax
+        pass
+    try:
+        # jax LATCHES cache-unused at the first compile that ran without a
+        # cache dir (is_cache_used checks once per task); any entrypoint
+        # that compiled anything before calling enable() would silently get
+        # no caching at all. reset_cache() clears the latch so the next
+        # compile re-evaluates against the directory just configured.
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:  # pragma: no cover - internal API drift
+        pass
+    with _lock:
+        _state["dir"] = cache_dir
+        if not _state["listener"]:
+            try:
+                from jax._src import monitoring
+
+                monitoring.register_event_listener(_listener)
+                _state["listener"] = True
+            except Exception:  # pragma: no cover - counters degrade to 0
+                pass
+    return cache_dir
+
+
+def enabled_dir() -> Optional[str]:
+    with _lock:
+        return _state["dir"]
+
+
+def disable() -> None:
+    """Undo :func:`enable`: detach jax from the cache directory and clear
+    the cache-used latch. The cache is process-global state — tests that
+    build a supervisor (which enables it under the checkpoint root) use
+    this to keep the setting from leaking into every later test."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    with _lock:
+        _state["dir"] = None
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:  # pragma: no cover - internal API drift
+        pass
+
+
+def stats() -> Dict:
+    """Counters + entry census for artifact embedding (bench JSON,
+    tpu_capture artifacts). ``hits``/``misses`` are process-lifetime."""
+    d = enabled_dir()
+    entries = 0
+    if d is not None:
+        try:
+            entries = sum(1 for n in os.listdir(d) if n.endswith("-cache"))
+        except OSError:
+            entries = -1  # dir vanished under us; label, don't crash
+    return {
+        "enabled": d is not None,
+        "dir": d,
+        "hits": int(STAT_GET("compile_cache.hits")),
+        "misses": int(STAT_GET("compile_cache.misses")),
+        "requests": int(STAT_GET("compile_cache.requests")),
+        "entries": entries,
+    }

@@ -54,6 +54,11 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             file is opened/read — an injected failure is a
                             synthetic unreadable file (quarantined whole in
                             data_quarantine mode)
+    backend.init            utils/backendguard.py  before each subprocess
+                            backend-init probe — an injected failure is a
+                            simulated wedged TPU runtime, exercising the
+                            watchdog + CPU-fallback path without owning a
+                            wedgeable chip
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -98,6 +103,7 @@ KNOWN_SITES = (
     "boundary.writeback",
     "parser.parse_line",
     "data.file_read",
+    "backend.init",
 )
 
 

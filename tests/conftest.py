@@ -17,8 +17,23 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _isolate_compile_cache():
+    """The persistent XLA compile cache (utils/compilecache) is
+    process-global jax state. A supervisor built inside one test enables it
+    under that test's tmp checkpoint root; left in place it changes compile
+    behavior for every later test in the process. Detach it after each
+    test so suite results never depend on test order."""
+    yield
+    from paddlebox_tpu.utils import compilecache
+
+    if compilecache.enabled_dir() is not None:
+        compilecache.disable()
 
 
 def pytest_configure(config):

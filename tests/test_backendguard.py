@@ -1,0 +1,178 @@
+"""Backend-init watchdog (utils/backendguard.py) and the persistent XLA
+compile cache (utils/compilecache.py): wedged init must fall back to CPU
+inside the configured deadline, and a warm cache must report hits."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.utils import compilecache
+from paddlebox_tpu.utils.backendguard import (
+    BackendVerdict,
+    ensure_backend,
+    probe_backend,
+    probe_backend_with_retries,
+)
+from paddlebox_tpu.utils.faultinject import fail_always, fail_once, inject
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+
+def test_wedged_init_falls_back_to_cpu_within_deadline():
+    """The acceptance scenario: every probe wedges (injected at the
+    backend.init site), and ensure_backend must return a labeled
+    fallback_cpu verdict within retries x timeout — not hang."""
+    timeout_s, retries = 2.0, 3
+    deadline = retries * timeout_s + 5.0
+    slept = []
+    t0 = time.monotonic()
+    with inject(fail_always("backend.init")) as plan:
+        v = ensure_backend(
+            timeout_s=timeout_s,
+            retries=retries,
+            backoff_s=0.0,
+            probe="always",
+            sleep=slept.append,  # no real sleeping between probes
+        )
+        assert plan.failures("backend.init") == retries
+    elapsed = time.monotonic() - t0
+    assert elapsed <= deadline
+    assert v.verdict == "fallback_cpu"
+    assert v.wedged and v.probed
+    assert v.platform == "cpu" and v.n_devices >= 1
+    assert "wedged" in (v.error or "")
+    assert len(v.probe_log) == retries
+    assert all(not e["ok"] for e in v.probe_log)
+    assert len(slept) == retries - 1  # backoff between probes, not after last
+    assert STAT_GET("backend.init_wedged") == 1
+    # work continues on the fallback: the process has a live CPU backend
+    assert float(jnp.sum(jnp.ones(4))) == 4.0
+
+
+def test_wedged_verdict_serializes_for_artifacts():
+    with inject(fail_always("backend.init")):
+        v = ensure_backend(
+            timeout_s=1.0, retries=1, probe="always", sleep=lambda s: None
+        )
+    d = v.as_dict()
+    assert d["verdict"] == "fallback_cpu"
+    assert d["wedged"] is True
+    assert d["error"] and d["probe_log"]
+    # ok verdicts omit the failure fields entirely
+    ok = BackendVerdict(platform="cpu", n_devices=1, verdict="ok").as_dict()
+    assert "error" not in ok and "probe_log" not in ok
+
+
+def test_initialized_backend_short_circuits():
+    """probe='auto' with a live in-process backend: no subprocess, verdict
+    ok immediately (the zero-cost CI path)."""
+    jnp.zeros(1).block_until_ready()  # force backend init
+    before = STAT_GET("backend.init_probes")
+    v = ensure_backend()
+    assert v.verdict == "ok" and not v.probed and not v.wedged
+    assert v.platform == jax.default_backend()
+    assert STAT_GET("backend.init_probes") == before  # no probe ran
+
+
+@pytest.mark.slow
+def test_real_subprocess_probe_succeeds_on_cpu():
+    """The actual watchdog path: a child python initializes jax and
+    reports its platform (CPU here; TPU on hardware)."""
+    info, err = probe_backend(timeout_s=180.0)
+    assert err is None, err
+    assert info["platform"] in ("cpu", "tpu", "gpu")
+    assert info["n_devices"] >= 1
+
+
+@pytest.mark.slow
+def test_retry_recovers_from_transient_wedge():
+    """fail_once wedges the first probe only; the second real probe
+    succeeds and the log records one failure then one success."""
+    with inject(fail_once("backend.init")) as plan:
+        info, log = probe_backend_with_retries(
+            timeout_s=180.0, retries=2, backoff_s=0.0, sleep=lambda s: None
+        )
+        assert plan.failures("backend.init") == 1
+    assert info is not None
+    assert [e["ok"] for e in log] == [False, True]
+
+
+def test_ensure_backend_rejects_bad_probe_mode():
+    with pytest.raises(ValueError):
+        ensure_backend(probe="sometimes")
+
+
+def test_resolve_dir_policy(tmp_path):
+    for off in ("", "off", "none", None):
+        assert compilecache.resolve_dir(off) is None
+    # "auto" only engages under a durable checkpoint root
+    assert compilecache.resolve_dir("auto") is None
+    assert compilecache.resolve_dir("auto", ckpt_root=str(tmp_path)) == str(
+        tmp_path / "compile_cache"
+    )
+    explicit = str(tmp_path / "cc")
+    assert compilecache.resolve_dir(explicit) == explicit
+
+
+def test_compile_cache_counts_hits(tmp_path):
+    """Enable the persistent cache, compile the same program twice from
+    distinct function objects: the second compile must be served from disk
+    and counted as a hit — the mechanism behind the cold/warm warmup_s
+    acceptance check in bench.py."""
+    cache_dir = str(tmp_path / "compile_cache")
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        got = compilecache.enable(cache_dir)
+        assert got == cache_dir and os.path.isdir(cache_dir)
+        assert compilecache.enabled_dir() == cache_dir
+
+        hits0 = STAT_GET("compile_cache.hits")
+        misses0 = STAT_GET("compile_cache.misses")
+        x = jnp.arange(64, dtype=jnp.float32)
+
+        f_cold = jax.jit(lambda v: v * 3.0 + 1.0)
+        cold = np.asarray(f_cold(x))
+        assert STAT_GET("compile_cache.misses") > misses0  # populated disk
+        assert len(os.listdir(cache_dir)) > 0
+
+        # a DISTINCT function object with an identical jaxpr: jax's
+        # in-memory jit cache can't serve it, the persistent cache must
+        f_warm = jax.jit(lambda v: v * 3.0 + 1.0)
+        warm = np.asarray(f_warm(x))
+        assert STAT_GET("compile_cache.hits") > hits0
+        np.testing.assert_array_equal(cold, warm)
+
+        s = compilecache.stats()
+        assert s["enabled"] and s["dir"] == cache_dir
+        assert s["hits"] >= 1 and s["misses"] >= 1
+        assert s["requests"] >= s["hits"] + s["misses"] - 1
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+
+
+def test_legacy_env_maps_to_flags(monkeypatch):
+    """PBOX_BENCH_INIT_* env (the pre-flag interface tpu_probe_loop and
+    operators already use) must keep working by mapping onto the
+    backend_init_* flags."""
+    import bench
+
+    old = {k: config.get_flag(k) for k in
+           ("backend_init_timeout_s", "backend_init_retries",
+            "backend_init_backoff_s")}
+    monkeypatch.setenv("PBOX_BENCH_INIT_TIMEOUT", "7.5")
+    monkeypatch.setenv("PBOX_BENCH_INIT_RETRIES", "2")
+    monkeypatch.setenv("PBOX_BENCH_INIT_BACKOFF", "0.25")
+    try:
+        bench.apply_legacy_init_env()
+        assert float(config.get_flag("backend_init_timeout_s")) == 7.5
+        assert int(config.get_flag("backend_init_retries")) == 2
+        assert float(config.get_flag("backend_init_backoff_s")) == 0.25
+    finally:
+        for k, v in old.items():
+            config.set_flag(k, v)

@@ -1,0 +1,292 @@
+"""KernelPlan registry: per-shape pallas-vs-native routing for pull/push
+(ops/kernel_plan.py), plan artifact round-trip, eligibility clamps, and
+bitwise identity of the two implementations at eligible shapes."""
+
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.ops.kernel_plan import (
+    PALLAS_BLK,
+    PALLAS_LANE,
+    KernelPlan,
+    PlanEntry,
+    default_plan,
+    get_plan,
+    invalidate_plan,
+    log2_bucket,
+    pallas_eligible,
+    resolve_plan_path,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan_cache():
+    """Every test resolves its plan fresh and leaves no cache behind."""
+    invalidate_plan()
+    yield
+    config.set_flag("kernel_plan_path", "auto")
+    config.set_flag("use_pallas_sparse", False)
+    invalidate_plan()
+
+
+def test_log2_bucket_boundaries():
+    # all n in (2^(k-1), 2^k] share bucket k — exact powers stay put,
+    # the next integer starts the next band
+    assert log2_bucket(1) == 0
+    assert log2_bucket(2) == 1
+    assert log2_bucket(3) == 2
+    assert log2_bucket(4) == 2
+    assert log2_bucket(5) == 3
+    for k in (10, 17, 20):
+        assert log2_bucket(2**k) == k
+        assert log2_bucket(2**k + 1) == k + 1
+    # deterministic: same n, same bucket, always
+    assert all(log2_bucket(131072) == 17 for _ in range(3))
+
+
+def test_plan_round_trip(tmp_path):
+    plan = KernelPlan(
+        entries=[
+            PlanEntry(op="pull", backend="tpu", impl="native", width=128),
+            PlanEntry(
+                op="push", backend="tpu", impl="pallas",
+                width=128, rows_log2=20, uniq_log2=17, why="measured",
+            ),
+        ],
+        fallback="native",
+        source="test",
+    )
+    p = tmp_path / "plan.json"
+    plan.save(str(p))
+    loaded = KernelPlan.load(str(p))
+    assert loaded.to_json()["entries"] == plan.to_json()["entries"]
+    assert loaded.fallback == "native"
+    # the loaded plan answers identically across the whole key space
+    for op in ("pull", "push"):
+        for backend in ("tpu", "cpu"):
+            for n_rows, n_idx in ((1 << 20, 1 << 17), (100, 8)):
+                assert loaded.preferred(
+                    op, backend, n_rows, 128, n_idx
+                ) == plan.preferred(op, backend, n_rows, 128, n_idx)
+
+
+def test_unknown_shape_falls_back():
+    plan = KernelPlan(
+        entries=[
+            PlanEntry(
+                op="push", backend="tpu", impl="pallas",
+                width=128, rows_log2=20, uniq_log2=17,
+            )
+        ],
+        fallback="native",
+    )
+    # exact bucket hit
+    assert plan.preferred("push", "tpu", 1 << 20, 128, 1 << 17) == "pallas"
+    # anything off-key: other width, other bucket, other op, other backend
+    assert plan.preferred("push", "tpu", 1 << 20, 256, 1 << 17) == "native"
+    assert plan.preferred("push", "tpu", 1 << 10, 128, 1 << 17) == "native"
+    assert plan.preferred("pull", "tpu", 1 << 20, 128, 1 << 17) == "native"
+    assert plan.preferred("push", "cpu", 1 << 20, 128, 1 << 17) == "native"
+
+
+def test_probe_order_specificity():
+    """Exact bucket beats width-wildcards beats the (op, backend) catch-all."""
+    plan = KernelPlan(
+        entries=[
+            PlanEntry(op="push", backend="tpu", impl="native"),  # catch-all
+            PlanEntry(op="push", backend="tpu", impl="native", width=128),
+            PlanEntry(
+                op="push", backend="tpu", impl="pallas",
+                width=128, rows_log2=20, uniq_log2=17,
+            ),
+        ],
+        fallback="native",
+    )
+    assert plan.preferred("push", "tpu", 1 << 20, 128, 1 << 17) == "pallas"
+    assert plan.preferred("push", "tpu", 1 << 20, 128, 1 << 10) == "native"
+    assert plan.preferred("push", "tpu", 1 << 20, 64, 1 << 17) == "native"
+
+
+def test_eligibility_clamps():
+    """A plan may PREFER pallas; select() must clamp every ineligible
+    shape to native — the artifact cannot route into a miscompile."""
+    plan = KernelPlan(entries=[], fallback="pallas")
+    # off-TPU: always native
+    assert plan.select("pull", "cpu", 1000, 128, 64) == "native"
+    # width not lane-aligned
+    assert plan.select("pull", "tpu", 1000, 21, 64) == "native"
+    # index count not block-aligned
+    assert plan.select("pull", "tpu", 1000, 128, 63) == "native"
+    # push without unique rows (dedup off): per-row SET would be
+    # last-write-wins instead of merged
+    assert plan.select("push", "tpu", 1000, 128, 64, unique_rows=False) == "native"
+    # fully eligible: the preference goes through
+    assert plan.select("pull", "tpu", 1000, 128, 64) == "pallas"
+    assert plan.select("push", "tpu", 1000, 128, 64, unique_rows=True) == "pallas"
+    # the clamp mirrors pallas_eligible exactly
+    assert pallas_eligible("pull", "tpu", PALLAS_LANE, PALLAS_BLK)
+    assert not pallas_eligible("pull", "cpu", PALLAS_LANE, PALLAS_BLK)
+    assert not pallas_eligible("push", "tpu", PALLAS_LANE, PALLAS_BLK, False)
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError, match="duplicate"):
+        KernelPlan(entries=[
+            PlanEntry(op="pull", backend="tpu", impl="native", width=128),
+            PlanEntry(op="pull", backend="tpu", impl="pallas", width=128),
+        ])
+    with pytest.raises(ValueError, match="op"):
+        KernelPlan(entries=[PlanEntry(op="frobnicate", backend="tpu", impl="native")])
+    with pytest.raises(ValueError, match="impl"):
+        KernelPlan(entries=[PlanEntry(op="pull", backend="tpu", impl="cuda")])
+    with pytest.raises(ValueError, match="fallback"):
+        KernelPlan(fallback="cuda")
+
+
+def test_default_plan_honors_legacy_flag():
+    config.set_flag("use_pallas_sparse", False)
+    assert default_plan().fallback == "native"
+    config.set_flag("use_pallas_sparse", True)
+    assert default_plan().fallback == "pallas"
+
+
+def test_plan_file_loading_via_flag(tmp_path):
+    p = tmp_path / "custom_plan.json"
+    KernelPlan(
+        entries=[PlanEntry(op="pull", backend="cpu", impl="native", why="t")],
+        source="will-be-replaced-by-path",
+    ).save(str(p))
+    config.set_flag("kernel_plan_path", str(p))
+    invalidate_plan()
+    plan = get_plan()
+    assert plan.source == str(p)
+    # cache keys on the flag: flipping to "off" re-resolves to builtins
+    config.set_flag("kernel_plan_path", "off")
+    assert get_plan().source.startswith("builtin-default")
+
+
+def test_resolve_plan_path():
+    for off in ("", "off", "none"):
+        assert resolve_plan_path(off) is None
+    with pytest.raises(FileNotFoundError):
+        resolve_plan_path("/nonexistent/kernel_plan.json")
+    # "auto" finds the committed artifact (this repo ships one)
+    assert resolve_plan_path("auto") == os.path.join(
+        REPO, "tools", "kernel_plan.json"
+    )
+
+
+def test_committed_plan_is_loadable_and_native_off_tpu():
+    """The committed tools/kernel_plan.json must always load, and every
+    selection off-TPU must be native (eligibility clamp regardless of
+    artifact content)."""
+    plan = KernelPlan.load(os.path.join(REPO, "tools", "kernel_plan.json"))
+    for op in ("pull", "push"):
+        for n_rows, width, n_idx in ((1 << 20, 128, 1 << 17), (96, 21, 24)):
+            assert plan.select(op, "cpu", n_rows, width, n_idx) == "native"
+
+
+def test_pallas_native_identity_pull():
+    """Gather via the pallas row-DMA kernel (interpret mode) must be
+    BITWISE identical to jnp.take — a DMA copies bytes, so any eligible
+    shape may be routed either way without changing training."""
+    from paddlebox_tpu.ops.pallas_kernels import pull_rows_pallas
+
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(256, PALLAS_LANE)).astype(np.float32))
+    rows = jnp.asarray(rng.integers(0, 256, 64).astype(np.int32))
+    via_pallas = np.asarray(pull_rows_pallas(table, rows, interpret=True))
+    via_native = np.asarray(jnp.take(table, rows, axis=0))
+    assert np.array_equal(via_pallas, via_native)
+
+
+def test_pallas_native_identity_push_write():
+    """Writeback via the pallas kernel (interpret) must be bitwise equal
+    to scatter-SET of the same new rows (unique indices — the regime the
+    plan's unique_rows clamp guarantees)."""
+    from paddlebox_tpu.ops.pallas_kernels import write_rows_pallas
+
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(rng.normal(size=(128, PALLAS_LANE)).astype(np.float32))
+    rows = jnp.asarray(rng.permutation(128)[:PALLAS_BLK * 3].astype(np.int32))
+    new = jnp.asarray(
+        rng.normal(size=(PALLAS_BLK * 3, PALLAS_LANE)).astype(np.float32)
+    )
+    via_pallas = np.asarray(
+        write_rows_pallas(jnp.array(table), rows, new, interpret=True)
+    )
+    via_native = np.asarray(jnp.array(table).at[rows].set(new))
+    assert np.array_equal(via_pallas, via_native)
+
+
+def test_select_runs_through_pull_push():
+    """The ops layer has no residual direct gate: _impl_for consults the
+    active plan, so a plan swap changes routing with no code change."""
+    from paddlebox_tpu.ops.pull_push import _impl_for
+
+    t = jnp.zeros((64, 128))
+    assert _impl_for("pull", t, 64) == "native"  # cpu: clamped regardless
+    config.set_flag("use_pallas_sparse", True)
+    config.set_flag("kernel_plan_path", "off")
+    invalidate_plan()
+    assert _impl_for("pull", t, 64) == "native"  # still cpu-clamped
+
+
+def test_tune_kernels_default_smoke(tmp_path):
+    out = tmp_path / "plan.json"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tune_kernels.py"),
+         "--default", "--out", str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr
+    plan = KernelPlan.load(str(out))
+    assert {(e.op, e.impl) for e in plan.entries} == {
+        ("pull", "native"), ("push", "native"),
+    }
+    assert all(e.why for e in plan.entries)  # provenance is mandatory
+
+
+def test_tune_kernels_artifact_conversion(tmp_path):
+    """A measured sweep artifact where pallas wins must produce a pallas
+    push entry at the measured bucket (and its width generalization);
+    a native win or a hysteresis miss must produce native."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from tune_kernels import entries_from_artifact
+    finally:
+        sys.path.pop(0)
+
+    def art(native_ms, pallas_ms):
+        return {
+            "version": 1,
+            "backend": "tpu",
+            "shape": {"rows": 2514944, "u": 131072, "w": 21},
+            "points": {
+                "w128": {"ms": native_ms},
+                "pallas": {"ms": pallas_ms},
+            },
+        }
+
+    wins = entries_from_artifact(art(10.0, 5.0), min_speedup=1.1)
+    assert [e.impl for e in wins] == ["pallas", "pallas"]
+    assert wins[0].rows_log2 == log2_bucket(2514944)
+    assert wins[0].uniq_log2 == log2_bucket(131072)
+    assert wins[1].rows_log2 is None  # the width-only generalization
+    loses = entries_from_artifact(art(5.0, 10.0), min_speedup=1.1)
+    assert [e.impl for e in loses] == ["native", "native"]
+    # hysteresis: a 5% win under a 1.1 min-speedup stays native
+    close = entries_from_artifact(art(10.0, 9.5), min_speedup=1.1)
+    assert [e.impl for e in close] == ["native", "native"]
+    # a cpu-backend artifact proves nothing about the tpu crossover
+    cpu_art = art(10.0, 5.0)
+    cpu_art["backend"] = "cpu"
+    assert entries_from_artifact(cpu_art, min_speedup=1.1) == []

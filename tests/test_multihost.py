@@ -799,9 +799,20 @@ def test_duplicate_replayed_frames_dropped_by_seq():
         assert t0.recv("shuffle:0/n", 1, timeout=5.0) == b"payload-1"
         s.close()
 
-        # "reconnect" that ignores the ack and replays the whole round
+        # "reconnect" that ignores the ack and replays the whole round.
+        # Seqs 2-3 are delivered by the receiver thread and can lag this
+        # reconnect under load: poll until the advertised count covers the
+        # whole round before replaying.
+        import time as _time
+
         dups_before = STAT_GET("transport.dup_frames_dropped")
-        s2, acked = connect()
+        deadline = _time.monotonic() + 5.0
+        while True:
+            s2, acked = connect()
+            if acked == 3 or _time.monotonic() > deadline:
+                break
+            s2.close()
+            _time.sleep(0.05)
         assert acked == 3, "receiver must advertise the delivered count"
         for seq, tag in ((1, "shuffle:0/n"), (2, "shuffle:0/0"),
                          (3, "shuffle:0/1"), (4, "shuffle:0/2")):

@@ -53,19 +53,29 @@ def test_writeback_repeated_pad_row_identical_content():
 
 
 def test_flag_gating():
-    """The flag must not engage off-TPU, with unaligned widths, or with
-    unaligned index counts."""
+    """The legacy use_pallas_sparse opt-in (now the builtin plan's fallback
+    preference) must not engage off-TPU, with unaligned widths, or with
+    unaligned index counts — the plan's eligibility clamp, exercised
+    through the same _impl_for lookup the pull/push ops use."""
     from paddlebox_tpu import config
-    from paddlebox_tpu.ops.pull_push import _use_pallas
+    from paddlebox_tpu.ops.kernel_plan import invalidate_plan
+    from paddlebox_tpu.ops.pull_push import _impl_for
 
     t_ok = jnp.zeros((64, 128))
     t_narrow = jnp.zeros((64, 21))
     on_tpu = backend_is_tpu()  # conftest forces CPU, but stay portable
+    config.set_flag("kernel_plan_path", "off")  # builtin defaults only
     config.set_flag("use_pallas_sparse", True)
+    invalidate_plan()
     try:
-        assert _use_pallas(t_ok, 64) == on_tpu
-        assert not _use_pallas(t_narrow, 64)    # width not lane-aligned
-        assert not _use_pallas(t_ok, 63)        # U not 8-aligned
+        assert (_impl_for("pull", t_ok, 64) == "pallas") == on_tpu
+        assert _impl_for("pull", t_narrow, 64) == "native"  # width unaligned
+        assert _impl_for("pull", t_ok, 63) == "native"      # U not 8-aligned
+        # pallas push is per-row SET: without dedup'd (unique) rows it
+        # must clamp to native even where pull would engage
+        assert _impl_for("push", t_ok, 64, unique_rows=False) == "native"
     finally:
         config.set_flag("use_pallas_sparse", False)
-    assert not _use_pallas(t_ok, 64)            # flag off
+        config.set_flag("kernel_plan_path", "auto")
+        invalidate_plan()
+    assert _impl_for("pull", t_ok, 64) == "native"          # flag off

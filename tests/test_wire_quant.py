@@ -40,6 +40,36 @@ def test_bf16_row_roundtrip_and_bytes():
     np.testing.assert_allclose(up, x, rtol=8e-3, atol=1e-6)
 
 
+def test_wire_stat_counters_track_bytes_on_wire():
+    """fetch/send account actual encoded bytes + rows into wire.* stats at
+    the transport choke points — the bench JSON 'wire' block's source."""
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    lay = ValueLayout(embedx_dim=16)
+    rng = np.random.default_rng(7)
+    x = _rows(rng, 32, lay)
+    before = {
+        k: STAT_GET(k)
+        for k in (
+            "wire.fetch_rows_total", "wire.fetch_bytes_total",
+            "wire.fetch_fp32_bytes_total", "wire.send_rows_total",
+            "wire.send_bytes_total", "wire.send_fp32_bytes_total",
+        )
+    }
+    fetch_rows(jax.numpy.asarray(x), lay, "bf16")
+    send_rows(x, lay, "int8")
+    assert STAT_GET("wire.fetch_rows_total") - before["wire.fetch_rows_total"] == 32
+    assert STAT_GET("wire.send_rows_total") - before["wire.send_rows_total"] == 32
+    d_fetch = STAT_GET("wire.fetch_bytes_total") - before["wire.fetch_bytes_total"]
+    assert d_fetch == row_wire_nbytes(32, lay, "bf16")
+    d_send = STAT_GET("wire.send_bytes_total") - before["wire.send_bytes_total"]
+    assert d_send == row_wire_nbytes(32, lay, "int8")
+    # the fp32 twin is the denominator for the compression ratio
+    for k in ("wire.fetch_fp32_bytes_total", "wire.send_fp32_bytes_total"):
+        assert STAT_GET(k) - before[k] == 32 * lay.width * 4
+    assert d_fetch < 32 * lay.width * 4 and d_send < 32 * lay.width * 4
+
+
 def test_int8_rows_keep_counters_and_embeds():
     """int8 scales ONLY the embed block per row — a show=2000 counter must
     not crush 0.05-magnitude embeddings, and counters stay bf16-exact."""

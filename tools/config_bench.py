@@ -27,7 +27,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from bench import probe_backend_with_retries  # noqa: E402
+from bench import apply_legacy_init_env  # noqa: E402
+from paddlebox_tpu.utils.backendguard import (  # noqa: E402
+    probe_backend_with_retries,
+)
 
 
 def write_files(tmpdir, rng, n_rows, n_slots, key_space):
@@ -142,9 +145,8 @@ def main():
             batches = int(sys.argv[i + 1])
         if a == "--data-dir":
             data_dir = sys.argv[i + 1]
-    info, _ = probe_backend_with_retries(
-        float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "120"))
-    )
+    apply_legacy_init_env()
+    info, _ = probe_backend_with_retries()
     import jax
 
     if info is None:

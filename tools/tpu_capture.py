@@ -38,6 +38,15 @@ sys.path.insert(0, REPO)
 from bench import CAPTURE_LOCK_PATH, CAPTURE_PATH, bench_config_id  # noqa: E402
 
 HISTORY_PATH = os.path.join(REPO, "tools", "tpu_capture_history.jsonl")
+# a wedged-backend capture attempt records its evidence HERE — never over
+# CAPTURE_PATH, which only ever holds measurements from a healthy window
+WEDGED_PATH = os.path.join(REPO, "tools", "tpu_capture_wedged.json")
+# children share one persistent compile cache so every stage after the
+# first warm-starts its XLA compiles — more measurements per window
+CHILD_COMPILE_CACHE = os.path.join(REPO, "tools", "compile_cache")
+# resumable scatter-sweep artifact (op_probe --sweep-artifact): measured
+# points survive a wedge and are skipped on the next capture attempt
+SWEEP_ARTIFACT = os.path.join(REPO, "tools", "op_sweep.json")
 
 
 def _now() -> str:
@@ -54,6 +63,9 @@ def run_bench(env_extra: dict, timeout: float = 480):
     env.setdefault("PBOX_BENCH_INIT_TIMEOUT", "150")
     # our own bench children must not wait on our own capture lock
     env["PBOX_BENCH_NO_LOCK_WAIT"] = "1"
+    # persistent compile cache shared across every child of this capture
+    # (and across captures): only the first stage pays full XLA compile
+    env.setdefault("PBOX_COMPILE_CACHE_DIR", CHILD_COMPILE_CACHE)
     try:
         p = subprocess.run(
             [sys.executable, "bench.py"],
@@ -108,7 +120,34 @@ def _main_locked(quick: bool) -> int:
         "started_at": _now(),
         "bench_config": bench_config_id(),
         "quick": quick,
+        "compile_cache_dir": CHILD_COMPILE_CACHE,
     }
+
+    # -- 0. backend watchdog: is the chip actually alive RIGHT NOW? The
+    # probe loop saw it healthy, but wedges happen between probe and
+    # capture — a wedged verdict writes its evidence to WEDGED_PATH and
+    # bails before any stage can waste the driver's budget. ensure_backend
+    # itself never writes artifacts, so last_good_tpu_capture.json is
+    # structurally safe from this path.
+    from paddlebox_tpu.utils.backendguard import ensure_backend
+
+    verdict = ensure_backend(
+        timeout_s=float(os.environ.get("PBOX_BENCH_INIT_TIMEOUT", "150")),
+        retries=int(os.environ.get("PBOX_BENCH_INIT_RETRIES", "1")),
+    )
+    cap["backend_init"] = verdict.as_dict()
+    if verdict.wedged:
+        wedged = {
+            "backend_init": "wedged",
+            "verdict": verdict.as_dict(),
+            "bench_config": bench_config_id(),
+            "ts": _now(),
+        }
+        with open(WEDGED_PATH, "w") as f:
+            json.dump(wedged, f, indent=1)
+        print(f"[capture] backend wedged; evidence -> {WEDGED_PATH}",
+              file=sys.stderr, flush=True)
+        return 1
 
     # -- 1. headline at default knobs ------------------------------------
     print("[capture] headline bench...", file=sys.stderr, flush=True)
@@ -147,11 +186,17 @@ def _main_locked(quick: bool) -> int:
     sweep_points = {}
     cap["scatter_sweep"] = {
         "point_timeout_s": point_timeout, "points": sweep_points,
+        "artifact_path": SWEEP_ARTIFACT,
     }
     for pt in points:
+        # --sweep-artifact makes each point RESUMABLE: a point already
+        # measured (this capture or a previous partial one) is skipped by
+        # op_probe itself, so retried captures only pay for the remainder
         try:
             p = subprocess.run(
-                [sys.executable, "tools/op_probe.py", f"--scatter-sweep={pt}"],
+                [sys.executable, "tools/op_probe.py",
+                 f"--scatter-sweep={pt}",
+                 f"--sweep-artifact={SWEEP_ARTIFACT}"],
                 cwd=REPO, capture_output=True, text=True,
                 timeout=point_timeout,
             )
@@ -164,6 +209,11 @@ def _main_locked(quick: bool) -> int:
             sweep_points[pt] = {
                 "error": f"timed out after {point_timeout:.0f}s"
             }
+        try:  # structured per-point ms, written atomically by op_probe
+            with open(SWEEP_ARTIFACT) as f:
+                cap["scatter_sweep"]["artifact"] = json.load(f)
+        except (OSError, ValueError):
+            pass
         _save(cap)  # partial sweep survives a later wedge
         print(f"[capture]   point {pt}: "
               f"{sweep_points[pt].get('error', 'ok')}",

@@ -14,9 +14,10 @@ incrementally (headline first), so even a window shorter than the full
 capture yields the headline number; bench.py embeds the artifact as
 "tpu_capture" in any later CPU-fallback JSON.
 
-Reuses bench.probe_backend (one watchdogged subprocess per probe — the
-axon backend init is known to wedge for hours inside make_c_api_client,
-and a hung child is killable while a hung in-process import is not).
+Reuses backendguard.probe_backend (one watchdogged subprocess per probe —
+the axon backend init is known to wedge for hours inside
+make_c_api_client, and a hung child is killable while a hung in-process
+import is not).
 
 Usage: nohup python tools/tpu_probe_loop.py &  (from the repo root)
 """
@@ -34,9 +35,9 @@ sys.path.insert(0, REPO)
 from bench import (  # noqa: E402
     PROBE_LOOP_LOG,
     bench_config_id,
-    probe_backend,
     read_last_capture,
 )
+from paddlebox_tpu.utils.backendguard import probe_backend  # noqa: E402
 
 
 def _log(entry: dict) -> None:
