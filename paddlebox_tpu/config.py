@@ -142,6 +142,44 @@ define_flag(
     "name them instead of running out the full timeout",
 )
 
+# --- serving plane (serve/) ---
+define_flag(
+    "serve_poll_interval_s",
+    0.05,
+    "follower watermark poll period: how often serve/follower.py re-reads "
+    "latest.json looking for newly published deltas (the freshness half of "
+    "the freshness/latency tradeoff — see docs/SERVING.md)",
+)
+define_flag(
+    "serve_row_bucket",
+    256,
+    "request working-set capacity rounds to multiples of this before the "
+    "compiled forward (serve-side analog of batch_bucket_rounding: bounds "
+    "the distinct table shapes XLA compiles for, at the cost of padded "
+    "gather rows)",
+)
+define_flag(
+    "serve_key_bucket",
+    256,
+    "flat key-count padding bucket for score batches (the pack_batch "
+    "bucket the scorer uses; smaller than the training default because "
+    "serving batches are request-sized, not pass-sized)",
+)
+define_flag(
+    "serve_batch_wait_ms",
+    2.0,
+    "max time the score server holds an under-full batch open waiting for "
+    "more requests before scoring it (the latency half of the tradeoff: 0 "
+    "scores every request alone, larger values amortize the compiled step)",
+)
+define_flag(
+    "serve_require_manifest",
+    True,
+    "follower refuses snapshots without a manifest.json (legacy pre-"
+    "manifest trees need False; the trainer-side resume path stays lenient "
+    "either way)",
+)
+
 # --- metrics ---
 define_flag("auc_num_buckets", 1_000_000, "AUC wuauc bucket table size (reference box_wrapper.h:61)")
 define_flag("auc_runner_pool_size", 10_000, "AucRunner candidate reservoir capacity per pool")

@@ -1,0 +1,26 @@
+"""Online serving plane: pull-only followers over the published
+base+delta checkpoint stream (docs/SERVING.md).
+
+- follower.py       tails latest.json, CRC-verifies, applies delta chains
+- scoring_table.py  atomic-swap versions backing the scorers
+- server.py         compiled forward-only scoring + batched front-end
+"""
+
+from paddlebox_tpu.serve.follower import Follower
+from paddlebox_tpu.serve.scoring_table import ScoringTable, TableVersion
+from paddlebox_tpu.serve.server import (
+    ScoreServer,
+    Scorer,
+    table_source,
+    version_source,
+)
+
+__all__ = [
+    "Follower",
+    "ScoringTable",
+    "TableVersion",
+    "Scorer",
+    "ScoreServer",
+    "table_source",
+    "version_source",
+]

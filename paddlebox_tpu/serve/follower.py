@@ -1,0 +1,249 @@
+"""Pull-only checkpoint follower: tails latest.json, applies delta chains.
+
+The consumer half of the paper's online loop: the trainer publishes
+base + per-pass deltas (CheckpointManager / xbox SaveBase+SaveDelta
+parity) and a serving replica *pulls* them — no connection back into the
+training job, just a shared checkpoint root. Poll cadence is
+``serve_poll_interval_s``; each poll:
+
+1. reads the ``latest.json`` watermark (atomic publish, so a read sees a
+   whole watermark or the previous one — never a torn save),
+2. validates lineage (:func:`validate_watermark` + rewind detection →
+   :class:`DeltaLineageError`; a new base/date triggers a full reload),
+3. CRC-verifies every snapshot it is about to consume (manifest CRC
+   pinned by the watermark, then the full per-file manifest check) — a
+   corrupt delta is SKIPPED with an alarm stat and the follower keeps
+   serving the last good version,
+4. applies verified deltas into a private staging HostSparseTable (the
+   same load/apply_delta code the trainer's resume uses, so decay-epoch
+   catch-up is bitwise-faithful to the trainer's own table),
+5. commits each applied delta to the :class:`ScoringTable` as an atomic
+   version swap, and loads the paired dense params for the chain head.
+
+Scores served from the committed version are bitwise-equal to scoring
+directly against the trainer's table at the same pass — tests/test_serve.py
+and tools/serve_soak.py both pin that gate.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from paddlebox_tpu import config
+from paddlebox_tpu.serve.scoring_table import ScoringTable, TableVersion
+from paddlebox_tpu.table.sparse_table import HostSparseTable
+from paddlebox_tpu.train.checkpoint import (
+    DeltaLineageError,
+    _file_crc32,
+    _manifest_crc,
+    read_watermark,
+    validate_watermark,
+    verify_snapshot,
+)
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
+
+logger = logging.getLogger(__name__)
+
+
+class Follower:
+    """Tail a checkpoint root and maintain an atomically-served ScoringTable.
+
+    ``trainer`` (optional) is a CTRTrainer used purely as the dense-param
+    holder/loader — the follower never trains; it calls ``init_params`` to
+    build the tree structure and ``load_dense`` per published dense file.
+    Threading: ``poll_once``/``run`` mutate follower state from ONE poller
+    thread; scorers only touch the immutable versions the ScoringTable
+    hands out (plus ``trainer.params``, which dense loads replace with a
+    single tuple assignment — readers grab the reference once per batch).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        layout,
+        sparse_opt,
+        n_host_shards: int = 4,
+        trainer=None,
+        require_manifest: Optional[bool] = None,
+    ):
+        self.root = root
+        self.layout = layout
+        self.sparse_opt = sparse_opt
+        self.n_host_shards = n_host_shards
+        self.trainer = trainer
+        self.require_manifest = (
+            config.get_flag("serve_require_manifest")
+            if require_manifest is None
+            else require_manifest
+        )
+        self.scoring = ScoringTable(layout.width)
+        self._staging = self._fresh_staging()
+        # last committed chain position; base_crc pins the lineage so a
+        # re-published base under the same date forces a full reload
+        self._applied: Optional[Dict[str, Any]] = None
+        self._dense_loaded: Optional[str] = None
+
+    def _fresh_staging(self) -> HostSparseTable:
+        # seed is irrelevant: the staging table only ever load()s published
+        # rows, it never creates keys
+        return HostSparseTable(
+            self.layout, self.sparse_opt, n_shards=self.n_host_shards, seed=0
+        )
+
+    # ---- public surface --------------------------------------------------
+
+    def version(self) -> TableVersion:
+        return self.scoring.version()
+
+    def poll_once(self) -> bool:
+        """One watermark poll; returns True when any new state was applied.
+
+        Raises :class:`DeltaLineageError` on a watermark that conflicts
+        with applied history (rewind / malformed chain); propagates
+        injected faults from the apply window. ``run`` wraps this with
+        alarm-and-keep-serving semantics; tests call it bare.
+        """
+        STAT_ADD("serve.polls")
+        wm = read_watermark(self.root)
+        if wm is None:
+            return False
+        validate_watermark(wm)
+        date, idx = wm["date"], int(wm["delta_idx"])
+        base_crc = wm["base"].get("manifest_crc")
+
+        applied = self._applied
+        same_lineage = (
+            applied is not None
+            and applied["date"] == date
+            and applied["base_crc"] == base_crc
+        )
+        if same_lineage and idx < applied["delta_idx"]:
+            raise DeltaLineageError(
+                f"watermark rewound: serving {applied['date']}/delta_idx "
+                f"{applied['delta_idx']} but latest.json names delta_idx "
+                f"{idx} on the same base — refusing to regress the model"
+            )
+        advanced = False
+        if not same_lineage:
+            # new day or re-published base: the old chain's epochs and rows
+            # are not comparable — rebuild staging from scratch
+            if not self._verify(wm["base"]["path"], base_crc, "base"):
+                return False
+            self._staging = self._fresh_staging()
+            self._staging.load(os.path.join(self.root, wm["base"]["path"]))
+            if idx == 0:
+                self._load_dense(wm)
+            self._commit(wm, delta_idx=0, base_crc=base_crc)
+            advanced = True
+        start = self._applied["delta_idx"] + 1
+        for i in range(start, idx + 1):
+            entry = wm["deltas"][i - 1]
+            if not self._verify(entry["path"], entry.get("manifest_crc"), "delta"):
+                break  # chain order is load-bearing: stop at the first bad link
+            self._staging.apply_delta(os.path.join(self.root, entry["path"]))
+            if i == idx:
+                # the watermark's dense pairs with the chain HEAD: load it
+                # before committing delta idx so any version matching the
+                # watermark serves with its exact dense params (mid-chain
+                # catch-up versions carry the previous dense)
+                self._load_dense(wm)
+            self._commit(wm, delta_idx=i, base_crc=base_crc)
+            advanced = True
+        return advanced
+
+    def run(self, stop: threading.Event, poll_interval_s: Optional[float] = None) -> None:
+        """Poll loop with alarm-and-keep-serving semantics: any apply
+        failure (corrupt chain, injected crash, lineage conflict) is
+        counted and logged, the served version stays the last good one,
+        and polling continues — a follower never takes itself out of
+        rotation over a bad publish."""
+        interval = (
+            config.get_flag("serve_poll_interval_s")
+            if poll_interval_s is None
+            else poll_interval_s
+        )
+        while not stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — serving must outlive applies
+                STAT_ADD("serve.apply_failures")
+                logger.error("follower apply failed (still serving last good): %s", e)
+            stop.wait(interval)
+
+    # ---- internals -------------------------------------------------------
+
+    def _verify(self, rel: str, want_crc, kind: str) -> bool:
+        """CRC gate for one chain link: the dir's manifest must match the
+        watermark's pin AND the manifest's per-file CRCs must hold. False
+        (+ alarm stats) on any mismatch — the caller keeps the last good
+        version serving."""
+        snap = os.path.join(self.root, rel)
+        ok = True
+        if want_crc is not None and _manifest_crc(snap) != want_crc:
+            ok = False
+        if ok:
+            ok = verify_snapshot(snap, require_manifest=self.require_manifest)
+        if not ok:
+            STAT_ADD("serve.corrupt_skipped")
+            STAT_SET("serve.last_corrupt_unix", time.time())
+            logger.error(
+                "follower: %s snapshot %s failed CRC verification — "
+                "skipping, still serving the last good version", kind, rel,
+            )
+        return ok
+
+    def _commit(self, wm: Dict[str, Any], delta_idx: int, base_crc) -> None:
+        keys = np.sort(self._staging.keys())
+        rows = (
+            self._staging.pull_or_create(keys)  # all exist: pure read
+            if len(keys)
+            else np.zeros((0, self.layout.width), dtype=np.float32)
+        )
+        self.scoring.commit(
+            keys,
+            rows,
+            date=wm["date"],
+            delta_idx=delta_idx,
+            decay_epoch=self._staging.decay_epochs,
+            published_unix=wm.get("published_unix"),
+            # the version carries the dense pair: scorers read params off
+            # the version, so sparse+dense swap atomically together
+            params=None if self.trainer is None else self.trainer.params,
+            opt_state=None if self.trainer is None else self.trainer.opt_state,
+        )
+        self._applied = {
+            "date": wm["date"],
+            "delta_idx": delta_idx,
+            "base_crc": base_crc,
+        }
+        STAT_SET("serve.applied_delta_idx", delta_idx)
+        STAT_ADD("serve.applies")
+
+    def _load_dense(self, wm: Dict[str, Any]) -> None:
+        dense = wm.get("dense")
+        if self.trainer is None or dense is None:
+            return
+        rel = dense["path"]
+        if rel == self._dense_loaded:
+            return
+        path = os.path.join(self.root, rel)
+        if not os.path.exists(path):
+            STAT_ADD("serve.dense_skipped")
+            logger.error("follower: dense file %s missing — keeping previous params", rel)
+            return
+        want = dense.get("crc32")
+        if want is not None and _file_crc32(path) != want:
+            STAT_ADD("serve.dense_skipped")
+            logger.error("follower: dense file %s failed CRC — keeping previous params", rel)
+            return
+        if self.trainer.params is None:
+            self.trainer.init_params()
+        self.trainer.load_dense(path)
+        self._dense_loaded = rel
+        STAT_ADD("serve.dense_loads")

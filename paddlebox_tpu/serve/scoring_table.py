@@ -1,0 +1,181 @@
+"""Atomic-swap scoring table: the follower's serve-side model state.
+
+The xbox/abacus serving fleet consumes the trainer's per-pass SaveDelta
+stream and must never answer a request from a half-applied delta
+(box_wrapper.cc publishes whole passes; the serving side swaps whole
+models). This module gives the follower that all-or-nothing boundary:
+
+- :class:`TableVersion` — one immutable published state (base + deltas
+  1..delta_idx): sorted keys, a :class:`ReplicaCache` holding the rows,
+  and the publish metadata (decay epoch, watermark timestamp) that the
+  staleness metric is computed from.
+- :class:`ScoringTable` — holds the currently served version behind a
+  lock. :meth:`commit` builds the NEXT version completely off to the
+  side and installs it with a single reference swap; scorers that
+  grabbed the old version mid-request keep a complete consistent table.
+
+The kill-mid-apply contract lives here: fault site ``serve.apply_delta``
+fires after the next version is fully built but before the swap, so an
+injected crash models a follower dying mid-apply — the served version
+must remain the previous one, bit-for-bit (tests/test_serve.py pins it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.table.replica_cache import ReplicaCache
+from paddlebox_tpu.utils.faultinject import fire as _fault_fire
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
+
+
+class TableVersion:
+    """One immutable served state. Never mutated after construction —
+    that immutability is what makes the ScoringTable swap atomic."""
+
+    __slots__ = (
+        "date",
+        "delta_idx",
+        "decay_epoch",
+        "published_unix",
+        "keys",
+        "cache",
+        "rows",
+        "params",
+        "opt_state",
+        "first_served_unix",
+    )
+
+    def __init__(
+        self,
+        date: Optional[str],
+        delta_idx: int,
+        decay_epoch: int,
+        published_unix: Optional[float],
+        keys: np.ndarray,
+        cache: ReplicaCache,
+        params=None,
+        opt_state=None,
+    ):
+        self.date = date
+        self.delta_idx = delta_idx
+        self.decay_epoch = decay_epoch
+        self.published_unix = published_unix
+        self.keys = keys  # uint64 [n], sorted
+        self.cache = cache
+        # the dense params this sparse state pairs with (the cursor pairs
+        # them on the producer side; carrying them IN the version keeps the
+        # pair atomic under the same swap — a crash between dense load and
+        # commit can never serve new dense over old sparse)
+        self.params = params
+        self.opt_state = opt_state
+        # materialized once (versions are immutable) so lookups are a
+        # searchsorted + fancy-index, not a per-request stack
+        self.rows = cache.host_array()  # f32 [n, width]
+        # stamped by the server the first time a request is answered from
+        # this version; (first_served - published) IS the train-to-serve
+        # staleness the soak reports. Single batcher thread writes it.
+        self.first_served_unix: Optional[float] = None
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.keys)
+
+    def lookup_rows(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Rows for uint64 ``keys``; returns (rows [n, width], miss count).
+
+        Missing keys get the zero row: a key the published model has never
+        seen scores from a cold embedding, exactly like a fresh-created
+        (pre-first-push) trainer row with zero counters would after the
+        show/clk CVM transform zeroes out.
+        """
+        q = np.asarray(keys, dtype=np.uint64)
+        out = np.zeros((len(q), self.cache.dim), dtype=np.float32)
+        n_miss = len(q)
+        if len(self.keys) and len(q):
+            pos = np.searchsorted(self.keys, q)
+            pos = np.minimum(pos, len(self.keys) - 1)
+            hit = self.keys[pos] == q
+            out[hit] = self.rows[pos[hit]]
+            n_miss = int(np.count_nonzero(~hit))
+        return out, n_miss
+
+
+def _empty_version(width: int) -> TableVersion:
+    return TableVersion(
+        date=None,
+        delta_idx=-1,
+        decay_epoch=0,
+        published_unix=None,
+        keys=np.zeros(0, dtype=np.uint64),
+        cache=ReplicaCache(width),
+    )
+
+
+class ScoringTable:
+    """The follower's served table: an atomically swappable TableVersion.
+
+    Readers call :meth:`version` once per request and use that object for
+    the whole request; writers call :meth:`commit` with the complete next
+    state. There is no in-place mutation path on purpose.
+    """
+
+    def __init__(self, width: int):
+        self.width = width
+        self._lock = threading.Lock()
+        self._version: TableVersion = _empty_version(width)  # guarded-by: _lock
+        self._history: List[int] = []  # guarded-by: _lock  (committed delta idxs)
+
+    def version(self) -> TableVersion:
+        with self._lock:
+            return self._version
+
+    def committed_indices(self) -> List[int]:
+        """Delta indices in commit order (monotonicity probe for tests)."""
+        with self._lock:
+            return list(self._history)
+
+    def commit(
+        self,
+        keys: np.ndarray,
+        rows: np.ndarray,
+        *,
+        date: str,
+        delta_idx: int,
+        decay_epoch: int,
+        published_unix: Optional[float] = None,
+        params=None,
+        opt_state=None,
+    ) -> TableVersion:
+        """Build and install the next version, all-or-nothing.
+
+        ``keys`` must be sorted uint64 with ``rows`` aligned ([n, width]).
+        Everything expensive (cache build, row materialization) happens
+        BEFORE the swap; the swap itself is one reference assignment under
+        the lock. A crash anywhere before it (the ``serve.apply_delta``
+        fault site sits in that window) leaves the previous version served.
+        """
+        cache = ReplicaCache(self.width)
+        if len(rows):
+            cache.add_batch(rows)
+        nxt = TableVersion(
+            date=date,
+            delta_idx=delta_idx,
+            decay_epoch=decay_epoch,
+            published_unix=published_unix,
+            keys=np.asarray(keys, dtype=np.uint64),
+            cache=cache,
+            params=params,
+            opt_state=opt_state,
+        )
+        _fault_fire("serve.apply_delta")  # window: built, not yet visible
+        with self._lock:
+            self._version = nxt
+            self._history.append(delta_idx)
+        cache.publish_serve_stats()
+        STAT_SET("serve.version_delta_idx", delta_idx)
+        STAT_ADD("serve.version_commits")
+        return nxt

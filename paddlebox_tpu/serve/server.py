@@ -1,0 +1,309 @@
+"""Batched score serving over a follower's table versions.
+
+Two layers:
+
+- :class:`Scorer` — the compiled forward-only step. Reuses the
+  ``set_test_mode`` eval path of ``train_step.py`` verbatim (forward +
+  metrics, no pushes, no dense update) so serving numerics are the
+  trainer's eval numerics by construction; tests/test_eval_mode.py pins
+  eval-forward == train-forward preds at equal params. Per request it
+  builds a tiny PassWorkingSet from the request's keys, pulls rows from
+  a pluggable row source (a follower TableVersion, or a trainer's
+  HostSparseTable for the parity gate), packs with the standard
+  device packer, and runs one jitted step. Shapes are bucketed on three
+  axes — records pad to the configured batch size, working-set capacity
+  rounds to ``serve_row_bucket``, flat keys to ``serve_key_bucket`` — so
+  XLA compiles a small bounded program family instead of one program per
+  request size (the Ragged-Paged-Attention lesson: inference wants its
+  own latency-shaped execution path, not ad-hoc shapes).
+
+- :class:`ScoreServer` — an in-process batching front-end: requests
+  queue up, a single batcher thread coalesces them (up to the batch
+  size, waiting at most ``serve_batch_wait_ms``), scores them against
+  the follower's CURRENT version, and resolves per-request futures.
+  Train-to-serve staleness is stamped here: the first request answered
+  from a version records ``now - published_unix``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import jax
+    import jax.numpy as jnp
+    import optax
+except Exception:  # pragma: no cover
+    jax = jnp = optax = None
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data.device_pack import pack_batch
+from paddlebox_tpu.data.slot_record import build_batch
+from paddlebox_tpu.metrics.auc import auc_init
+from paddlebox_tpu.serve.scoring_table import TableVersion
+from paddlebox_tpu.table.sparse_table import PassWorkingSet
+from paddlebox_tpu.train.train_step import TrainState, make_train_step
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
+
+
+class _RowSource:
+    """Adapter giving PassWorkingSet.finalize a host-table interface over
+    any pull function (TableVersion lookup, or a live HostSparseTable)."""
+
+    def __init__(self, layout, pull_fn):
+        self.layout = layout
+        self._pull = pull_fn
+
+    def pull_or_create(self, keys: np.ndarray) -> np.ndarray:
+        return self._pull(keys)
+
+
+def version_source(layout, version: TableVersion) -> _RowSource:
+    """Row source over an immutable served version; misses (keys the
+    published model has never seen) pull the zero row and are counted."""
+
+    def pull(keys: np.ndarray) -> np.ndarray:
+        rows, n_miss = version.lookup_rows(keys)
+        if n_miss:
+            STAT_ADD("serve.miss_keys", n_miss)
+        return rows
+
+    return _RowSource(layout, pull)
+
+
+def table_source(layout, table) -> _RowSource:
+    """Row source over a live HostSparseTable (the trainer-direct side of
+    the bitwise-parity gate). Callers score keys the table holds; a
+    missing key would be created by pull_or_create, so parity probes use
+    keys drawn from trained data."""
+    return _RowSource(layout, table.pull_or_create)
+
+
+class Scorer:
+    """Compiled forward-only scoring (one jit cache shared by all callers).
+
+    Stateless across requests apart from the jit cache: params/opt_state
+    and the row source are per-call, so one Scorer can serve follower
+    versions and trainer-direct parity probes with the SAME compiled
+    program — which is exactly what makes the bitwise gate meaningful.
+    Thread-safe: concurrent score_records calls build independent working
+    sets and feed the same jitted function.
+    """
+
+    def __init__(self, model, cfg, dense_opt=None, dense_slot=None, dense_dim: int = 0):
+        self.cfg = cfg
+        self.dense_slot = dense_slot
+        self.dense_dim = dense_dim
+        # NO donation (unlike the training jit): params are reused across
+        # requests, donating them would delete the live buffers
+        self._step = jax.jit(
+            make_train_step(
+                model.apply, dense_opt or optax.adam(1e-3), cfg, eval_mode=True
+            )
+        )
+
+    def score_records(
+        self, records: Sequence, schema, source: _RowSource, params, opt_state=None
+    ) -> np.ndarray:
+        """preds float32 [len(records)] — deterministic in (rows, params)."""
+        if params is None:
+            raise RuntimeError(
+                "no dense params to score with — the follower has not "
+                "loaded a published dense file yet"
+            )
+        n, B = len(records), self.cfg.batch_size
+        out = np.empty(n, dtype=np.float32)
+        for lo in range(0, n, B):
+            chunk = list(records[lo : lo + B])
+            out[lo : lo + len(chunk)] = self._score_chunk(
+                chunk, schema, source, params, opt_state
+            )
+        return out
+
+    def _score_chunk(self, records, schema, source, params, opt_state) -> np.ndarray:
+        m = len(records)
+        # pad to the compiled batch size by repeating the tail record:
+        # per-example forward math never mixes examples, so preds[:m] are
+        # bit-identical whatever rides in the ghost rows
+        padded = records + [records[-1]] * (self.cfg.batch_size - m)
+        batch = build_batch(padded, schema)
+        ws = PassWorkingSet(n_mesh_shards=1)
+        ws.add_keys(batch.keys)
+        dev = ws.finalize(source, round_to=config.get_flag("serve_row_bucket"))
+        db = pack_batch(
+            batch,
+            ws,
+            schema,
+            dense_slot=self.dense_slot,
+            dense_dim=self.dense_dim,
+            bucket=config.get_flag("serve_key_bucket"),
+        )
+        state = TrainState(
+            table=jnp.asarray(dev.reshape(-1, source.layout.width)),
+            params=params,
+            opt_state=opt_state,
+            auc=auc_init(self.cfg.auc_buckets),
+            step=jnp.zeros((), jnp.int32),
+        )
+        feed = {k: jnp.asarray(v) for k, v in db.as_dict().items()}
+        _, metrics = self._step(state, feed)
+        return np.asarray(metrics["preds"], dtype=np.float32)[:m]
+
+
+class _Pending:
+    """One submitted request: records in, preds (or an error) out."""
+
+    __slots__ = ("records", "t_submit", "done", "preds", "error", "delta_idx")
+
+    def __init__(self, records):
+        self.records = records
+        self.t_submit = time.perf_counter()
+        self.done = threading.Event()
+        self.preds: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.delta_idx: int = -1
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError("score request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.preds
+
+
+class ScoreServer:
+    """In-process batched scoring front-end over a Follower.
+
+    One batcher thread owns all scoring; submitters only enqueue and wait
+    on their request's event. Latency samples and served-version history
+    are kept for the soak report (lists grow one entry per request /
+    version — bounded by the run, not the process lifetime).
+    """
+
+    def __init__(self, follower, scorer: Scorer, schema):
+        self.follower = follower
+        self.scorer = scorer
+        self.schema = schema
+        self._q: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.latencies_s: List[float] = []  # guarded-by: _lock
+        self.served_indices: List[int] = []  # guarded-by: _lock
+        self.staleness: List[Tuple[int, float]] = []  # guarded-by: _lock
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._batcher, name="score-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    # ---- request surface -------------------------------------------------
+
+    def submit(self, records: Sequence) -> _Pending:
+        if not len(records):
+            raise ValueError("empty score request")
+        req = _Pending(list(records))
+        self._q.put(req)
+        return req
+
+    def score(self, records: Sequence, timeout: float = 60.0) -> np.ndarray:
+        """Synchronous convenience wrapper: submit + wait."""
+        return self.submit(records).result(timeout)
+
+    # ---- batcher ---------------------------------------------------------
+
+    def _batcher(self) -> None:
+        wait_s = float(config.get_flag("serve_batch_wait_ms")) / 1000.0
+        B = self.scorer.cfg.batch_size
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            reqs = [first]
+            total = len(first.records)
+            deadline = time.perf_counter() + wait_s
+            while total < B:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=left)
+                except queue.Empty:
+                    break
+                reqs.append(nxt)
+                total += len(nxt.records)
+            self._serve_batch(reqs)
+
+    def _serve_batch(self, reqs: List[_Pending]) -> None:
+        # one consistent (version, params) pair for the whole batch: the
+        # version carries its own dense params, committed under the same
+        # atomic swap as the sparse rows
+        v = self.follower.version()
+        params, opt_state = v.params, v.opt_state
+        records = [r for req in reqs for r in req.records]
+        try:
+            preds = self.scorer.score_records(
+                records,
+                self.schema,
+                version_source(self.follower.layout, v),
+                params,
+                opt_state,
+            )
+        except BaseException as e:  # noqa: BLE001 — fault must reach submitters
+            for req in reqs:
+                req.error = e
+                req.done.set()
+            STAT_ADD("serve.request_errors", len(reqs))
+            return
+        now_unix = time.time()
+        if v.first_served_unix is None and v.published_unix is not None:
+            # train-to-serve staleness: delta publish -> first answer from it
+            v.first_served_unix = now_unix
+            lag = now_unix - v.published_unix
+            STAT_SET("serve.staleness_s", lag)
+            with self._lock:
+                self.staleness.append((v.delta_idx, lag))
+        t_done = time.perf_counter()
+        lo = 0
+        with self._lock:
+            for req in reqs:
+                req.preds = preds[lo : lo + len(req.records)]
+                req.delta_idx = v.delta_idx
+                lo += len(req.records)
+                self.latencies_s.append(t_done - req.t_submit)
+                self.served_indices.append(v.delta_idx)
+        for req in reqs:
+            req.done.set()
+        STAT_ADD("serve.requests", len(reqs))
+        STAT_ADD("serve.records", len(records))
+        STAT_ADD("serve.batches")
+        STAT_SET("serve.served_delta_idx", v.delta_idx)
+
+    # ---- reporting -------------------------------------------------------
+
+    def latency_percentiles(self) -> dict:
+        with self._lock:
+            lats = list(self.latencies_s)
+        if not lats:
+            return {"n": 0}
+        arr = np.sort(np.asarray(lats))
+        return {
+            "n": len(arr),
+            "p50_ms": float(np.percentile(arr, 50) * 1000.0),
+            "p99_ms": float(np.percentile(arr, 99) * 1000.0),
+            "max_ms": float(arr[-1] * 1000.0),
+        }

@@ -33,6 +33,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from paddlebox_tpu.utils.monitor import STAT_SET
+
 try:  # jax only needed for to_device / device gathers
     import jax
     import jax.numpy as jnp
@@ -53,13 +55,47 @@ class ReplicaCache:
             return len(self._rows)
 
     def add_items(self, emb) -> int:
-        """Append one row; returns its id (AddItems parity, thread-safe)."""
-        row = np.asarray(emb, dtype=np.float32).reshape(-1)
+        """Append one row; returns its id (AddItems parity, thread-safe).
+
+        Strictly one row: a ``[1, dim]`` input squeezes, anything else
+        multi-dimensional is rejected HERE with both shapes named. (The
+        old ``reshape(-1)`` silently flattened e.g. a ``[2, dim/2]`` block
+        into one wrong row, deferring the crash — or worse, the wrong
+        gather — to scoring time.)"""
+        row = np.asarray(emb, dtype=np.float32)
+        if row.ndim == 2 and row.shape[0] == 1:
+            row = row[0]
+        if row.ndim != 1:
+            raise ValueError(
+                f"add_items wants one row of shape ({self.dim},), got shape "
+                f"{row.shape} — use add_batch for [n, dim] blocks"
+            )
         if row.shape[0] != self.dim:
             raise ValueError(f"row dim {row.shape[0]} != cache dim {self.dim}")
         with self._lock:
             self._rows.append(row)
             return len(self._rows) - 1
+
+    def add_batch(self, rows) -> np.ndarray:
+        """Append a ``[n, dim]`` block in one locked operation; returns the
+        assigned row ids (int64 [n]). The bulk path the serving scoring
+        table uses to materialize a snapshot without n lock round-trips."""
+        block = np.asarray(rows, dtype=np.float32)
+        if block.ndim != 2:
+            raise ValueError(
+                f"add_batch wants a [n, {self.dim}] block, got shape "
+                f"{block.shape} — use add_items for single rows"
+            )
+        if block.shape[1] != self.dim:
+            raise ValueError(
+                f"add_batch got dim-mismatched rows: shape {block.shape} "
+                f"vs cache dim {self.dim}"
+            )
+        block = np.ascontiguousarray(block)
+        with self._lock:
+            start = len(self._rows)
+            self._rows.extend(block)  # row views share the block's buffer
+            return np.arange(start, start + len(block), dtype=np.int64)
 
     def host_array(self) -> np.ndarray:
         with self._lock:
@@ -80,6 +116,16 @@ class ReplicaCache:
     def mem_used_mb(self) -> float:
         with self._lock:
             return len(self._rows) * self.dim * 4 / 1024.0 / 1024.0
+
+    def publish_serve_stats(self) -> None:
+        """Export size under the serving dashboard namespace. Called by the
+        scoring table on every version commit, so ``serve.replica_rows`` /
+        ``serve.replica_mem_mb`` always describe the cache backing the
+        CURRENTLY served version."""
+        with self._lock:
+            n = len(self._rows)
+        STAT_SET("serve.replica_rows", n)
+        STAT_SET("serve.replica_mem_mb", n * self.dim * 4 / 1024.0 / 1024.0)
 
 
 def pull_cache_value(cache: "jnp.ndarray", ids: "jnp.ndarray") -> "jnp.ndarray":

@@ -5,7 +5,12 @@ from paddlebox_tpu.train.sharded_step import (
     make_sharded_train_step,
 )
 from paddlebox_tpu.train.async_dense import AsyncDenseTable
-from paddlebox_tpu.train.checkpoint import CheckpointManager
+from paddlebox_tpu.train.checkpoint import (
+    CheckpointManager,
+    DeltaLineageError,
+    read_watermark,
+    validate_watermark,
+)
 from paddlebox_tpu.data.quarantine import DataPoisonedError
 from paddlebox_tpu.train.supervisor import (
     CoordinatedAbort,
@@ -30,6 +35,9 @@ __all__ = [
     "CheckpointManager",
     "CoordinatedAbort",
     "DataPoisonedError",
+    "DeltaLineageError",
+    "read_watermark",
+    "validate_watermark",
     "EpochCoordinator",
     "HealthGates",
     "PassFailure",

@@ -41,6 +41,7 @@ import json
 import logging
 import os
 import shutil
+import time
 import zlib
 from typing import Any, Dict, Optional
 
@@ -52,6 +53,19 @@ from paddlebox_tpu.utils.monitor import STAT_ADD
 logger = logging.getLogger(__name__)
 
 MANIFEST_NAME = "manifest.json"
+LATEST_NAME = "latest.json"
+
+
+class DeltaLineageError(RuntimeError):
+    """A delta publish or apply that does not extend the recorded lineage.
+
+    Deltas are meaningful only as an ordered chain over one base: a gap in
+    the chain, a rewound index, or a watermark whose listed dirs disagree
+    with its own (date, delta_idx) all mean some writer skipped the
+    protocol. Producers refuse to publish over a broken chain; followers
+    refuse to apply one — silently proceeding would serve a model state
+    no trainer ever held.
+    """
 
 
 def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
@@ -111,6 +125,58 @@ def verify_snapshot(snap_dir: str, require_manifest: bool = False) -> bool:
     return True
 
 
+def _manifest_crc(snap_dir: str) -> Optional[int]:
+    """CRC32 of a snapshot's manifest file (None when unstamped). Pins the
+    watermark to one exact publish of each snapshot: a re-published dir
+    under the same name gets a new manifest CRC, so a follower can tell
+    'same chain link' from 'same path, different contents'."""
+    mpath = os.path.join(snap_dir, MANIFEST_NAME)
+    try:
+        return _file_crc32(mpath)
+    except OSError:
+        return None
+
+
+def read_watermark(root: str) -> Optional[Dict[str, Any]]:
+    """The published ``latest.json`` under ``root``, or None when absent
+    or torn (a torn watermark reads as 'nothing published yet', never as
+    garbage — the same discipline as cursor reads)."""
+    path = os.path.join(root, LATEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def validate_watermark(wm: Dict[str, Any]) -> None:
+    """Structural + lineage check of a watermark; raises
+    :class:`DeltaLineageError` when the listed chain is not exactly
+    base + delta-0001..delta-NNNN for the watermark's own (date, delta_idx).
+    """
+    try:
+        date = wm["date"]
+        idx = int(wm["delta_idx"])
+        base = wm["base"]["path"]
+        deltas = [d["path"] for d in wm["deltas"]]
+    except (KeyError, TypeError, ValueError) as e:
+        raise DeltaLineageError(f"malformed watermark {wm!r}: {e}") from e
+    if idx < 0:
+        raise DeltaLineageError(f"watermark delta_idx {idx} is negative")
+    if base != f"{date}/base":
+        raise DeltaLineageError(
+            f"watermark base {base!r} does not belong to date {date!r}"
+        )
+    want = [f"{date}/delta-{i:04d}" for i in range(1, idx + 1)]
+    if deltas != want:
+        raise DeltaLineageError(
+            f"watermark delta chain {deltas} is out of lineage — "
+            f"delta_idx {idx} requires exactly {want} (ordered, gap-free)"
+        )
+
+
 class CheckpointManager:
     def __init__(self, root: str):
         self.root = root
@@ -155,6 +221,51 @@ class CheckpointManager:
                 json.dump(old, f)
         with atomic_write(self._cursor_path()) as f:  # crash-safe cursor
             json.dump(cur, f)
+        # the cursor is the trainer's resume anchor; the watermark is the
+        # FOLLOWER-facing view of the same commit. Published strictly after
+        # the cursor, so a watermark never names a state the producer
+        # itself would not resume into.
+        self._publish_watermark(cur)
+
+    # ---- follower watermark ---------------------------------------------
+
+    def _latest_path(self) -> str:
+        return os.path.join(self.root, LATEST_NAME)
+
+    def _publish_watermark(self, cur: Dict[str, Any]) -> None:
+        """Atomically publish ``latest.json``: the base + ordered delta
+        chain (each entry pinned by its manifest CRC32) plus the paired
+        dense file. atomic_write means a tailing follower either sees the
+        previous complete watermark or this one — never a half-published
+        save."""
+        date, idx = cur["date"], cur["delta_idx"]
+
+        def entry(rel: str) -> Dict[str, Any]:
+            return {
+                "path": rel,
+                "manifest_crc": _manifest_crc(os.path.join(self.root, rel)),
+            }
+
+        wm: Dict[str, Any] = {
+            "date": date,
+            "delta_idx": idx,
+            "base": entry(f"{date}/base"),
+            "deltas": [entry(f"{date}/delta-{i:04d}") for i in range(1, idx + 1)],
+            "published_unix": time.time(),
+        }
+        dense = cur.get("dense")
+        if dense is not None:
+            dpath = os.path.join(self._day(date), dense)
+            wm["dense"] = {
+                "path": f"{date}/{dense}",
+                "crc32": _file_crc32(dpath) if os.path.exists(dpath) else None,
+            }
+        with atomic_write(self._latest_path()) as f:
+            json.dump(wm, f)
+        STAT_ADD("ckpt_watermark_publishes")
+
+    def read_watermark(self) -> Optional[Dict[str, Any]]:
+        return read_watermark(self.root)
 
     # ---- save ------------------------------------------------------------
 
@@ -212,6 +323,19 @@ class CheckpointManager:
         _fault_fire("checkpoint.save")  # window: nothing written yet
         idx = cur["delta_idx"] + 1
         day = self._day(date)
+        missing = [
+            i for i in range(1, idx)
+            if not os.path.isdir(os.path.join(day, f"delta-{i:04d}"))
+        ]
+        if missing:
+            # the cursor promises a contiguous chain; a hole means someone
+            # deleted mid-chain links — publishing delta N on top would
+            # hand followers a chain no trainer state corresponds to
+            raise DeltaLineageError(
+                f"cursor for {date} is at delta_idx {idx - 1} but delta "
+                f"dir(s) {missing} are missing — refusing an out-of-lineage "
+                "publish (restore the chain or save_base to start a new one)"
+            )
         path = os.path.join(day, f"delta-{idx:04d}")
         # defer the touched-set clear until the cursor commits: a save that
         # crashes after publishing (but before the cursor names it) retries

@@ -59,6 +59,12 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             simulated wedged TPU runtime, exercising the
                             watchdog + CPU-fallback path without owning a
                             wedgeable chip
+    serve.apply_delta       serve/scoring_table.py  commit(): after the next
+                            scoring-table version is fully built, before the
+                            atomic swap — a failure is a follower crash
+                            mid-apply; the served version must remain the
+                            previous complete one (no partial delta is ever
+                            visible to score requests)
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -104,6 +110,7 @@ KNOWN_SITES = (
     "parser.parse_line",
     "data.file_read",
     "backend.init",
+    "serve.apply_delta",
 )
 
 

@@ -40,6 +40,11 @@ def STAT_RESET(name: str | None = None) -> None:
             _stats.pop(name, None)
 
 
-def all_stats() -> Dict[str, Number]:
+def all_stats(prefix: str | None = None) -> Dict[str, Number]:
+    """Snapshot of the registry; ``prefix`` filters to one dashboard
+    namespace (e.g. ``"serve."`` for the serving plane's counters)."""
     with _lock:
-        return dict(_stats)
+        snap = dict(_stats)
+    if prefix is None:
+        return snap
+    return {k: v for k, v in snap.items() if k.startswith(prefix)}

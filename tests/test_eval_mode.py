@@ -106,6 +106,69 @@ def test_eval_pass_bit_identical_state(tmp_path, mesh):
     assert not np.array_equal(tr.trained_table(), table_before)
 
 
+def test_eval_forward_preds_bitwise_match_train_forward(tmp_path):
+    """The forward-only step the serving plane compiles (eval_mode=True)
+    must produce bitwise-identical preds to the TRAINING step's forward at
+    equal params — same state, same batch, two programs. This is what lets
+    the follower's scorer (serve/server.py) stand in for the trainer's
+    eval numerics without a tolerance."""
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.data.device_pack import pack_batch
+    from paddlebox_tpu.data.parser import parse_line
+    from paddlebox_tpu.data.slot_record import build_batch
+    from paddlebox_tpu.metrics.auc import auc_init
+    from paddlebox_tpu.table import HostSparseTable, PassWorkingSet
+    from paddlebox_tpu.train import TrainState, make_train_step
+
+    rng = np.random.default_rng(1)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    lines = [
+        f"1 {int(k[0]) % 2}.0 " + " ".join(f"1 {x}" for x in k)
+        for k in (rng.integers(1, 300, NS) for _ in range(B))
+    ]
+    records = [parse_line(ln, schema) for ln in lines]
+    table = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    batch = build_batch(records, schema)
+    ws = PassWorkingSet(n_mesh_shards=1)
+    ws.add_keys(batch.keys)
+    dev = ws.finalize(table, round_to=64)
+    db = pack_batch(batch, ws, schema, bucket=64)
+    feed = {k: jnp.asarray(v) for k, v in db.as_dict().items()}
+
+    model = DeepFM(num_slots=NS, feat_width=LAYOUT.pull_width,
+                   embedx_dim=4, hidden=(8,))
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=B, layout=LAYOUT, sparse_opt=OPT, auc_buckets=500
+    )
+    dense_opt = optax.adam(1e-2)
+    params = model.init(jax.random.PRNGKey(0))
+    state = TrainState(
+        table=jnp.asarray(dev.reshape(-1, LAYOUT.width)),
+        params=params,
+        opt_state=dense_opt.init(params),
+        auc=auc_init(500),
+        step=jnp.zeros((), jnp.int32),
+    )
+    # no donation on either side: the same state feeds both programs
+    step_train = jax.jit(make_train_step(model.apply, dense_opt, cfg))
+    step_eval = jax.jit(make_train_step(model.apply, dense_opt, cfg, eval_mode=True))
+    _, m_train = step_train(state, feed)
+    st_eval, m_eval = step_eval(state, feed)
+    np.testing.assert_array_equal(
+        np.asarray(m_eval["preds"]), np.asarray(m_train["preds"])
+    )
+    np.testing.assert_array_equal(
+        float(m_eval["loss"]), float(m_train["loss"])
+    )
+    # and the eval step really is forward-only
+    np.testing.assert_array_equal(np.asarray(st_eval.table), np.asarray(state.table))
+
+
 def test_trainer_local_test_mode_flag(tmp_path):
     """trainer.set_test_mode works without a BoxWrapper binding."""
     box = BoxWrapper(embedx_dim=4, sparse_opt=OPT, n_host_shards=4)

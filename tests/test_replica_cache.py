@@ -116,6 +116,42 @@ def test_replica_cache_threaded_add_and_gather():
         cache.add_items(np.zeros(5, np.float32))
 
 
+def test_replica_cache_add_items_rejects_multirow_block():
+    """add_items is a one-row API: a [n>1, d] block must raise (it used to
+    be silently flattened into garbage ids), and the error names the bulk
+    path. [1, dim] still squeezes for parser convenience."""
+    cache = ReplicaCache(dim=4)
+    cache.add_items(np.zeros(4, np.float32))
+    cache.add_items(np.zeros((1, 4), np.float32))
+    assert len(cache) == 2
+    with pytest.raises(ValueError, match="add_batch"):
+        cache.add_items(np.zeros((2, 2), np.float32))
+    with pytest.raises(ValueError, match="add_batch"):
+        cache.add_items(np.zeros((3, 4), np.float32))
+    assert len(cache) == 2  # rejected blocks appended nothing
+
+
+def test_replica_cache_add_batch_and_serve_stats():
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    cache = ReplicaCache(dim=4)
+    ids = cache.add_batch(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_array_equal(ids, [0, 1, 2])
+    ids2 = cache.add_batch(np.ones((2, 4), np.float32))
+    np.testing.assert_array_equal(ids2, [3, 4])
+    assert len(cache) == 5
+    host = cache.host_array()
+    assert host.shape == (5, 4)
+    np.testing.assert_array_equal(host[1], [4, 5, 6, 7])
+    with pytest.raises(ValueError, match="dim-mismatched"):
+        cache.add_batch(np.zeros((2, 3), np.float32))
+    with pytest.raises(ValueError, match="add_items"):
+        cache.add_batch(np.zeros(4, np.float32))  # 1-D: not a block
+    cache.publish_serve_stats()
+    assert STAT_GET("serve.replica_rows") == 5
+    assert STAT_GET("serve.replica_mem_mb") > 0
+
+
 def test_input_table_default_miss_and_upsert():
     t = InputTable(dim=3)
     assert len(t) == 1  # default row

@@ -1,0 +1,342 @@
+"""Serving follower tests: watermark tailing, atomic delta apply, parity.
+
+The gates the serving plane (paddlebox_tpu/serve/) must hold:
+
+- latest.json is published with every cursor write and names exactly the
+  base + ordered delta chain (pinned by manifest CRCs) + paired dense.
+- Out-of-lineage watermarks (gaps, rewinds) raise DeltaLineageError on
+  both the producer and follower sides.
+- A crash injected mid-apply (fault site ``serve.apply_delta``) never
+  surfaces a partial delta: the served version — and its scores — stay
+  bitwise what they were, and a healed retry catches up.
+- THE gate: follower scores after applying delta N are bitwise-equal to
+  scoring directly against the trainer's table at pass N (same compiled
+  forward, table_source vs version_source).
+- Committed version indices and staleness samples are monotone.
+"""
+
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.data.parser import parse_line
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.serve import Follower, Scorer, table_source, version_source
+from paddlebox_tpu.table import HostSparseTable, SparseOptimizerConfig, ValueLayout
+from paddlebox_tpu.train import (
+    CheckpointManager,
+    CTRTrainer,
+    DeltaLineageError,
+    TrainStepConfig,
+    read_watermark,
+    validate_watermark,
+)
+from paddlebox_tpu.utils.faultinject import InjectedFault, fail_once, inject
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+S, B = 4, 16
+DATE = "20260807"
+LAYOUT = ValueLayout(embedx_dim=4)
+OPT = SparseOptimizerConfig(
+    embedx_threshold=0.0, show_clk_decay=0.97, shrink_threshold=0.0
+)
+SCHEMA = SlotSchema(
+    [SlotInfo("label", type="float", dense=True, dim=1)]
+    + [SlotInfo(f"s{i}") for i in range(S)],
+    label_slot="label",
+)
+
+
+class PublishStack:
+    """Producer (trainer + CheckpointManager) and follower (own trainer)
+    over one tmp checkpoint root. One training pass per published save."""
+
+    def __init__(self, tmp_path, with_follower=True):
+        self.tmp = str(tmp_path)
+        self.root = os.path.join(self.tmp, "ckpt")
+        self.rng = np.random.default_rng(0)
+        self.table = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+        self.ds = BoxPSDataset(SCHEMA, self.table, batch_size=B, shuffle_mode="none")
+        self.cfg = TrainStepConfig(
+            num_slots=S, batch_size=B, layout=LAYOUT, sparse_opt=OPT, auc_buckets=500
+        )
+        model = DeepFM(S, LAYOUT.pull_width, LAYOUT.embedx_dim, hidden=(8,))
+        self.trainer = CTRTrainer(model, self.cfg, dense_opt=optax.adam(1e-2))
+        self.trainer.init_params(jax.random.PRNGKey(0))
+        self.mgr = CheckpointManager(self.root)
+        self.n_files = 0
+        self.probe = None  # records scored on both sides of the parity gate
+        self.follower = None
+        self.scorer = None
+        if with_follower:
+            model_f = DeepFM(S, LAYOUT.pull_width, LAYOUT.embedx_dim, hidden=(8,))
+            tr_f = CTRTrainer(model_f, self.cfg, dense_opt=optax.adam(1e-2))
+            self.follower = Follower(self.root, LAYOUT, OPT, n_host_shards=4, trainer=tr_f)
+            self.scorer = Scorer(model_f, self.cfg)
+
+    def _write_file(self, n=96, lo=1):
+        path = os.path.join(self.tmp, f"p{self.n_files}.txt")
+        self.n_files += 1
+        lines = []
+        for _ in range(n):
+            keys = self.rng.integers(lo, lo + 150, S)
+            lines.append(
+                f"1 {float(keys[0] % 2)} " + " ".join(f"1 {k}" for k in keys)
+            )
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        if self.probe is None:
+            self.probe = [parse_line(ln, SCHEMA) for ln in lines[:24]]
+        return path
+
+    def run_pass(self, lo=1):
+        path = self._write_file(lo=lo)
+        self.ds.set_filelist([path])
+        self.ds.load_into_memory()
+        self.ds.begin_pass(round_to=8)
+        self.trainer.train_pass(self.ds)
+        self.ds.end_pass(self.trainer.trained_table_device())
+        self.table.drain_pending()
+
+    def publish_base(self):
+        self.run_pass(lo=1)
+        self.mgr.save_base(DATE, self.table, self.trainer)
+
+    def publish_delta(self, lo):
+        self.run_pass(lo=lo)
+        self.mgr.save_delta(DATE, self.table, self.trainer)
+
+    # ---- parity probes ---------------------------------------------------
+
+    def trainer_scores(self):
+        return self.scorer.score_records(
+            self.probe,
+            SCHEMA,
+            table_source(LAYOUT, self.table),
+            self.trainer.params,
+            self.trainer.opt_state,
+        )
+
+    def follower_scores(self, version=None):
+        v = self.follower.version() if version is None else version
+        return self.scorer.score_records(
+            self.probe, SCHEMA, version_source(LAYOUT, v), v.params, v.opt_state
+        )
+
+
+@pytest.fixture
+def stack(tmp_path):
+    return PublishStack(tmp_path)
+
+
+# ---- watermark publish + structure ---------------------------------------
+
+def test_watermark_published_with_every_save(tmp_path):
+    st = PublishStack(tmp_path, with_follower=False)
+    assert read_watermark(st.root) is None  # nothing published yet
+    st.publish_base()
+    wm = read_watermark(st.root)
+    assert wm["date"] == DATE and wm["delta_idx"] == 0
+    assert wm["base"]["path"] == f"{DATE}/base"
+    assert isinstance(wm["base"]["manifest_crc"], int)
+    assert wm["deltas"] == []
+    assert wm["dense"]["path"] == f"{DATE}/dense-0000.npz"
+    assert isinstance(wm["dense"]["crc32"], int)
+    assert wm["published_unix"] > 0
+    validate_watermark(wm)
+
+    st.publish_delta(lo=100)
+    st.publish_delta(lo=200)
+    wm = st.mgr.read_watermark()
+    assert wm["delta_idx"] == 2
+    assert [d["path"] for d in wm["deltas"]] == [
+        f"{DATE}/delta-0001",
+        f"{DATE}/delta-0002",
+    ]
+    assert all(isinstance(d["manifest_crc"], int) for d in wm["deltas"])
+    assert wm["dense"]["path"] == f"{DATE}/dense-0002.npz"
+    validate_watermark(wm)
+
+
+def test_watermark_lineage_validation():
+    # chain with a gap: delta_idx 2 but only delta-0002 listed
+    wm = {
+        "date": DATE,
+        "delta_idx": 2,
+        "base": {"path": f"{DATE}/base"},
+        "deltas": [{"path": f"{DATE}/delta-0002"}],
+    }
+    with pytest.raises(DeltaLineageError, match="out of lineage"):
+        validate_watermark(wm)
+    # base from another date
+    wm2 = {
+        "date": DATE,
+        "delta_idx": 0,
+        "base": {"path": "20200101/base"},
+        "deltas": [],
+    }
+    with pytest.raises(DeltaLineageError, match="does not belong"):
+        validate_watermark(wm2)
+    with pytest.raises(DeltaLineageError, match="malformed"):
+        validate_watermark({"date": DATE})
+
+
+def test_producer_refuses_out_of_lineage_publish(tmp_path):
+    """Deleting a mid-chain delta dir must make the NEXT save_delta raise
+    instead of publishing a chain no trainer state corresponds to."""
+    st = PublishStack(tmp_path, with_follower=False)
+    st.publish_base()
+    st.publish_delta(lo=100)
+    st.publish_delta(lo=200)
+    import shutil
+
+    shutil.rmtree(os.path.join(st.root, DATE, "delta-0001"))
+    st.run_pass(lo=300)
+    with pytest.raises(DeltaLineageError, match="out-of-lineage"):
+        st.mgr.save_delta(DATE, st.table, st.trainer)
+
+
+# ---- follower tailing + THE parity gate ----------------------------------
+
+def test_follower_tails_chain_with_bitwise_parity(stack):
+    st = stack
+    fol = st.follower
+    assert fol.poll_once() is False  # nothing published yet
+    st.publish_base()
+    assert fol.poll_once() is True
+    v = fol.version()
+    assert (v.date, v.delta_idx) == (DATE, 0)
+    assert v.n_rows == len(st.table.keys())
+    np.testing.assert_array_equal(st.trainer_scores(), st.follower_scores())
+
+    for i, lo in ((1, 120), (2, 260)):
+        st.publish_delta(lo=lo)
+        ref = st.trainer_scores()  # trainer-direct, captured at pass i
+        assert fol.poll_once() is True
+        v = fol.version()
+        assert v.delta_idx == i
+        np.testing.assert_array_equal(ref, st.follower_scores())
+
+    # versions committed in strictly increasing delta order
+    assert fol.scoring.committed_indices() == [0, 1, 2]
+    # idempotent poll: nothing new -> no new version
+    assert fol.poll_once() is False
+    assert fol.scoring.committed_indices() == [0, 1, 2]
+    # a key the published model never saw scores from the zero row, not a crash
+    rows, n_miss = v.lookup_rows(np.array([2**63 + 17], dtype=np.uint64))
+    assert n_miss == 1 and not rows.any()
+
+
+def test_kill_mid_apply_keeps_old_version_bitwise(stack):
+    st = stack
+    fol = st.follower
+    st.publish_base()
+    st.publish_delta(lo=120)
+    assert fol.poll_once() is True
+    v0 = fol.version()
+    before = st.follower_scores(v0)
+
+    st.publish_delta(lo=260)
+    with inject(fail_once("serve.apply_delta")):
+        with pytest.raises(InjectedFault):
+            fol.poll_once()
+    # the swap never happened: same version object, same scores, bit for bit
+    v1 = fol.version()
+    assert v1 is v0 and v1.delta_idx == 1
+    np.testing.assert_array_equal(before, st.follower_scores(v1))
+
+    # healed retry catches up (staging re-apply is idempotent)
+    assert fol.poll_once() is True
+    v2 = fol.version()
+    assert v2.delta_idx == 2
+    np.testing.assert_array_equal(st.trainer_scores(), st.follower_scores(v2))
+    assert fol.scoring.committed_indices() == [0, 1, 2]
+
+
+def test_corrupt_delta_skipped_and_alarmed(stack):
+    st = stack
+    fol = st.follower
+    st.publish_base()
+    assert fol.poll_once() is True
+    good = st.follower_scores()
+
+    st.publish_delta(lo=120)
+    delta_dir = os.path.join(st.root, DATE, "delta-0001")
+    victim = next(
+        os.path.join(delta_dir, n)
+        for n in sorted(os.listdir(delta_dir))
+        if n.endswith(".npz")
+    )
+    original = open(victim, "rb").read()
+    with open(victim, "wb") as f:  # flip bytes, keep the size
+        f.write(original[:10] + bytes([original[10] ^ 0xFF]) + original[11:])
+
+    skipped0 = STAT_GET("serve.corrupt_skipped")
+    assert fol.poll_once() is False  # bad link: nothing applied
+    assert STAT_GET("serve.corrupt_skipped") == skipped0 + 1
+    v = fol.version()
+    assert v.delta_idx == 0  # still the base
+    np.testing.assert_array_equal(good, st.follower_scores(v))
+
+    with open(victim, "wb") as f:  # repair: publisher re-copies the delta
+        f.write(original)
+    assert fol.poll_once() is True
+    assert fol.version().delta_idx == 1
+    np.testing.assert_array_equal(st.trainer_scores(), st.follower_scores())
+
+
+def test_watermark_rewind_rejected(stack):
+    st = stack
+    fol = st.follower
+    st.publish_base()
+    st.publish_delta(lo=120)
+    assert fol.poll_once() is True
+    assert fol.version().delta_idx == 1
+
+    # hand-roll a rewound watermark: same base, delta_idx back to 0
+    wm = read_watermark(st.root)
+    wm["delta_idx"], wm["deltas"] = 0, []
+    with open(os.path.join(st.root, "latest.json"), "w") as f:
+        json.dump(wm, f)
+    with pytest.raises(DeltaLineageError, match="rewound"):
+        fol.poll_once()
+    assert fol.version().delta_idx == 1  # still serving, unregressed
+
+
+def test_staleness_and_served_index_monotonic(stack):
+    """Drive the batched front-end across publishes: staleness samples are
+    non-negative and stamped once per version in increasing delta order;
+    served indices never regress."""
+    from paddlebox_tpu.serve import ScoreServer
+
+    st = stack
+    fol = st.follower
+    st.publish_base()
+    fol.poll_once()
+    srv = ScoreServer(fol, st.scorer, SCHEMA)
+    srv.start()
+    try:
+        for lo in (120, 260):
+            preds = srv.score(st.probe[:8], timeout=60)
+            assert preds.shape == (8,) and np.isfinite(preds).all()
+            st.publish_delta(lo=lo)
+            fol.poll_once()
+        preds = srv.score(st.probe[:8], timeout=60)
+        np.testing.assert_array_equal(preds, st.trainer_scores()[:8])
+    finally:
+        srv.stop()
+
+    assert len(srv.staleness) == 3  # one sample per served version
+    indices = [i for i, _ in srv.staleness]
+    assert indices == sorted(indices) == [0, 1, 2]
+    assert all(lag >= 0 for _, lag in srv.staleness)
+    served = srv.served_indices
+    assert served == sorted(served)  # never regresses
+    lat = srv.latency_percentiles()
+    assert lat["n"] == 3 and lat["p99_ms"] >= lat["p50_ms"] > 0

@@ -322,6 +322,112 @@ def run_wedge_backend(args):
     return 0 if ok else 1
 
 
+def run_serve(args):
+    """Serving-chain corruption smoke (``--serve``): a follower tailing a
+    live publish stream must SKIP a corrupted delta with an alarm — same
+    version served, bitwise-same scores — and catch up once the publisher
+    repairs it. Exercises the deep per-file CRC gate: the corrupted byte
+    lives inside a shard npz, so the watermark's manifest-CRC pin still
+    matches and only verify_snapshot can catch it.
+
+      JAX_PLATFORMS=cpu python tools/chaos_probe.py --serve [--json]
+    """
+    import serve_soak
+
+    from paddlebox_tpu.data.parser import parse_line
+    from paddlebox_tpu.serve import table_source, version_source
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        root = os.path.join(tmpdir, "ckpt")
+        table, ds, cfg, trainer, mgr = serve_soak.make_stack(root)
+        fol, scorer = serve_soak.make_follower(root, cfg)
+        rng = np.random.default_rng(args.seed)
+        date = serve_soak.DATE
+
+        p0 = os.path.join(tmpdir, "pass-0.txt")
+        lines = serve_soak.write_pass_file(rng, p0, args.rows, 1)
+        probe = [parse_line(ln, serve_soak.SCHEMA) for ln in lines[:16]]
+
+        def one_pass(lo, path=None):
+            if path is None:
+                path = os.path.join(tmpdir, f"pass-{lo}.txt")
+                serve_soak.write_pass_file(rng, path, args.rows, lo)
+            ds.set_filelist([path])
+            ds.load_into_memory()
+            ds.begin_pass(round_to=8)
+            trainer.train_pass(ds)
+            ds.end_pass(trainer.trained_table_device())
+            table.drain_pending()
+
+        def follower_scores(v):
+            return scorer.score_records(
+                probe, serve_soak.SCHEMA,
+                version_source(serve_soak.LAYOUT, v), v.params, v.opt_state,
+            )
+
+        one_pass(1, path=p0)
+        mgr.save_base(date, table, trainer)
+        one_pass(120)
+        mgr.save_delta(date, table, trainer)
+        assert fol.poll_once()
+        v1 = fol.version()
+        good = follower_scores(v1)
+
+        # publish delta-0002, then flip one byte inside a shard npz
+        one_pass(260)
+        mgr.save_delta(date, table, trainer)
+        delta_dir = os.path.join(root, date, "delta-0002")
+        victim = next(
+            os.path.join(delta_dir, n)
+            for n in sorted(os.listdir(delta_dir)) if n.endswith(".npz")
+        )
+        original = open(victim, "rb").read()
+        with open(victim, "wb") as f:  # same size, one byte flipped
+            f.write(original[:20] + bytes([original[20] ^ 0xFF]) + original[21:])
+
+        skipped_before = STAT_GET("serve.corrupt_skipped")
+        applied_corrupt = fol.poll_once()
+        v_after = fol.version()
+        scores_after = follower_scores(v_after)
+        skipped = int(STAT_GET("serve.corrupt_skipped") - skipped_before)
+        held = (
+            not applied_corrupt
+            and v_after is v1
+            and np.array_equal(scores_after, good)
+            and skipped >= 1
+        )
+
+        with open(victim, "wb") as f:  # publisher repairs the delta
+            f.write(original)
+        caught_up = fol.poll_once()
+        v2 = fol.version()
+        ref = scorer.score_records(
+            probe, serve_soak.SCHEMA,
+            table_source(serve_soak.LAYOUT, table),
+            trainer.params, trainer.opt_state,
+        )
+        recovered = (
+            caught_up
+            and v2.delta_idx == 2
+            and np.array_equal(follower_scores(v2), ref)
+        )
+
+    ok = held and recovered
+    report = {
+        "mode": "serve",
+        "corrupt_delta_skipped": skipped,
+        "served_idx_during_corruption": v_after.delta_idx,
+        "scores_held_bitwise": bool(held),
+        "caught_up_after_repair": bool(caught_up),
+        "final_served_idx": v2.delta_idx,
+        "parity_after_repair_bitwise": bool(recovered),
+        "ok": bool(ok),
+    }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if ok else 1
+
+
 def _dist_free_ports(n):
     import socket
 
@@ -541,9 +647,16 @@ def main(argv=None):
                          "within the watchdog deadline, a mini supervised "
                          "day must still train, and the last-good TPU "
                          "capture must remain untouched")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-chain corruption smoke: a follower must "
+                         "skip a corrupted published delta with an alarm, "
+                         "keep serving the last good version bitwise, and "
+                         "catch up once the delta is repaired")
     ap.add_argument("--json", action="store_true", help="machine output only")
     args = ap.parse_args(argv)
 
+    if args.serve:
+        return run_serve(args)
     if args.wedge_backend:
         return run_wedge_backend(args)
     if args.distributed:

@@ -1,0 +1,264 @@
+"""serve_soak: a supervised training day publishing deltas while a follower serves.
+
+One process, three concurrent roles over a shared checkpoint root:
+
+- **producer** (main thread): trains one pass per publish (save_base for
+  pass 0, save_delta after), and captures reference predictions for a
+  fixed probe set against the LIVE trainer table immediately after each
+  save — the trainer-direct side of the bitwise-parity gate.
+- **follower** (poller thread): ``Follower.run`` tails latest.json and
+  applies the chain as it grows.
+- **load generator** (client threads): fires batched score requests at a
+  target QPS through the :class:`ScoreServer` front-end while versions
+  swap underneath it.
+
+After the day, every version the follower served is re-scored offline and
+compared bitwise against the producer's capture at the same delta index.
+The report carries p50/p99 score latency, achieved QPS, per-version
+train-to-serve staleness, and the parity verdict — the acceptance gate of
+the serving tentpole (docs/SERVING.md).
+
+Run:  python tools/serve_soak.py --passes 6 --qps 40 [--json report.json]
+Exit: 0 on full parity + no request errors, 1 otherwise.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import optax
+
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.data.parser import parse_line
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.serve import Follower, ScoreServer, Scorer, table_source, version_source
+from paddlebox_tpu.table import HostSparseTable, SparseOptimizerConfig, ValueLayout
+from paddlebox_tpu.train import CheckpointManager, CTRTrainer, TrainStepConfig
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+S, B = 4, 16
+DATE = "20260807"
+LAYOUT = ValueLayout(embedx_dim=4)
+OPT = SparseOptimizerConfig(
+    embedx_threshold=0.0, show_clk_decay=0.97, shrink_threshold=0.0
+)
+SCHEMA = SlotSchema(
+    [SlotInfo("label", type="float", dense=True, dim=1)]
+    + [SlotInfo(f"s{i}") for i in range(S)],
+    label_slot="label",
+)
+
+
+def make_stack(root):
+    """Producer trainer + checkpoint manager over ``root``."""
+    table = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    ds = BoxPSDataset(SCHEMA, table, batch_size=B, shuffle_mode="none")
+    cfg = TrainStepConfig(
+        num_slots=S, batch_size=B, layout=LAYOUT, sparse_opt=OPT, auc_buckets=500
+    )
+    model = DeepFM(S, LAYOUT.pull_width, LAYOUT.embedx_dim, hidden=(8,))
+    trainer = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    trainer.init_params(jax.random.PRNGKey(0))
+    return table, ds, cfg, trainer, CheckpointManager(root)
+
+
+def make_follower(root, cfg):
+    model = DeepFM(S, LAYOUT.pull_width, LAYOUT.embedx_dim, hidden=(8,))
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    return Follower(root, LAYOUT, OPT, n_host_shards=4, trainer=tr), Scorer(model, cfg)
+
+
+def write_pass_file(rng, path, rows, lo):
+    lines = []
+    for _ in range(rows):
+        keys = rng.integers(lo, lo + 200, S)
+        lines.append(f"1 {float(keys[0] % 2)} " + " ".join(f"1 {k}" for k in keys))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return lines
+
+
+def run_soak(workdir, passes=6, rows=400, qps=40.0, probe_n=32):
+    """The full concurrent day; returns the report dict (see module doc)."""
+    root = os.path.join(workdir, "ckpt")
+    rng = np.random.default_rng(0)
+    table, ds, cfg, trainer, mgr = make_stack(root)
+    fol, scorer = make_follower(root, cfg)
+
+    # the probe rides inside pass 0's training data: parity probes must use
+    # keys the published model has trained (an unseen key would be CREATED
+    # in the trainer table by the reference pull, skewing the comparison)
+    pass0_path = os.path.join(workdir, "pass-0.txt")
+    pass0_lines = write_pass_file(rng, pass0_path, rows, 1)
+    probe = [parse_line(ln, SCHEMA) for ln in pass0_lines[:probe_n]]
+
+    def run_pass(lo, path=None):
+        if path is None:
+            path = os.path.join(workdir, f"pass-{lo}.txt")
+            write_pass_file(rng, path, rows, lo)
+        ds.set_filelist([path])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)
+        trainer.train_pass(ds)
+        ds.end_pass(trainer.trained_table_device())
+        table.drain_pending()
+
+    # reference preds per delta idx, captured trainer-direct right after
+    # each save (the producer's truth the follower must match bitwise)
+    reference = {}
+
+    def capture_reference(idx):
+        reference[idx] = scorer.score_records(
+            probe, SCHEMA, table_source(LAYOUT, table), trainer.params, trainer.opt_state
+        )
+
+    # capture every version the follower commits: versions are immutable
+    # and carry their own (sparse, dense) pair, so they can be re-scored
+    # offline after the day for the per-delta bitwise parity sweep
+    captured = {}
+    orig_commit = fol.scoring.commit
+
+    def commit_and_capture(*a, **k):
+        v = orig_commit(*a, **k)
+        captured[v.delta_idx] = v
+        return v
+
+    fol.scoring.commit = commit_and_capture
+
+    # ---- follower + server up before anything is published: the soak
+    # exercises the cold-start path (empty version, no params) too
+    stop = threading.Event()
+    poller = threading.Thread(
+        target=fol.run, args=(stop,), kwargs={"poll_interval_s": 0.02}, daemon=True
+    )
+    poller.start()
+    srv = ScoreServer(fol, scorer, SCHEMA)
+    srv.start()
+
+    client_errors = []
+    requests_sent = [0]
+    t_gen = [0.0]
+
+    def load_gen():
+        period = 1.0 / qps
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            if fol.version().params is not None:  # serving is warm
+                k = int(rng.integers(0, probe_n - 8))
+                try:
+                    srv.score(probe[k : k + 8], timeout=30)
+                    requests_sent[0] += 1
+                    if t_gen[0] == 0.0:
+                        t_gen[0] = time.perf_counter()
+                except Exception as e:  # noqa: BLE001 — soak must report, not die
+                    client_errors.append(repr(e))
+            left = period - (time.perf_counter() - t0)
+            if left > 0:
+                time.sleep(left)
+
+    clients = [threading.Thread(target=load_gen, daemon=True) for _ in range(2)]
+    t_start = time.perf_counter()
+    for c in clients:
+        c.start()
+
+    # ---- the training day: publish while the fleet above keeps serving
+    for p in range(passes):
+        lo = 1 + p * 120
+        run_pass(lo, path=pass0_path if p == 0 else None)
+        if p == 0:
+            mgr.save_base(DATE, table, trainer)
+        else:
+            mgr.save_delta(DATE, table, trainer)
+        capture_reference(p)
+
+    # let the follower drain the tail of the chain
+    deadline = time.time() + 30
+    while fol.version().delta_idx < passes - 1 and time.time() < deadline:
+        time.sleep(0.05)
+    time.sleep(0.2)  # a few more serves against the final version
+    stop.set()
+    for c in clients:
+        c.join(timeout=10)
+    srv.stop()
+    poller.join(timeout=10)
+    elapsed = time.perf_counter() - t_start
+
+    # ---- offline parity sweep: every version the follower committed must
+    # score the probe bitwise-equal to the producer's capture at that pass
+    head = fol.version()
+    parity = {"checked": 0, "missing": [], "mismatched": []}
+    for idx in sorted(reference):
+        v = captured.get(idx)
+        if v is None:
+            # the follower never committed this index — a skipped link is a
+            # parity failure too (ok requires checked == passes)
+            parity["missing"].append(idx)
+            continue
+        got = scorer.score_records(
+            probe, SCHEMA, version_source(LAYOUT, v), v.params, v.opt_state
+        )
+        parity["checked"] += 1
+        if not np.array_equal(got, reference[idx]):
+            parity["mismatched"].append(idx)
+
+    lat = srv.latency_percentiles()
+    achieved_qps = requests_sent[0] / elapsed if elapsed > 0 else 0.0
+    report = {
+        "passes": passes,
+        "rows_per_pass": rows,
+        "elapsed_s": round(elapsed, 3),
+        "requests": requests_sent[0],
+        "achieved_qps": round(achieved_qps, 2),
+        "latency": lat,
+        "staleness_s": [
+            {"delta_idx": i, "lag_s": round(lag, 4)} for i, lag in srv.staleness
+        ],
+        "served_head_delta_idx": head.delta_idx,
+        "follower_applies": STAT_GET("serve.applies"),
+        "apply_failures": STAT_GET("serve.apply_failures"),
+        "request_errors": client_errors[:5],
+        "parity": parity,
+        "ok": (
+            not parity["mismatched"]
+            and not parity["missing"]
+            and parity["checked"] == passes
+            and head.delta_idx == passes - 1
+            and not client_errors
+            and requests_sent[0] > 0
+        ),
+    }
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--passes", type=int, default=6, help="publishes in the day (1 base + N-1 deltas)")
+    ap.add_argument("--rows", type=int, default=400, help="training rows per pass")
+    ap.add_argument("--qps", type=float, default=40.0, help="target score QPS per client thread")
+    ap.add_argument("--probe", type=int, default=32, help="probe records for the parity gate")
+    ap.add_argument("--json", help="write the report to this path")
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as workdir:
+        report = run_soak(
+            workdir, passes=args.passes, rows=args.rows, qps=args.qps, probe_n=args.probe
+        )
+    print(json.dumps(report, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+    print("SERVE SOAK", "PASS" if report["ok"] else "FAIL")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

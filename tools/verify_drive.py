@@ -174,4 +174,18 @@ assert fplan.failures("backend.init") == 2, fplan.failures("backend.init")
 assert took <= 2.0 * 2 + 2.0, f"fallback blew the deadline: {took:.1f}s"
 float(jnp.arange(8.0).sum())  # backend still usable after the verdict
 print(f"[7] backend watchdog ok: wedged init -> {v.verdict} in {took:.2f}s")
+
+# --- 8. publish-while-serve soak (the serving tentpole, short) ----------
+# Trains a 3-pass day publishing base+deltas while a follower tails and
+# serves; the gate is bitwise parity between follower scores and
+# trainer-direct scores at every applied delta (docs/SERVING.md).
+import serve_soak
+
+with tempfile.TemporaryDirectory() as soak_dir:
+    report = serve_soak.run_soak(soak_dir, passes=3, rows=200, qps=25.0, probe_n=16)
+assert report["ok"], report
+assert report["parity"]["checked"] == 3 and not report["parity"]["mismatched"]
+print(f"[8] serve soak ok: {report['requests']} req @ {report['achieved_qps']} qps, "
+      f"p50={report['latency']['p50_ms']:.1f}ms p99={report['latency']['p99_ms']:.1f}ms, "
+      f"parity bitwise at {report['parity']['checked']} deltas")
 print("VERIFY DRIVE PASS")
