@@ -67,7 +67,14 @@ struct Shard {
   std::vector<float> values;        // [n_rows * width]
   std::vector<uint64_t> row_key;    // [n_rows]
   std::vector<uint8_t> row_touched; // [n_rows]
+  std::vector<int64_t> row_epoch;   // [n_rows] last-touched table epoch
   int64_t n_rows = 0;
+
+  // cumulative tier counters (monotone; exported via pbx_table_tier_stats)
+  int64_t n_spilled = 0;        // mem rows written to the disk tier
+  int64_t n_promoted = 0;       // disk rows brought back to mem
+  int64_t n_admit_spilled = 0;  // spills forced by the admission threshold
+  int64_t n_lazy_shrunk = 0;    // disk rows dropped at promote (decayed out)
 
   // disk tier
   FILE* spill = nullptr;
@@ -160,10 +167,12 @@ int64_t shard_new_row(const Table* t, Shard* s, uint64_t key) {
     if (cap < s->n_rows) cap = s->n_rows;
     s->row_key.resize(cap);
     s->row_touched.resize(cap, 0);
+    s->row_epoch.resize(cap, 0);
     s->values.resize(cap * (int64_t)t->width);
   }
   s->row_key[row] = key;
   s->row_touched[row] = 0;
+  s->row_epoch[row] = t->epoch;
   return row;
 }
 
@@ -208,10 +217,13 @@ int64_t promote(Table* t, Shard* s, uint64_t j, bool seek_end = true) {
   if (seek_end) fseeko(s->spill, 0, SEEK_END);
   int64_t missed = t->epoch - rec.epoch;
   if (missed > 0 && t->last_decay < 1.0f) {
-    float d = 1.0f;
-    for (int64_t i = 0; i < missed; ++i) d *= t->last_decay;
-    buf[t->show_col] *= d;
-    buf[t->clk_col] *= d;
+    // one multiply per slept-through pass, in pass order — NOT an
+    // accumulated power: (s*d)*d != s*(d*d) in fp32 for non-pow2 rates,
+    // and a promoted row must match its never-spilled twin bitwise
+    for (int64_t i = 0; i < missed; ++i) {
+      buf[t->show_col] *= t->last_decay;
+      buf[t->clk_col] *= t->last_decay;
+    }
   }
   s->n_disk--;
   s->dead_disk++;  // the on-disk bytes at `off` are now garbage
@@ -236,6 +248,7 @@ int64_t promote(Table* t, Shard* s, uint64_t j, bool seek_end = true) {
       s->n_used++;
       k = (k + 1) & s->mask;
     }
+    s->n_lazy_shrunk++;
     return -1;
   }
   int64_t row = shard_new_row(t, s, s->hkeys[j]);
@@ -244,6 +257,7 @@ int64_t promote(Table* t, Shard* s, uint64_t j, bool seek_end = true) {
   s->row_touched[row] = rec.touched ? 1 : 0;
   s->hval[j] = row;
   s->hstate[j] = kMem;
+  s->n_promoted++;
   return row;
 }
 
@@ -334,6 +348,188 @@ int64_t compact_spill(Table* t, Shard* s) {
     s->hval[live[i].second] = new_off[i];
   s->dead_disk = 0;
   return (int64_t)live.size();
+}
+
+enum : int { kSpillFifo = 0, kSpillFreq = 1 };
+
+// Write the given mem rows (any order) of one shard to its spill file,
+// convert their hash entries to kDisk, and compact the surviving mem rows
+// in place. Caller holds the shard lock and has opened the spill file.
+// Returns rows spilled, or -2 on IO error.
+int64_t shard_spill_rows(Table* t, Shard* s,
+                         const std::vector<int64_t>& victims) {
+  if (victims.empty()) return 0;
+  fseeko(s->spill, 0, SEEK_END);
+  std::vector<uint8_t> is_victim(s->n_rows, 0);
+  std::vector<int64_t> disk_off(s->n_rows, 0);
+  for (int64_t r : victims) {
+    int64_t off = ftello(s->spill);
+    SpillRec rec{s->row_key[r], t->epoch, s->row_touched[r] ? 1ull : 0ull};
+    if (fwrite(&rec, sizeof(rec), 1, s->spill) != 1 ||
+        fwrite(&s->values[r * t->width], sizeof(float), t->width, s->spill) !=
+            (size_t)t->width)
+      return -2;
+    is_victim[r] = 1;
+    disk_off[r] = off;
+    if (s->row_touched[r]) s->n_disk_touched++;
+  }
+  fflush(s->spill);
+  // compact survivors
+  std::vector<int64_t> remap(s->n_rows, -1);
+  int64_t keep = 0;
+  for (int64_t r = 0; r < s->n_rows; ++r)
+    if (!is_victim[r]) remap[r] = keep++;
+  for (int64_t r = 0; r < s->n_rows; ++r) {
+    int64_t nr = remap[r];
+    if (nr < 0 || nr == r) continue;
+    std::memcpy(&s->values[nr * t->width], &s->values[r * t->width],
+                sizeof(float) * t->width);
+    s->row_key[nr] = s->row_key[r];
+    s->row_touched[nr] = s->row_touched[r];
+    s->row_epoch[nr] = s->row_epoch[r];
+  }
+  for (uint64_t j = 0; j <= s->mask && s->mask; ++j) {
+    if (s->hstate[j] != kMem) continue;
+    int64_t r = s->hval[j];
+    if (is_victim[r]) {
+      s->hstate[j] = kDisk;
+      s->hval[j] = disk_off[r];
+      s->n_disk++;
+    } else {
+      s->hval[j] = remap[r];
+    }
+  }
+  s->n_rows = keep;
+  s->n_spilled += (int64_t)victims.size();
+  // opportunistic space reclaim: once dead records outnumber live ones
+  // the file is mostly garbage — rewrite it now, while we already hold
+  // the shard lock at a pass boundary
+  if (s->dead_disk > s->n_disk && s->dead_disk >= 1024) {
+    if (compact_spill(t, s) < 0) return -2;
+  }
+  return (int64_t)victims.size();
+}
+
+// Coldness-ranked victim pick for one shard: every row under the admission
+// threshold goes first (disk-first admission — sub-threshold keys don't get
+// to occupy RAM past a cap sweep), then the coldest rows by (lowest decayed
+// show, oldest last-touched epoch, lowest row id) until `want` victims.
+// Rows at or above the pin threshold are spilled only once every colder
+// candidate is gone. Caller holds the shard lock.
+void pick_victims_freq(const Table* t, const Shard* s, int64_t want,
+                       float pin_show, float admit_show,
+                       std::vector<int64_t>* victims, int64_t* admitted) {
+  std::vector<int64_t> ranked;  // below pin threshold: normal candidates
+  std::vector<int64_t> pinned;  // at/above pin threshold: last resort
+  for (int64_t r = 0; r < s->n_rows; ++r) {
+    float show = s->values[r * t->width + t->show_col];
+    if (admit_show > 0.0f && show < admit_show) {
+      victims->push_back(r);
+      continue;
+    }
+    if (pin_show > 0.0f && show >= pin_show)
+      pinned.push_back(r);
+    else
+      ranked.push_back(r);
+  }
+  *admitted = (int64_t)victims->size();
+  auto colder = [&](int64_t a, int64_t b) {
+    float sa = s->values[a * t->width + t->show_col];
+    float sb = s->values[b * t->width + t->show_col];
+    if (sa != sb) return sa < sb;
+    if (s->row_epoch[a] != s->row_epoch[b])
+      return s->row_epoch[a] < s->row_epoch[b];
+    return a < b;
+  };
+  int64_t extra = want - *admitted;
+  for (auto* pool : {&ranked, &pinned}) {
+    if (extra <= 0) break;
+    if ((int64_t)pool->size() > extra) {
+      std::partial_sort(pool->begin(), pool->begin() + extra, pool->end(),
+                        colder);
+      pool->resize(extra);
+    } else {
+      std::sort(pool->begin(), pool->end(), colder);
+    }
+    victims->insert(victims->end(), pool->begin(), pool->end());
+    extra -= (int64_t)pool->size();
+  }
+}
+
+int64_t spill_cold_impl(Table* t, int64_t max_mem_rows, int policy,
+                        float pin_show, float admit_show) {
+  if (t->spill_dir.empty()) return -1;
+  std::vector<int64_t> shard_mem(t->n_shards, 0);
+  int64_t mem = 0;
+  for (int si = 0; si < t->n_shards; ++si) {
+    Shard* s = &t->shards[si];
+    std::lock_guard<std::mutex> g(s->mtx);
+    shard_mem[si] = s->n_rows;
+    mem += s->n_rows;
+  }
+  int64_t over = mem - max_mem_rows;
+  if (over <= 0) return 0;
+  int64_t spilled_total = 0;
+  if (policy == kSpillFreq) {
+    // exact largest-remainder apportionment of `over` across shards in
+    // proportion to their occupancy: the post-sweep mem tier stays
+    // balanced by shard and totals exactly max_mem_rows (admission
+    // evictions may push it lower — that's the point of admission)
+    std::vector<int64_t> want(t->n_shards, 0);
+    int64_t assigned = 0;
+    for (int si = 0; si < t->n_shards; ++si) {
+      want[si] = over * shard_mem[si] / mem;
+      assigned += want[si];
+    }
+    int64_t rem = over - assigned;
+    while (rem > 0) {
+      bool progress = false;
+      for (int si = 0; si < t->n_shards && rem > 0; ++si) {
+        if (want[si] < shard_mem[si]) {
+          want[si]++;
+          rem--;
+          progress = true;
+        }
+      }
+      if (!progress) break;
+    }
+    for (int si = 0; si < t->n_shards; ++si) {
+      Shard* s = &t->shards[si];
+      std::lock_guard<std::mutex> g(s->mtx);
+      if (s->n_rows == 0) continue;
+      if (want[si] <= 0 && admit_show <= 0.0f) continue;
+      if (!shard_open_spill(t, si)) return -2;
+      std::vector<int64_t> victims;
+      int64_t admitted = 0;
+      pick_victims_freq(t, s, want[si], pin_show, admit_show, &victims,
+                        &admitted);
+      int64_t n = shard_spill_rows(t, s, victims);
+      if (n < 0) return n;
+      s->n_admit_spilled += admitted;
+      spilled_total += n;
+    }
+    return spilled_total;
+  }
+  // fifo (legacy, kept as the A/B baseline): untouched rows in creation
+  // order, then touched rows, greedily shard by shard until under cap
+  int64_t need = over;
+  for (int si = 0; si < t->n_shards && need > 0; ++si) {
+    Shard* s = &t->shards[si];
+    std::lock_guard<std::mutex> g(s->mtx);
+    if (s->n_rows == 0) continue;
+    if (!shard_open_spill(t, si)) return -2;
+    std::vector<int64_t> victims;
+    for (int64_t r = 0; r < s->n_rows && (int64_t)victims.size() < need; ++r)
+      if (!s->row_touched[r]) victims.push_back(r);
+    for (int64_t r = 0; r < s->n_rows && (int64_t)victims.size() < need; ++r)
+      if (s->row_touched[r]) victims.push_back(r);
+    if (victims.empty()) continue;
+    int64_t n = shard_spill_rows(t, s, victims);
+    if (n < 0) return n;
+    need -= n;
+    spilled_total += n;
+  }
+  return spilled_total;
 }
 
 }  // namespace
@@ -455,6 +651,7 @@ int pbx_table_pull_or_create(void* h, const uint64_t* keys, int64_t n,
       } else {
         row = s->hval[j];
       }
+      s->row_epoch[row] = t->epoch;  // a pull is a touch (recency signal)
       std::memcpy(out + i * t->width, &s->values[row * t->width],
                   sizeof(float) * t->width);
     }
@@ -471,6 +668,37 @@ int pbx_table_push(void* h, const uint64_t* keys, const float* rows,
     std::lock_guard<std::mutex> g(s->mtx);
     while ((s->mask + 1) * 7 < (uint64_t)(s->n_used + m + 1) * 10)
       shard_grow_hash(s);
+    // disk-resident keys in this batch are fully overwritten below — only
+    // the header's touched bit matters. Read those headers in file-offset
+    // order (one sequential sweep, same trick as the batched promote in
+    // pull) instead of an fseeko pair per superseded record.
+    if (s->n_disk >= 64) {
+      std::vector<std::pair<int64_t, uint64_t>> hits;  // (offset, key)
+      for (int64_t q = 0; q < m; ++q) {
+        bool found;
+        uint64_t j = shard_find(s, keys[idx[q]], &found);
+        if (found && s->hstate[j] == kDisk)
+          hits.emplace_back(s->hval[j], s->hkeys[j]);
+      }
+      std::sort(hits.begin(), hits.end());
+      SpillRec rec;
+      for (auto& hit : hits) {
+        bool found;
+        uint64_t j = shard_find(s, hit.second, &found);
+        if (!found || s->hstate[j] != kDisk) continue;  // dup in batch
+        fseeko(s->spill, hit.first, SEEK_SET);
+        if (fread(&rec, sizeof(rec), 1, s->spill) != 1) return -2;
+        if (rec.touched) s->n_disk_touched--;
+        s->n_disk--;
+        s->dead_disk++;  // the superseded on-disk record is garbage now
+        // row contents stay undefined until the main loop's memcpy — every
+        // pre-pass key is in this batch, so each gets overwritten below
+        int64_t row = shard_new_row(t, s, hit.second);
+        s->hval[j] = row;
+        s->hstate[j] = kMem;
+      }
+      if (!hits.empty()) fseeko(s->spill, 0, SEEK_END);
+    }
     for (int64_t q = 0; q < m; ++q) {
       int64_t i = idx[q];
       uint64_t key = keys[i];
@@ -501,6 +729,7 @@ int pbx_table_push(void* h, const uint64_t* keys, const float* rows,
       std::memcpy(&s->values[row * t->width], rows + i * t->width,
                   sizeof(float) * t->width);
       s->row_touched[row] = 1;
+      s->row_epoch[row] = t->epoch;  // a push is a touch
     }
     return 0;
   });
@@ -543,6 +772,7 @@ int64_t pbx_table_decay_shrink(void* h, float decay, float threshold) {
                     sizeof(float) * t->width);
         s->row_key[nr] = s->row_key[r];
         s->row_touched[nr] = s->row_touched[r];
+        s->row_epoch[nr] = s->row_epoch[r];
       }
       s->n_rows = keep;
       // rebuild the hash from scratch: survivors remapped, disk entries
@@ -585,81 +815,55 @@ int64_t pbx_table_decay_shrink(void* h, float decay, float threshold) {
   return dropped;
 }
 
-// Spill cold mem rows to the shard's disk file until total mem rows <=
-// max_mem_rows. Untouched (not pushed since last delta save) rows go
-// first; touched rows are spilled only if still over cap, with the touched
-// bit preserved in the on-disk record so delta saves stay exact. Returns
-// rows spilled, or negative if spill is disabled / IO fails.
+// Spill cold mem rows to the shard disk files until total mem rows <=
+// max_mem_rows, with the touched bit preserved in the on-disk record so
+// delta saves stay exact. Victim selection by policy: kSpillFifo keeps the
+// legacy creation-order sweep (untouched rows first); kSpillFreq ranks by
+// coldness — admission-threshold rows disk-first, then lowest decayed
+// show / oldest last-touched epoch, with rows at/above pin_show spilled
+// only when no colder victim remains, and the sweep apportioned across
+// shards in proportion to occupancy. Returns rows spilled, or negative if
+// spill is disabled (-1) / IO fails (-2).
+int64_t pbx_table_spill_cold_ex(void* h, int64_t max_mem_rows, int policy,
+                                float pin_show, float admit_show) {
+  return spill_cold_impl((Table*)h, max_mem_rows, policy, pin_show,
+                         admit_show);
+}
+
+// Legacy entry point: creation-order (fifo) sweep, no thresholds.
 int64_t pbx_table_spill_cold(void* h, int64_t max_mem_rows) {
+  return spill_cold_impl((Table*)h, max_mem_rows, kSpillFifo, 0.0f, 0.0f);
+}
+
+// Per-shard tier stats, 8 int64 slots per shard:
+//   [mem_rows, disk_rows, spilled_total, promoted_total,
+//    admit_spilled_total, lazy_shrunk_total, dead_records,
+//    spill_file_bytes]
+// `out` must hold n_shards * 8 entries. Returns n_shards.
+int64_t pbx_table_tier_stats(void* h, int64_t* out) {
   Table* t = (Table*)h;
-  if (t->spill_dir.empty()) return -1;
-  int64_t mem = pbx_table_mem_rows(h);
-  if (mem <= max_mem_rows) return 0;
-  int64_t need = mem - max_mem_rows;
-  int64_t spilled_total = 0;
-  for (int si = 0; si < t->n_shards && need > 0; ++si) {
+  for (int si = 0; si < t->n_shards; ++si) {
     Shard* s = &t->shards[si];
     std::lock_guard<std::mutex> g(s->mtx);
-    if (s->n_rows == 0) continue;
-    if (!shard_open_spill(t, si)) return -2;
-    fseeko(s->spill, 0, SEEK_END);
-    // cold-first: untouched rows in creation order, then touched rows
-    std::vector<int64_t> victims;
-    for (int64_t r = 0; r < s->n_rows && (int64_t)victims.size() < need; ++r)
-      if (!s->row_touched[r]) victims.push_back(r);
-    for (int64_t r = 0; r < s->n_rows && (int64_t)victims.size() < need; ++r)
-      if (s->row_touched[r]) victims.push_back(r);
-    if (victims.empty()) continue;
-    // write victims to disk, update hash entries
-    std::vector<uint8_t> is_victim(s->n_rows, 0);
-    std::vector<int64_t> disk_off(s->n_rows, 0);
-    for (int64_t r : victims) {
-      int64_t off = ftello(s->spill);
-      SpillRec rec{s->row_key[r], t->epoch, s->row_touched[r] ? 1ull : 0ull};
-      if (fwrite(&rec, sizeof(rec), 1, s->spill) != 1 ||
-          fwrite(&s->values[r * t->width], sizeof(float), t->width,
-                 s->spill) != (size_t)t->width)
-        return -2;
-      is_victim[r] = 1;
-      disk_off[r] = off;
-      if (s->row_touched[r]) s->n_disk_touched++;
+    int64_t bytes = 0;
+    if (s->spill) {
+      fflush(s->spill);
+      off_t cur = ftello(s->spill);
+      fseeko(s->spill, 0, SEEK_END);
+      bytes = (int64_t)ftello(s->spill);
+      fseeko(s->spill, cur, SEEK_SET);
     }
-    fflush(s->spill);
-    // compact survivors
-    std::vector<int64_t> remap(s->n_rows, -1);
-    int64_t keep = 0;
-    for (int64_t r = 0; r < s->n_rows; ++r)
-      if (!is_victim[r]) remap[r] = keep++;
-    for (int64_t r = 0; r < s->n_rows; ++r) {
-      int64_t nr = remap[r];
-      if (nr < 0 || nr == r) continue;
-      std::memcpy(&s->values[nr * t->width], &s->values[r * t->width],
-                  sizeof(float) * t->width);
-      s->row_key[nr] = s->row_key[r];
-      s->row_touched[nr] = s->row_touched[r];
-    }
-    for (uint64_t j = 0; j <= s->mask && s->mask; ++j) {
-      if (s->hstate[j] != kMem) continue;
-      int64_t r = s->hval[j];
-      if (is_victim[r]) {
-        s->hstate[j] = kDisk;
-        s->hval[j] = disk_off[r];
-        s->n_disk++;
-      } else {
-        s->hval[j] = remap[r];
-      }
-    }
-    s->n_rows = keep;
-    need -= victims.size();
-    spilled_total += victims.size();
-    // opportunistic space reclaim: once dead records outnumber live ones
-    // the file is mostly garbage — rewrite it now, while we already hold
-    // the shard lock at a pass boundary
-    if (s->dead_disk > s->n_disk && s->dead_disk >= 1024) {
-      if (compact_spill(t, s) < 0) return -2;
-    }
+    int64_t* o = out + (int64_t)si * 8;
+    o[0] = s->n_used - s->n_disk;
+    o[1] = s->n_disk;
+    o[2] = s->n_spilled;
+    o[3] = s->n_promoted;
+    o[4] = s->n_admit_spilled;
+    o[5] = s->n_lazy_shrunk;
+    o[6] = s->dead_disk;
+    o[7] = bytes;
   }
-  return spilled_total;
+  return t->n_shards;
 }
 
 // Force-compact every shard's spill file that holds any dead records.
@@ -719,21 +923,27 @@ int64_t pbx_table_shard_shows(void* h, int shard, float* out, int64_t cap) {
   for (int64_t r = 0; r < s->n_rows && n < cap; ++r)
     out[n++] = s->values[r * t->width + t->show_col];
   if (s->n_disk > 0 && s->spill) {
-    for (uint64_t j = 0; j <= s->mask && s->mask && n < cap; ++j) {
-      if (s->hstate[j] != kDisk) continue;
-      SpillRec rec;
-      float show;
-      fseeko(s->spill, s->hval[j], SEEK_SET);
+    // batched sequential read: visit records in file-offset order (the
+    // caller only wants the show distribution, so order is free) instead
+    // of a random seek per hash slot — at scale the cache_threshold scan
+    // was dominating pass-end time
+    std::vector<int64_t> offs;
+    offs.reserve((size_t)s->n_disk);
+    for (uint64_t j = 0; j <= s->mask && s->mask; ++j)
+      if (s->hstate[j] == kDisk) offs.push_back(s->hval[j]);
+    std::sort(offs.begin(), offs.end());
+    SpillRec rec;
+    float show;
+    for (int64_t off : offs) {
+      if (n >= cap) break;
+      fseeko(s->spill, off, SEEK_SET);
       if (fread(&rec, sizeof(rec), 1, s->spill) != 1 ||
           fseeko(s->spill, t->show_col * (off_t)sizeof(float), SEEK_CUR) != 0 ||
           fread(&show, sizeof(float), 1, s->spill) != 1)
         return -2;
       int64_t missed = t->epoch - rec.epoch;
-      if (missed > 0 && t->last_decay < 1.0f) {
-        float d = 1.0f;
-        for (int64_t i = 0; i < missed; ++i) d *= t->last_decay;
-        show *= d;
-      }
+      if (missed > 0 && t->last_decay < 1.0f)
+        for (int64_t i = 0; i < missed; ++i) show *= t->last_decay;
       out[n++] = show;
     }
     fseeko(s->spill, 0, SEEK_END);
@@ -818,11 +1028,17 @@ int64_t pbx_table_snapshot(void* h, int shard, int only_touched,
   bool scan_disk =
       s->spill && (only_touched ? s->n_disk_touched > 0 : s->n_disk > 0);
   if (scan_disk) {
+    // offset-ordered scan (sequential IO, same trick as batched promote);
+    // disk rows land in the snapshot in file order, which no caller
+    // depends on — loads replay records through push, order-insensitive
+    std::vector<std::pair<int64_t, uint64_t>> drecs;  // (offset, hash slot)
+    for (uint64_t j = 0; j <= s->mask && s->mask; ++j)
+      if (s->hstate[j] == kDisk) drecs.push_back({s->hval[j], j});
+    std::sort(drecs.begin(), drecs.end());
     std::vector<float> buf(t->width);
-    for (uint64_t j = 0; j <= s->mask && s->mask; ++j) {
-      if (s->hstate[j] != kDisk) continue;
+    for (auto& dr : drecs) {
       SpillRec rec;
-      fseeko(s->spill, s->hval[j], SEEK_SET);
+      fseeko(s->spill, dr.first, SEEK_SET);
       if (fread(&rec, sizeof(rec), 1, s->spill) != 1 ||
           fread(buf.data(), sizeof(float), t->width, s->spill) !=
               (size_t)t->width)
@@ -830,18 +1046,19 @@ int64_t pbx_table_snapshot(void* h, int shard, int only_touched,
       if (only_touched && !rec.touched) continue;
       int64_t missed = t->epoch - rec.epoch;
       if (missed > 0 && t->last_decay < 1.0f) {
-        float d = 1.0f;
-        for (int64_t i = 0; i < missed; ++i) d *= t->last_decay;
-        buf[t->show_col] *= d;
-        buf[t->clk_col] *= d;
+        // sequential multiplies: bitwise parity with the mem-tier decay
+        for (int64_t i = 0; i < missed; ++i) {
+          buf[t->show_col] *= t->last_decay;
+          buf[t->clk_col] *= t->last_decay;
+        }
       }
-      keys_out[n] = s->hkeys[j];
+      keys_out[n] = s->hkeys[dr.second];
       std::memcpy(vals_out + n * t->width, buf.data(),
                   sizeof(float) * t->width);
       n++;
       if (clear_touched && rec.touched) {
         rec.touched = 0;
-        fseeko(s->spill, s->hval[j], SEEK_SET);
+        fseeko(s->spill, dr.first, SEEK_SET);
         if (fwrite(&rec, sizeof(rec), 1, s->spill) != 1) return -2;
         s->n_disk_touched--;
       }

@@ -1338,6 +1338,9 @@ class BoxPSDataset:
                 # (LoadSSD2Mem inverse; next finalize promotes what it needs)
                 if getattr(table, "mem_cap_rows", None) is not None:
                     table.maybe_spill()
+                # per-pass table.tier.* gauges (occupancy, spill/promote flow)
+                if hasattr(table, "publish_tier_stats"):
+                    table.publish_tier_stats()
                 # the pass is published: drop the rollback snapshot (Confirm)
                 if guard is not None and guard.armed:
                     guard.confirm()

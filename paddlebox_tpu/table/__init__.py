@@ -1,5 +1,9 @@
 from paddlebox_tpu.table.value_layout import ValueLayout, FeatureType
-from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
+from paddlebox_tpu.table.sparse_table import (
+    HostSparseTable,
+    PassWorkingSet,
+    SpillIOError,
+)
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.replica_cache import (
     InputTable,
@@ -12,6 +16,7 @@ __all__ = [
     "FeatureType",
     "HostSparseTable",
     "PassWorkingSet",
+    "SpillIOError",
     "SparseOptimizerConfig",
     "ReplicaCache",
     "InputTable",

@@ -45,14 +45,38 @@ import numpy as np
 from paddlebox_tpu import config
 from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
+from paddlebox_tpu.utils.faultinject import InjectedFault, fire as _fault_fire
 from paddlebox_tpu.utils.fs import atomic_write
-from paddlebox_tpu.utils.monitor import STAT_SET
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
 from paddlebox_tpu.utils.trace import record_event
 
 config.define_flag(
     "boundary_merge_threads", 4,
     "threads for the chunked pass-boundary key merge; <=1 falls back to "
     "the serial np.unique(np.concatenate(...))",
+)
+config.define_flag(
+    "spill_policy", "freq",
+    "victim selection for the RAM->disk cap sweep (maybe_spill): 'freq' "
+    "ranks rows by coldness — lowest decayed show first, oldest "
+    "last-touched epoch breaking ties — honoring spill_pin_show / "
+    "spill_admit_show and balancing the sweep across shards; 'fifo' is "
+    "the legacy creation-order sweep (untouched rows first), kept as the "
+    "A/B baseline",
+)
+config.define_flag(
+    "spill_pin_show", 0.0,
+    "freq policy pin threshold: rows whose decayed show is >= this are "
+    "never spilled while any colder victim exists in their shard "
+    "(0 disables pinning)",
+)
+config.define_flag(
+    "spill_admit_show", 0.0,
+    "freq policy admission threshold: at sweep time every row whose "
+    "decayed show is under this is written disk-first instead of holding "
+    "a RAM slot until pure cap pressure evicts it — pair with "
+    "cache_threshold(rate) to target a resident fraction (0 disables "
+    "admission)",
 )
 
 _HASH_MULT = np.uint64(0x9E3779B97F4A7C15)
@@ -142,6 +166,29 @@ def key_to_shard(keys: np.ndarray, n_shards: int) -> np.ndarray:
     with np.errstate(over="ignore"):
         mixed = keys.astype(np.uint64) * _HASH_MULT
     return (mixed >> np.uint64(33)).astype(np.int64) % n_shards
+
+
+class SpillIOError(IOError):
+    """Typed disk-tier failure from the spill entry points.
+
+    The native store returns -1 (tier disabled) / -2 (IO failure) from
+    ``spill_cold`` / ``compact_spill``; before this type those codes could
+    flow upward as plain ints and read as "spilled -2 rows". Carries the
+    failing op and raw code; every raise is counted under the
+    ``table.spill_errors`` stat.
+    """
+
+    def __init__(self, op: str, rc: int, detail: str = ""):
+        msg = f"spill tier {op} failed rc={rc}"
+        if detail:
+            msg = f"{msg} ({detail})"
+        super().__init__(msg)
+        self.op = op
+        self.rc = rc
+
+
+# flag value -> native policy code (csrc/host_table.cc kSpillFifo/kSpillFreq)
+_SPILL_POLICY_CODES = {"fifo": 0, "freq": 1}
 
 
 class _Shard:
@@ -286,32 +333,115 @@ class HostSparseTable:
         return self._native.disk_rows if self._native else 0
 
     def spill_cold(self, max_mem_rows: int) -> int:
-        """Evict cold rows to disk until RAM tier <= max_mem_rows."""
+        """Evict cold rows to disk until RAM tier <= max_mem_rows.
+
+        Victim selection follows the ``spill_policy`` flag: ``freq`` ranks
+        by coldness (lowest decayed show, then oldest last-touched epoch)
+        with the ``spill_pin_show`` / ``spill_admit_show`` thresholds
+        active; ``fifo`` is the legacy creation-order sweep. Raises
+        :class:`SpillIOError` (counted under ``table.spill_errors``) when
+        the disk tier is disabled or a shard file write fails.
+        """
         if self._native is None:
             raise RuntimeError("spill requires the native table store")
-        return self._native.spill_cold(max_mem_rows)
+        policy = str(config.get_flag("spill_policy"))
+        code = _SPILL_POLICY_CODES.get(policy)
+        if code is None:
+            raise ValueError(
+                f"unknown spill_policy {policy!r} (expected 'freq' or 'fifo')"
+            )
+        try:
+            _fault_fire("spill.io")
+        except InjectedFault as e:
+            STAT_ADD("table.spill_errors", 1)
+            raise SpillIOError("spill_cold", -2, str(e)) from e
+        n = self._native.spill_cold(
+            max_mem_rows,
+            policy=code,
+            pin_show=float(config.get_flag("spill_pin_show")),
+            admit_show=float(config.get_flag("spill_admit_show")),
+        )
+        if n < 0:
+            STAT_ADD("table.spill_errors", 1)
+            raise SpillIOError(
+                "spill_cold", n,
+                "disk tier disabled (no spill_dir)" if n == -1
+                else "shard spill-file write failed",
+            )
+        return n
 
     def maybe_spill(self) -> int:
         """Enforce ``mem_cap_rows`` if configured (pass-end hook)."""
         if self.mem_cap_rows is None or self._native is None:
             return 0
-        return self._native.spill_cold(self.mem_cap_rows)
+        return self.spill_cold(self.mem_cap_rows)
 
     def compact_spill(self) -> int:
         """Reclaim dead spill-file space (records superseded by promotes).
 
         spill_cold compacts a shard automatically once dead records
         outnumber live ones; this forces it everywhere — call at day
-        boundaries. Returns live records kept."""
+        boundaries. Returns live records kept; raises SpillIOError on a
+        shard rewrite failure (the failed shard keeps its old file)."""
         if self._native is None:
             return 0
-        return self._native.compact_spill()
+        n = self._native.compact_spill()
+        if n == -1:  # tier disabled: nothing to reclaim
+            return 0
+        if n < 0:
+            STAT_ADD("table.spill_errors", 1)
+            raise SpillIOError("compact_spill", n, "shard rewrite failed")
+        return n
 
     def spill_stats(self) -> tuple:
         """(live_records, dead_records, file_bytes) of the disk tier."""
         if self._native is None:
             return (0, 0, 0)
         return self._native.spill_stats()
+
+    def tier_stats(self) -> dict:
+        """Tiered-store occupancy + cumulative flow counters.
+
+        Totals over all shards for each field of
+        ``native.TIER_STAT_FIELDS`` (mem_rows, disk_rows, spilled_total,
+        promoted_total, admitted_disk_first, lazy_shrunk, dead_records,
+        spill_bytes), the per-shard maxima of the two occupancy columns
+        (skew telltales), and the full per-shard vectors under
+        ``"per_shard"``. The Python fallback reports mem occupancy only.
+        """
+        from paddlebox_tpu.utils.native import TIER_STAT_FIELDS
+
+        if self._native is not None:
+            per = self._native.tier_stats()
+        else:
+            per = np.zeros((self.n_shards, len(TIER_STAT_FIELDS)), np.int64)
+            for i, sh in enumerate(self._shards):
+                with sh.lock:
+                    per[i, 0] = len(sh.index)
+        out = {f: int(per[:, i].sum()) for i, f in enumerate(TIER_STAT_FIELDS)}
+        out["mem_rows_max_shard"] = int(per[:, 0].max()) if len(per) else 0
+        out["disk_rows_max_shard"] = int(per[:, 1].max()) if len(per) else 0
+        out["per_shard"] = {
+            f: per[:, i].tolist() for i, f in enumerate(TIER_STAT_FIELDS)
+        }
+        return out
+
+    def publish_tier_stats(self) -> dict:
+        """Export :meth:`tier_stats` totals as ``table.tier.*`` STAT gauges
+        (per-shard vectors stay in the returned dict — stat names must be
+        literals, so shard-indexed gauges are out by design)."""
+        st = self.tier_stats()
+        STAT_SET("table.tier.mem_rows", st["mem_rows"])
+        STAT_SET("table.tier.disk_rows", st["disk_rows"])
+        STAT_SET("table.tier.spilled_total", st["spilled_total"])
+        STAT_SET("table.tier.promoted_total", st["promoted_total"])
+        STAT_SET("table.tier.admitted_disk_first", st["admitted_disk_first"])
+        STAT_SET("table.tier.lazy_shrunk", st["lazy_shrunk"])
+        STAT_SET("table.tier.dead_records", st["dead_records"])
+        STAT_SET("table.tier.spill_bytes", st["spill_bytes"])
+        STAT_SET("table.tier.mem_rows_max_shard", st["mem_rows_max_shard"])
+        STAT_SET("table.tier.disk_rows_max_shard", st["disk_rows_max_shard"])
+        return st
 
     def __len__(self) -> int:
         if self._native is not None:

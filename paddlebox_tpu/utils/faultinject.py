@@ -65,6 +65,12 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             mid-apply; the served version must remain the
                             previous complete one (no partial delta is ever
                             visible to score requests)
+    spill.io                table/sparse_table.py  spill_cold, before the
+                            native cap sweep — an injected failure is a
+                            disk-tier write error: surfaced as the typed
+                            SpillIOError and counted under
+                            table.spill_errors (the end_pass worker's
+                            failure path then reopens the pass for retry)
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -111,6 +117,7 @@ KNOWN_SITES = (
     "data.file_read",
     "backend.init",
     "serve.apply_delta",
+    "spill.io",
 )
 
 

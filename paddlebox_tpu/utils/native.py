@@ -43,6 +43,16 @@ _i64p = ctypes.POINTER(ctypes.c_int64)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _f32p = ctypes.POINTER(ctypes.c_float)
 
+# spill victim-selection policies (mirror csrc/host_table.cc kSpill*)
+SPILL_FIFO = 0  # legacy creation-order sweep, untouched rows first
+SPILL_FREQ = 1  # coldness-ranked: admission/pin thresholds + (show, epoch)
+
+# column layout of pbx_table_tier_stats (8 int64 slots per shard)
+TIER_STAT_FIELDS = (
+    "mem_rows", "disk_rows", "spilled_total", "promoted_total",
+    "admitted_disk_first", "lazy_shrunk", "dead_records", "spill_bytes",
+)
+
 
 def _build() -> bool:
     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
@@ -160,6 +170,13 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.pbx_table_spill_cold.restype = ctypes.c_int64
         lib.pbx_table_spill_cold.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pbx_table_spill_cold_ex.restype = ctypes.c_int64
+        lib.pbx_table_spill_cold_ex.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float,
+        ]
+        lib.pbx_table_tier_stats.restype = ctypes.c_int64
+        lib.pbx_table_tier_stats.argtypes = [ctypes.c_void_p, _i64p]
         lib.pbx_table_compact_spill.restype = ctypes.c_int64
         lib.pbx_table_compact_spill.argtypes = [ctypes.c_void_p]
         lib.pbx_table_spill_stats.restype = None
@@ -383,12 +400,11 @@ class NativeHostStore:
 
     def compact_spill(self) -> int:
         """Rewrite shard spill files keeping only live records; returns the
-        live count, or raises on IO error. (spill_cold also compacts a
-        shard opportunistically once dead records outnumber live.)"""
-        n = int(self._lib.pbx_table_compact_spill(self._h))
-        if n < -1:
-            raise IOError(f"spill compaction failed rc={n}")
-        return max(n, 0)
+        live count or the raw negative code (-1 tier disabled, -2 IO
+        failure) for the table layer to map to SpillIOError. (spill_cold
+        also compacts a shard opportunistically once dead records
+        outnumber live.)"""
+        return int(self._lib.pbx_table_compact_spill(self._h))
 
     def spill_stats(self) -> tuple:
         """(live_records, dead_records, file_bytes) of the disk tier."""
@@ -400,11 +416,29 @@ class NativeHostStore:
         )
         return int(live.value), int(dead.value), int(nbytes.value)
 
-    def spill_cold(self, max_mem_rows: int) -> int:
-        n = int(self._lib.pbx_table_spill_cold(self._h, max_mem_rows))
-        if n < 0:
-            raise IOError(f"native table spill failed rc={n}")
-        return n
+    def spill_cold(
+        self,
+        max_mem_rows: int,
+        policy: int = SPILL_FIFO,
+        pin_show: float = 0.0,
+        admit_show: float = 0.0,
+    ) -> int:
+        """Run one cap sweep; returns rows spilled, or the raw NEGATIVE
+        native code (-1 tier disabled, -2 IO failure). The table layer maps
+        codes to the typed SpillIOError — the raw int never escapes to a
+        caller that could read it as "spilled -2 rows"."""
+        return int(self._lib.pbx_table_spill_cold_ex(
+            self._h, int(max_mem_rows), int(policy),
+            float(pin_show), float(admit_show),
+        ))
+
+    def tier_stats(self) -> np.ndarray:
+        """int64 [n_shards, len(TIER_STAT_FIELDS)] per-shard occupancy and
+        cumulative spill/promote counters, rows ordered by shard id."""
+        out = np.zeros((self.n_shards, len(TIER_STAT_FIELDS)), np.int64)
+        if self.n_shards:
+            self._lib.pbx_table_tier_stats(self._h, _as_ptr(out, ctypes.c_int64))
+        return out
 
     def clear_touched(self) -> None:
         self._lib.pbx_table_clear_touched(self._h)

@@ -1,0 +1,342 @@
+"""Frequency-aware tiered store: eviction ranking, admission, pin, A/B.
+
+The reference's 1e11-key store survives because hot feasigns stay in the
+fast tier (BoxPS LoadSSD2Mem + cache-rate policy, box_wrapper.cc:1325);
+the open table's cap sweep (spill_cold) earns the same property with a
+CTR-style coldness ranking — lowest decayed show first, oldest
+last-touched epoch breaking ties — plus pin/admission thresholds. These
+tests pin the policy semantics, the bitwise promote contract under the new
+thresholds, the typed SpillIOError path, the tier_stats surface, and the
+fifo-vs-freq A/B claim (fewer promotes at equal mem_cap_rows) that
+tools/scale_soak.py --zipf measures at scale.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    SpillIOError,
+    ValueLayout,
+)
+from paddlebox_tpu.utils.faultinject import fail_once, inject
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPILL_FLAGS = ("spill_policy", "spill_pin_show", "spill_admit_show")
+
+
+@pytest.fixture(autouse=True)
+def _restore_spill_flags():
+    saved = {n: config.get_flag(n) for n in SPILL_FLAGS}
+    yield
+    for n, v in saved.items():
+        config.set_flag(n, v)
+
+
+def _native_or_skip():
+    from paddlebox_tpu.utils import native
+
+    if not native.available():
+        pytest.skip("native table store unavailable")
+
+
+def _make_table(d, n_shards=4, decay=1.0, cap=None, embedx=1, spill=True):
+    return HostSparseTable(
+        ValueLayout(embedx_dim=embedx),
+        SparseOptimizerConfig(show_clk_decay=decay, shrink_threshold=0.0),
+        n_shards=n_shards,
+        seed=0,
+        spill_dir=(d if spill else None),
+        mem_cap_rows=cap,
+    )
+
+
+def _seed_shows(table, lay, keys, show):
+    rows = table.pull_or_create(keys)
+    rows[:, lay.SHOW] = show
+    table.push(keys, rows)
+
+
+def test_freq_spills_coldest_keeps_hot_resident():
+    """freq ranks victims by decayed show: after a sweep the hot set must
+    still be RAM-resident (re-pulling it promotes nothing) even though the
+    hot keys were created FIRST — the exact stream that defeats fifo."""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=1)
+    with tempfile.TemporaryDirectory() as d:
+        table = _make_table(d)
+        hot = np.arange(1, 101, dtype=np.uint64)
+        cold = np.arange(1001, 1901, dtype=np.uint64)
+        _seed_shows(table, lay, hot, 50.0)  # created before the cold tail
+        _seed_shows(table, lay, cold, 1.0)
+        config.set_flag("spill_policy", "freq")
+        spilled = table.spill_cold(200)
+        assert spilled == 800
+        st = table.tier_stats()
+        assert st["mem_rows"] == 200 and st["disk_rows"] == 800
+        before = st["promoted_total"]
+        table.pull_or_create(hot)
+        assert table.tier_stats()["promoted_total"] == before  # all resident
+
+
+def test_fifo_spills_creation_order():
+    """The legacy baseline evicts in creation order regardless of show —
+    the early-created hot head lands on disk and every re-pull promotes.
+    (This contrast is WHY the soak's A/B favors freq.)"""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=1)
+    with tempfile.TemporaryDirectory() as d:
+        table = _make_table(d, n_shards=1)
+        hot = np.arange(1, 101, dtype=np.uint64)
+        cold = np.arange(1001, 1901, dtype=np.uint64)
+        _seed_shows(table, lay, hot, 50.0)
+        _seed_shows(table, lay, cold, 1.0)
+        config.set_flag("spill_policy", "fifo")
+        assert table.spill_cold(200) == 800
+        before = table.tier_stats()["promoted_total"]
+        table.pull_or_create(hot)
+        # creation-order sweep spilled the whole hot head
+        assert table.tier_stats()["promoted_total"] == before + 100
+
+
+def test_pin_threshold_spills_pinned_only_under_pressure():
+    """Rows at/above spill_pin_show are spilled only once every colder
+    victim in the shard is gone; when cap pressure exceeds the cold pool
+    the sweep must still converge (pins yield rather than deadlock)."""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=1)
+    with tempfile.TemporaryDirectory() as d:
+        table = _make_table(d, n_shards=1)
+        hot = np.arange(1, 101, dtype=np.uint64)
+        cold = np.arange(1001, 1101, dtype=np.uint64)
+        _seed_shows(table, lay, hot, 50.0)
+        _seed_shows(table, lay, cold, 1.0)
+        config.set_flag("spill_policy", "freq")
+        config.set_flag("spill_pin_show", 10.0)
+        # need 150 victims but only 100 are colder than the pin: all cold
+        # spill first, then exactly 50 pinned rows yield
+        assert table.spill_cold(50) == 150
+        before = table.tier_stats()["promoted_total"]
+        table.pull_or_create(hot)
+        assert table.tier_stats()["promoted_total"] == before + 50
+        table.pull_or_create(cold)  # every cold row was on disk
+        assert table.tier_stats()["promoted_total"] == before + 150
+
+
+def test_admission_threshold_writes_cold_disk_first():
+    """At sweep time every row under spill_admit_show goes disk-first even
+    beyond the cap overage, and the admitted count is surfaced."""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=1)
+    with tempfile.TemporaryDirectory() as d:
+        table = _make_table(d, n_shards=1)
+        warm = np.arange(1, 51, dtype=np.uint64)
+        junk = np.arange(1001, 1051, dtype=np.uint64)
+        _seed_shows(table, lay, warm, 10.0)
+        _seed_shows(table, lay, junk, 1.0)
+        config.set_flag("spill_policy", "freq")
+        config.set_flag("spill_admit_show", 5.0)
+        # over = 10, but admission must take the whole sub-threshold set
+        spilled = table.spill_cold(90)
+        st = table.tier_stats()
+        assert st["admitted_disk_first"] == 50
+        assert spilled == 50 and st["disk_rows"] == 50
+        before = st["promoted_total"]
+        table.pull_or_create(warm)  # warm rows never left RAM
+        assert table.tier_stats()["promoted_total"] == before
+
+
+def test_promote_catchup_bitwise_with_thresholds():
+    """Spill -> decay passes -> promote must reproduce the never-spilled
+    table bitwise, with pin/admission thresholds active and a decay rate
+    (0.9) whose powers are NOT exact in fp32 — the catch-up must replay
+    the same sequential multiplies the resident path applied."""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=3)
+    rng = np.random.default_rng(7)
+    keys = np.unique(rng.integers(1, 1 << 48, 3000).astype(np.uint64))
+    vals = rng.normal(0, 1, (len(keys), lay.width)).astype(np.float32)
+    vals[:, lay.SHOW] = rng.uniform(0.5, 60.0, len(keys)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as d:
+        spilly = _make_table(d, decay=0.9, embedx=3)
+        control = _make_table(None, decay=0.9, embedx=3, spill=False)
+        for t in (spilly, control):
+            t.pull_or_create(keys)
+            t.push(keys, vals.copy())
+        config.set_flag("spill_policy", "freq")
+        config.set_flag("spill_pin_show", 30.0)
+        config.set_flag("spill_admit_show", 2.0)
+        spilly.spill_cold(len(keys) // 3)
+        assert spilly.tier_stats()["disk_rows"] > 0
+        for _ in range(5):  # spilled rows fall 5 decay epochs behind
+            spilly.decay_and_shrink()
+            control.decay_and_shrink()
+        got = spilly.pull_or_create(keys)  # promote + catch-up decay
+        want = control.pull_or_create(keys)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_freq_beats_fifo_on_zipf_stream():
+    """The A/B unit claim: same seeded zipf stream, same mem_cap_rows,
+    freq must finish with strictly fewer disk promotes than fifo."""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=1)
+    promotes = {}
+    for policy in ("freq", "fifo"):
+        with tempfile.TemporaryDirectory() as d:
+            table = _make_table(d, n_shards=8, decay=0.98, cap=1500)
+            config.set_flag("spill_policy", policy)
+            for p in range(4):
+                rng = np.random.default_rng((3, p))
+                raw = rng.zipf(1.3, 20_000)
+                folded = ((raw - 1) % 5000).astype(np.uint64)
+                with np.errstate(over="ignore"):
+                    keys = folded * np.uint64(0x9E3779B97F4A7C15) + np.uint64(1)
+                uniq, counts = np.unique(keys, return_counts=True)
+                rows = table.pull_or_create(uniq)
+                rows[:, lay.SHOW] += counts.astype(np.float32)
+                table.push(uniq, rows)
+                table.decay_and_shrink()
+                table.maybe_spill()
+            promotes[policy] = table.tier_stats()["promoted_total"]
+    assert promotes["freq"] < promotes["fifo"], promotes
+
+
+def test_spill_io_error_typed_and_counted():
+    """A failing sweep surfaces as the typed SpillIOError (an IOError, so
+    existing retry tiers still catch it), bumps table.spill_errors, and a
+    healed retry succeeds."""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=1)
+    with tempfile.TemporaryDirectory() as d:
+        table = _make_table(d, cap=100)
+        keys = np.arange(1, 501, dtype=np.uint64)
+        _seed_shows(table, lay, keys, 1.0)
+        before = STAT_GET("table.spill_errors")
+        with inject(fail_once("spill.io")):
+            with pytest.raises(SpillIOError) as ei:
+                table.maybe_spill()
+            assert isinstance(ei.value, IOError)
+            assert ei.value.op == "spill_cold" and ei.value.rc == -2
+            assert STAT_GET("table.spill_errors") == before + 1
+            assert table.maybe_spill() == 400  # healed retry inside plan
+        assert table.tier_stats()["mem_rows"] == 100
+
+
+def test_spill_without_disk_tier_raises_typed():
+    """spill_cold on a table built without spill_dir: the native rc -1
+    maps to SpillIOError too (fifo + freq alike)."""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=1)
+    table = _make_table(None, spill=False)
+    _seed_shows(table, lay, np.arange(1, 301, dtype=np.uint64), 1.0)
+    for policy in ("freq", "fifo"):
+        config.set_flag("spill_policy", policy)
+        with pytest.raises(SpillIOError) as ei:
+            table.spill_cold(10)
+        assert ei.value.rc == -1
+
+
+def test_unknown_policy_rejected():
+    _native_or_skip()
+    with tempfile.TemporaryDirectory() as d:
+        table = _make_table(d)
+        table.pull_or_create(np.arange(1, 101, dtype=np.uint64))
+        config.set_flag("spill_policy", "lru")
+        with pytest.raises(ValueError, match="spill_policy"):
+            table.spill_cold(10)
+
+
+def test_tier_stats_shape_and_gauges():
+    """tier_stats must expose every TIER_STAT_FIELDS total, per-shard
+    vectors, and skew maxima consistent with mem_rows/disk_rows; the
+    publish hook mirrors the totals into literal table.tier.* gauges."""
+    _native_or_skip()
+    from paddlebox_tpu.utils.native import TIER_STAT_FIELDS
+
+    lay = ValueLayout(embedx_dim=1)
+    with tempfile.TemporaryDirectory() as d:
+        table = _make_table(d, n_shards=4)
+        _seed_shows(table, lay, np.arange(1, 1001, dtype=np.uint64), 1.0)
+        table.spill_cold(300)
+        st = table.publish_tier_stats()
+        for f in TIER_STAT_FIELDS:
+            assert f in st
+            assert len(st["per_shard"][f]) == 4
+            assert sum(st["per_shard"][f]) == st[f]
+        assert st["mem_rows"] == table.mem_rows == 300
+        assert st["disk_rows"] == table.disk_rows == 700
+        assert st["spilled_total"] == 700
+        assert st["spill_bytes"] > 0
+        assert st["mem_rows_max_shard"] == max(st["per_shard"]["mem_rows"])
+        assert STAT_GET("table.tier.mem_rows") == 300
+        assert STAT_GET("table.tier.disk_rows") == 700
+        assert STAT_GET("table.tier.spilled_total") == 700
+        # the freq sweep apportions by occupancy: no shard hoards the cap
+        assert st["mem_rows_max_shard"] <= 300  # trivial bound
+        assert st["mem_rows_max_shard"] < 300 or table.n_shards == 1
+
+
+def test_cap_never_hit_is_bitwise_noop():
+    """With mem_cap_rows above the working set the tier machinery must be
+    invisible: zero spills and rows bitwise equal to a no-tier table."""
+    _native_or_skip()
+    lay = ValueLayout(embedx_dim=2)
+    rng = np.random.default_rng(11)
+    keys = np.unique(rng.integers(1, 1 << 40, 2000).astype(np.uint64))
+    vals = rng.normal(0, 1, (len(keys), lay.width)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as d:
+        tiered = _make_table(d, decay=0.9, cap=10_000_000, embedx=2)
+        plain = _make_table(None, decay=0.9, embedx=2, spill=False)
+        for t in (tiered, plain):
+            t.pull_or_create(keys)
+            t.push(keys, vals.copy())
+            t.decay_and_shrink()
+        tiered.maybe_spill()
+        st = tiered.tier_stats()
+        assert st["spilled_total"] == 0 and st["disk_rows"] == 0
+        np.testing.assert_array_equal(
+            tiered.pull_or_create(keys), plain.pull_or_create(keys)
+        )
+
+
+def test_scale_soak_zipf_smoke():
+    """tools/scale_soak.py --zipf at toy scale: both policies run, tier
+    stats land in the JSON, and with a cap that is never hit the two
+    policies' table digests are bitwise identical."""
+    _native_or_skip()
+    with tempfile.TemporaryDirectory() as d:
+        out = os.path.join(d, "tier.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "scale_soak.py"),
+             "--zipf", "--keys", "1e5", "--passes", "2", "--draws", "3e4",
+             "--mem-cap", "1000000000", "--out", out],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+        with open(out) as f:
+            res = json.load(f)
+        assert res["metric"] == "tiered_store_zipf_soak"
+        for policy in ("freq", "fifo"):
+            pol = res["policies"][policy]
+            assert pol["tier_stats"]["spilled_total"] == 0  # cap never hit
+            assert len(pol["passes"]) == 2
+            assert all(p["spill_hit_rate"] == 1.0 for p in pol["passes"])
+        assert res["ab"]["bitwise_equal"] is True
+        assert (
+            res["policies"]["freq"]["digest"]
+            == res["policies"]["fifo"]["digest"]
+        )

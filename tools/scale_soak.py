@@ -18,6 +18,18 @@ SOAK_r05.json. Pass sizing: each rank references keys/2 uint64 keys with
 a realistic mix of owned and remote keys.
 
   python tools/scale_soak.py [--keys 1e8] [--out SOAK_r05.json]
+
+--zipf switches to the tiered-store A/B soak (ROADMAP item 3): a seeded
+zipf-skewed CTR key stream over a --keys key space is driven through
+multi-pass pull/push/decay/spill cycles TWICE — once per spill policy
+(freq, fifo) — at the same mem_cap_rows, recording per-pass wall times
+(the degradation curve), promote counts, spill hit-rates, and per-shard
+occupancy from table.tier_stats(), plus a full-table sha256 digest that
+must be bitwise-identical across policies (catch-up decay is exact).
+
+  python tools/scale_soak.py --zipf --keys 1e9 [--passes 8] [--draws 4e6]
+      [--mem-cap ROWS] [--zipf-a 1.2] [--pin-show X] [--admit-rate R]
+      [--no-digest] [--out SOAK_TIER.json]
 """
 
 from __future__ import annotations
@@ -155,7 +167,248 @@ def worker(rank: int, conf: dict) -> None:
     print(f"rank {rank}: {json.dumps(out)}", flush=True)
 
 
+# ---------------------------------------------------------------------------
+# --zipf: tiered-store A/B soak (freq vs fifo at equal mem_cap_rows)
+# ---------------------------------------------------------------------------
+
+
+def _zipf_pass_keys(rng, key_space: int, draws: int, a: float):
+    """One pass of a seeded zipf-skewed CTR stream: (unique keys, counts).
+
+    The raw zipf ranks are folded into [0, key_space) and then mixed by an
+    odd-constant uint64 multiply so hot keys land on uncorrelated shards
+    (rank 1 would otherwise always hash identically across runs of any
+    key_space).
+    """
+    import numpy as np
+
+    raw = rng.zipf(a, draws)
+    folded = ((raw - 1) % key_space).astype(np.uint64)
+    with np.errstate(over="ignore"):
+        keys = folded * np.uint64(0x9E3779B97F4A7C15) + np.uint64(1)
+    return np.unique(keys, return_counts=True)
+
+
+def _table_digest(table) -> str:
+    """sha256 over the key-sorted full snapshot of every shard — bitwise
+    table identity (the cap-never-hit / cross-policy equivalence oracle)."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for s in range(table.n_shards):
+        keys, vals = table._snapshot_shard(
+            s, only_touched=False, clear_touched=False
+        )
+        order = np.argsort(keys, kind="stable")
+        h.update(keys[order].tobytes())
+        h.update(np.ascontiguousarray(vals[order]).tobytes())
+    return h.hexdigest()
+
+
+def run_zipf_policy(policy: str, conf: dict) -> dict:
+    """Drive one spill policy through the full multi-pass tier cycle.
+
+    Fresh table + spill dir per policy; the key stream is re-derived from
+    the same seed so both policies see the identical pass sequence.
+    """
+    import numpy as np
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+
+    layout = ValueLayout(embedx_dim=conf["embedx_dim"])
+    opt = SparseOptimizerConfig(
+        embedx_threshold=0.0,
+        show_clk_decay=conf["decay"],
+        shrink_threshold=0.0,
+    )
+    spill_dir = os.path.join(conf["workdir"], f"spill-{policy}")
+    os.makedirs(spill_dir, exist_ok=True)
+    saved = {
+        n: config.get_flag(n)
+        for n in ("spill_policy", "spill_pin_show", "spill_admit_show")
+    }
+    out = {"policy": policy, "passes": []}
+    try:
+        config.set_flag("spill_policy", policy)
+        config.set_flag("spill_pin_show", conf["pin_show"])
+        config.set_flag("spill_admit_show", conf["admit_show"])
+        table = HostSparseTable(
+            layout, opt, n_shards=conf["n_shards"], seed=0,
+            mem_cap_rows=conf["mem_cap_rows"], spill_dir=spill_dir,
+        )
+        prev = table.tier_stats()
+        t_all = time.perf_counter()
+        for p in range(conf["passes"]):
+            rng = np.random.default_rng((conf["seed"], p))
+            uniq, counts = _zipf_pass_keys(
+                rng, conf["keys"], conf["draws"], conf["zipf_a"]
+            )
+            t0 = time.perf_counter()
+            rows = table.pull_or_create(uniq)
+            rows[:, layout.SHOW] += counts.astype(np.float32)
+            table.push(uniq, rows)
+            table.decay_and_shrink()
+            if conf["admit_rate"] > 0.0:
+                # re-derive the admission threshold from the live show
+                # distribution: coldest ~admit_rate of keys go disk-first
+                config.set_flag(
+                    "spill_admit_show",
+                    float(table.cache_threshold(conf["admit_rate"])),
+                )
+            table.maybe_spill()
+            pass_s = time.perf_counter() - t0
+            st = table.tier_stats()
+            promotes = st["promoted_total"] - prev["promoted_total"]
+            spilled = st["spilled_total"] - prev["spilled_total"]
+            admitted = (
+                st["admitted_disk_first"] - prev["admitted_disk_first"]
+            )
+            prev = st
+            out["passes"].append({
+                "pass": p,
+                "pass_s": round(pass_s, 4),
+                "uniq_keys": int(len(uniq)),
+                "promotes": int(promotes),
+                "spilled": int(spilled),
+                "admitted_disk_first": int(admitted),
+                # pulls served without a disk promote, over unique pulls
+                "spill_hit_rate": round(1.0 - promotes / len(uniq), 6),
+                "mem_rows": int(st["mem_rows"]),
+                "disk_rows": int(st["disk_rows"]),
+            })
+        out["wall_s"] = round(time.perf_counter() - t_all, 3)
+        st = table.tier_stats()
+        per_shard = st.pop("per_shard")
+        out["tier_stats"] = {k: int(v) for k, v in st.items()}
+        out["per_shard_mem_rows"] = [int(v) for v in per_shard["mem_rows"]]
+        out["per_shard_disk_rows"] = [
+            int(v) for v in per_shard["disk_rows"]
+        ]
+        if conf["digest"]:
+            t0 = time.perf_counter()
+            out["digest"] = _table_digest(table)
+            out["digest_s"] = round(time.perf_counter() - t0, 3)
+        del table
+    finally:
+        for n, v in saved.items():
+            config.set_flag(n, v)
+    return out
+
+
+def zipf_main(argv) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="scale_soak.py --zipf")
+    ap.add_argument("--zipf", action="store_true")
+    ap.add_argument("--keys", default="1e9", help="key SPACE of the stream")
+    ap.add_argument("--passes", type=int, default=8)
+    ap.add_argument("--draws", default=None,
+                    help="stream draws per pass (default min(4e6, keys))")
+    ap.add_argument("--mem-cap", default=None,
+                    help="mem_cap_rows (default draws//2: cap always hit)")
+    ap.add_argument("--zipf-a", type=float, default=1.2)
+    ap.add_argument("--decay", type=float, default=0.98)
+    ap.add_argument("--pin-show", type=float, default=0.0)
+    ap.add_argument("--admit-show", type=float, default=0.0)
+    ap.add_argument("--admit-rate", type=float, default=0.0,
+                    help="re-derive spill_admit_show from cache_threshold "
+                         "each pass (freq policy)")
+    ap.add_argument("--n-shards", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-digest", action="store_true")
+    ap.add_argument("--out", default=os.path.join(REPO, "SOAK_TIER.json"))
+    args = ap.parse_args(argv)
+
+    from paddlebox_tpu.utils import native
+
+    if not native.available():
+        print("zipf soak needs the native table", file=sys.stderr)
+        return 1
+    keys = int(float(args.keys))
+    draws = (
+        int(float(args.draws)) if args.draws is not None
+        else min(4_000_000, max(1000, keys))
+    )
+    with tempfile.TemporaryDirectory() as workdir:
+        conf = {
+            "keys": keys,
+            "draws": draws,
+            "passes": args.passes,
+            "mem_cap_rows": (
+                int(float(args.mem_cap)) if args.mem_cap is not None
+                else max(1, draws // 2)
+            ),
+            "zipf_a": args.zipf_a,
+            "decay": args.decay,
+            "pin_show": args.pin_show,
+            "admit_show": args.admit_show,
+            "admit_rate": args.admit_rate,
+            "n_shards": args.n_shards,
+            "seed": args.seed,
+            "embedx_dim": 8,
+            "digest": not args.no_digest,
+            "workdir": workdir,
+        }
+        policies = {}
+        for policy in ("freq", "fifo"):
+            policies[policy] = run_zipf_policy(policy, conf)
+            print(
+                f"{policy}: wall={policies[policy]['wall_s']}s "
+                f"promotes={policies[policy]['tier_stats']['promoted_total']} "
+                f"spilled={policies[policy]['tier_stats']['spilled_total']}",
+                flush=True,
+            )
+    pf = policies["freq"]["tier_stats"]
+    pq = policies["fifo"]["tier_stats"]
+    hr = {
+        k: round(
+            sum(p["spill_hit_rate"] * p["uniq_keys"] for p in v["passes"])
+            / max(1, sum(p["uniq_keys"] for p in v["passes"])),
+            6,
+        )
+        for k, v in policies.items()
+    }
+    ab = {
+        "mem_cap_rows": conf["mem_cap_rows"],
+        "promotes_freq": pf["promoted_total"],
+        "promotes_fifo": pq["promoted_total"],
+        # fraction of fifo's disk promotes the freq ranking avoided
+        "promote_improvement": round(
+            1.0 - pf["promoted_total"] / max(1, pq["promoted_total"]), 6
+        ),
+        "spill_hit_rate_freq": hr["freq"],
+        "spill_hit_rate_fifo": hr["fifo"],
+        "wall_s_freq": policies["freq"]["wall_s"],
+        "wall_s_fifo": policies["fifo"]["wall_s"],
+    }
+    if conf["digest"]:
+        ab["bitwise_equal"] = (
+            policies["freq"]["digest"] == policies["fifo"]["digest"]
+        )
+    conf.pop("workdir")
+    result = {
+        "metric": "tiered_store_zipf_soak",
+        "conf": conf,
+        "policies": policies,
+        "ab": ab,
+        "machine": {"cpus": os.cpu_count()},
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"ab": ab}))
+    return 0
+
+
 def main() -> int:
+    if "--zipf" in sys.argv:
+        return zipf_main(sys.argv[1:])
     keys = int(float(next(
         (sys.argv[i + 1] for i, a in enumerate(sys.argv) if a == "--keys"),
         "1e8",
