@@ -528,6 +528,18 @@ def main():
             "a2a_payload_bytes": int(STAT_GET("wire.a2a_payload_bytes")),
             "a2a_fp32_bytes": int(STAT_GET("wire.a2a_fp32_bytes")),
             "a2a_dtype_bits": int(STAT_GET("wire.a2a_dtype_bits")),
+            # host plane (PBTX v3 frame choke point + working-set
+            # exchange rounds, ops/host_codec.py): actual bytes shipped
+            # vs what the raw v2 framing would have shipped
+            "host_wire_codec": bool(_config.get_flag("host_wire_codec")),
+            "host_bytes_sent": int(STAT_GET("wire.host_bytes_sent")),
+            "host_raw_bytes_sent": int(STAT_GET("wire.host_raw_bytes_sent")),
+            "host_bytes_recv": int(STAT_GET("wire.host_bytes_recv")),
+            "host_raw_bytes_recv": int(STAT_GET("wire.host_raw_bytes_recv")),
+            "ws_req_bytes": int(STAT_GET("wire.ws_req_bytes")),
+            "ws_req_raw_bytes": int(STAT_GET("wire.ws_req_raw_bytes")),
+            "ws_rep_bytes": int(STAT_GET("wire.ws_rep_bytes")),
+            "ws_rep_raw_bytes": int(STAT_GET("wire.ws_rep_raw_bytes")),
         },
         # which kernel plan routed pull/push this run, and how often it
         # chose pallas (ops/kernel_plan.py; regenerate with

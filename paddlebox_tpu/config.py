@@ -86,6 +86,26 @@ define_flag(
     "fp32 | bf16 | int8 (bf16/int8 keep the show/clk counter columns fp32; "
     "int8 carries one per-record max-abs scale)",
 )
+define_flag(
+    "host_wire_codec",
+    True,
+    "host-plane wire codec (ops/host_codec.py): delta+varint key streams "
+    "in the working-set exchange and chunked-zlib PBTX v3 frame payloads. "
+    "False is the raw ablation — bitwise-identical results, more bytes "
+    "(wire.host_raw_bytes_* vs wire.host_bytes_* measures the cut)",
+)
+define_flag(
+    "host_compress_level",
+    1,
+    "zlib level for PBTX v3 frame payloads (1 = fastest: the codec runs "
+    "on the sender's worker thread and must outrun the socket to win)",
+)
+define_flag(
+    "host_compress_min_bytes",
+    512,
+    "frames smaller than this ship raw: below it the zlib+chunk-table "
+    "overhead eats the win and the codec byte already marks them raw",
+)
 
 # --- sparse table ---
 define_flag("sparse_table_shard_bits", 6, "log2 host shards in the tiered store")

@@ -24,14 +24,27 @@ closed boxps tier, rebuilt in the open — see docs/ROBUSTNESS.md,
 "Distributed plane"):
 
 - Every connection opens with a versioned HELLO handshake; the accepting
-  side replies with the count of data frames it has already delivered
-  from that peer, so a reconnecting sender resumes exactly where the
-  receiver left off.
-- Every frame carries a per-destination sequence number and a CRC32 over
-  tag+payload. The receiver drops duplicates (``seq <= delivered``) and
-  kills the connection on checksum mismatch — the sender's resync replays
-  the lost tail, so a frame is delivered exactly once or the send fails
-  loudly.
+  side replies ``_HELLO_REPLY`` (magic, its protocol version, the count of
+  data frames it has already delivered from that peer), so a reconnecting
+  sender resumes exactly where the receiver left off. Version capability
+  is negotiated here: a mismatched peer gets the reply (carrying the
+  listener's version) and a closed connection, and the sender raises the
+  typed :class:`VersionMismatchError` naming both versions — never a hang,
+  never downstream CRC noise. A pre-v3 peer that closes without any reply
+  surfaces the same typed error with ``peer_version=None``.
+- Every frame carries a per-destination sequence number, a codec byte
+  (PBTX v3: 0 = raw, 1 = chunked zlib via ``ops/host_codec.py``), and a
+  CRC32 over tag + *encoded* payload — corruption is caught before any
+  inflate runs. The receiver drops duplicates (``seq <= delivered``) and
+  kills the connection on checksum or decode failure — the sender's
+  resync replays the lost tail, so a frame is delivered exactly once or
+  the send fails loudly.
+- Compression happens on the sender's calling thread *before* taking the
+  per-destination send lock, so one peer's codec work overlaps another
+  peer's socket write; ``wire.host_bytes_*`` (actual frame bytes) vs
+  ``wire.host_raw_bytes_*`` (what v2 would have shipped) at this choke
+  point are the measurement the ROADMAP host-wire claim is graded
+  against.
 - The send path keeps un-acked frames in a per-destination resend buffer
   and heals dropped connections with bounded exponential backoff
   (``transport_send_retries`` x ``transport_backoff_s``).
@@ -60,21 +73,33 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from paddlebox_tpu import config
+from paddlebox_tpu.ops import host_codec
 from paddlebox_tpu.utils.faultinject import fire
 from paddlebox_tpu.utils.monitor import STAT_ADD
 from paddlebox_tpu.utils.trace import PROFILER
 
 _MAGIC = b"PBTX"
-_VERSION = 2
+_VERSION = 3
 # connection handshake: magic, protocol version, sender rank
 _HELLO = struct.Struct("<4sHH")
-# handshake reply / heartbeat ack payload: delivered data-frame count
+# v3 handshake reply: magic, listener's protocol version, delivered
+# data-frame count (the resync point). On version mismatch the listener
+# still sends this (delivered=0) before closing, so the peer can name the
+# incompatible version instead of guessing from a dropped connection.
+_HELLO_REPLY = struct.Struct("<4sHQ")
+# heartbeat ack payload: delivered data-frame count
 _ACK = struct.Struct("<Q")
-# frame header: seq, kind, tag_len, payload_len, crc32(tag+payload)
-_FRAME = struct.Struct("<QBHII")
+# frame header: seq, kind, codec, tag_len, payload_len,
+# crc32(tag + encoded payload) — the CRC covers the bytes as shipped, so
+# corruption is caught before any inflate
+_FRAME = struct.Struct("<QBBHII")
 
 _KIND_DATA = 0
 _KIND_HEARTBEAT = 1
+
+# frame payload codecs (PBTX v3)
+_CODEC_RAW = 0
+_CODEC_ZLIB = 1
 
 _EPOCH_RE = re.compile(r"@e(\d+)$")
 
@@ -121,7 +146,28 @@ class PeerDeadError(ConnectionError):
 
 
 class ProtocolError(ConnectionError):
-    """Handshake magic/version mismatch — incompatible peer."""
+    """Handshake magic/version mismatch — incompatible peer. Never
+    retried: reconnecting cannot change the peer's protocol."""
+
+
+class VersionMismatchError(ProtocolError):
+    """HELLO version negotiation failed; names both protocol versions.
+
+    ``peer_version`` is None when the peer closed without any version
+    reply — the signature of a pre-v3 listener, which rejects unknown
+    HELLO versions by silently dropping the connection."""
+
+    def __init__(self, local: int, peer: Optional[int]):
+        peer_s = (
+            f"v{peer}"
+            if peer is not None
+            else "<= v2 (closed without a version reply)"
+        )
+        super().__init__(
+            f"PBTX protocol version mismatch: local v{local}, peer {peer_s}"
+        )
+        self.local_version = local
+        self.peer_version = peer
 
 
 class _SendLink:
@@ -229,26 +275,36 @@ class TcpTransport:
                 STAT_ADD("transport.protocol_errors")
                 PROFILER.instant(
                     "transport:protocol_error",
-                    {"magic": repr(magic), "version": version},
+                    {"magic": repr(magic), "version": version,
+                     "local_version": _VERSION},
                 )
+                if magic == _MAGIC:
+                    # named rejection: the peer's connect parses our
+                    # version out of the reply and raises the typed
+                    # VersionMismatchError instead of diagnosing a hangup
+                    try:
+                        conn.sendall(_HELLO_REPLY.pack(_MAGIC, _VERSION, 0))
+                    except (ConnectionError, OSError):
+                        pass
                 return
             with self._cond:
                 delivered = self._delivered.get(src, 0)
                 self._last_seen[src] = time.monotonic()
             # resync point: the peer replays every frame after this count
-            conn.sendall(_ACK.pack(delivered))
+            conn.sendall(_HELLO_REPLY.pack(_MAGIC, _VERSION, delivered))
             conn.settimeout(None)
             while True:
                 fire("transport.recv_frame")
-                seq, kind, tag_len, n, crc = _FRAME.unpack(
+                seq, kind, codec, tag_len, n, crc = _FRAME.unpack(
                     _recv_exact(conn, _FRAME.size)
                 )
                 body = _recv_exact(conn, tag_len + n)
                 with self._cond:
                     self._last_seen[src] = time.monotonic()
                 if zlib.crc32(body) != crc:
-                    # corrupt frame: drop the connection; the sender's
-                    # resync replays everything un-delivered
+                    # corrupt frame: drop the connection BEFORE any
+                    # inflate; the sender's resync replays everything
+                    # un-delivered
                     STAT_ADD("transport.crc_errors")
                     PROFILER.instant(
                         "transport:crc_error", {"src": src, "seq": seq}
@@ -256,6 +312,34 @@ class TcpTransport:
                     return
                 tag = body[:tag_len].decode()
                 payload = body[tag_len:]
+                if kind == _KIND_DATA:
+                    STAT_ADD(
+                        "wire.host_bytes_recv", _FRAME.size + tag_len + n
+                    )
+                if codec != _CODEC_RAW:
+                    try:
+                        fire("wire.host_decode")
+                        if codec != _CODEC_ZLIB:
+                            raise host_codec.HostCodecError(
+                                f"unknown frame codec {codec}"
+                            )
+                        payload = host_codec.decompress_chunked(payload)
+                    except (host_codec.HostCodecError, OSError) as e:
+                        # decode failure (or injected wire.host_decode
+                        # fault): kill the connection pre-delivery; the
+                        # frame was never counted delivered, so the
+                        # sender's resync replays it exactly once
+                        STAT_ADD("transport.decode_errors")
+                        PROFILER.instant(
+                            "transport:decode_error",
+                            {"src": src, "seq": seq, "error": repr(e)},
+                        )
+                        return
+                if kind == _KIND_DATA:
+                    STAT_ADD(
+                        "wire.host_raw_bytes_recv",
+                        _FRAME.size + tag_len + len(payload),
+                    )
                 if kind == _KIND_HEARTBEAT:
                     if len(payload) == _ACK.size:
                         self._prune_retained(src, _ACK.unpack(payload)[0])
@@ -398,11 +482,33 @@ class TcpTransport:
         try:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             s.sendall(_HELLO.pack(_MAGIC, _VERSION, self.rank))
-            acked = _ACK.unpack(_recv_exact(s, _ACK.size))[0]
+            acked = self._read_hello_reply(s)
         except (ConnectionError, OSError):
             self._close_sock(s)
             raise
         return s, acked
+
+    def _read_hello_reply(self, s: socket.socket) -> int:
+        """Parse the listener's _HELLO_REPLY; typed failure on mismatch."""
+        buf = bytearray()
+        while len(buf) < _HELLO_REPLY.size:
+            chunk = s.recv(_HELLO_REPLY.size - len(buf))
+            if not chunk:
+                if not buf:
+                    # a pre-v3 listener rejects an unknown HELLO version
+                    # by closing without any reply bytes
+                    raise VersionMismatchError(_VERSION, None)
+                raise ConnectionError("peer closed mid-handshake reply")
+            buf.extend(chunk)
+        magic, version, acked = _HELLO_REPLY.unpack(bytes(buf))
+        if magic != _MAGIC:
+            raise ProtocolError(
+                f"handshake reply magic {magic!r} is not {_MAGIC!r} — "
+                "peer is not a PBTX listener"
+            )
+        if version != _VERSION:
+            raise VersionMismatchError(_VERSION, version)
+        return acked
 
     def _reopen(self, dst: int, link: _SendLink) -> None:
         """(Re)connect and replay the un-acked tail. Caller holds the dst
@@ -447,6 +553,12 @@ class TcpTransport:
                 elif frame is not None:
                     link.sock.sendall(frame)
                 return
+            except ProtocolError:
+                # incompatible peer: reconnecting cannot change its
+                # protocol version, so fail loudly instead of burning the
+                # retry budget (the typed error names both versions)
+                STAT_ADD("transport.protocol_errors")
+                raise
             except (ConnectionError, OSError) as e:
                 if link.sock is not None:
                     self._close_sock(link.sock)
@@ -473,6 +585,21 @@ class TcpTransport:
                 STAT_ADD("transport.send_retries")
                 time.sleep(min(backoff * (2 ** attempt), 5.0))
 
+    def _encode_payload(self, payload: bytes) -> Tuple[int, bytes]:
+        """Pick the wire codec for one data payload. Small payloads and
+        payloads the codec fails to shrink ship raw — the codec byte makes
+        every frame self-describing, so mixed traffic is fine."""
+        if (
+            len(payload) >= int(config.get_flag("host_compress_min_bytes"))
+            and config.get_flag("host_wire_codec")
+        ):
+            comp = host_codec.compress_chunked(
+                payload, int(config.get_flag("host_compress_level"))
+            )
+            if len(comp) < len(payload):
+                return _CODEC_ZLIB, comp
+        return _CODEC_RAW, payload
+
     def send(self, dst: int, tag: str, payload: bytes) -> None:
         tb = tag.encode()
         if dst == self.rank:
@@ -487,18 +614,31 @@ class TcpTransport:
             if stale:
                 STAT_ADD("transport.stale_frames_dropped")
             return
+        # encode OUTSIDE the per-destination send lock, on the caller's
+        # worker thread: one peer's compression overlaps another peer's
+        # socket write instead of serializing behind it
+        codec, wire_payload = self._encode_payload(payload)
+        body = tb + wire_payload
+        crc = zlib.crc32(body)
         with self._send_locks[dst]:
             link = self._links[dst]
             link.next_seq += 1
-            body = tb + payload
             frame = (
                 _FRAME.pack(
-                    link.next_seq, _KIND_DATA, len(tb), len(payload),
-                    zlib.crc32(body),
+                    link.next_seq, _KIND_DATA, codec, len(tb),
+                    len(wire_payload), crc,
                 )
                 + body
             )
             link.retained.append((link.next_seq, frame))
+            # counted per logical send (replays are not re-counted):
+            # actual frame bytes vs what an uncompressed v2 frame of the
+            # same header size would have shipped
+            STAT_ADD("wire.host_bytes_sent", len(frame))
+            STAT_ADD(
+                "wire.host_raw_bytes_sent",
+                _FRAME.size + len(tb) + len(payload),
+            )
             # the frame is retained BEFORE the first wire attempt, so every
             # failure path (including a fault injected on the very first
             # send) replays it through the reconnect resync
@@ -526,7 +666,10 @@ class TcpTransport:
             delivered = self._delivered.get(dst, 0)
         payload = _ACK.pack(delivered)
         frame = (
-            _FRAME.pack(0, _KIND_HEARTBEAT, 0, len(payload), zlib.crc32(payload))
+            _FRAME.pack(
+                0, _KIND_HEARTBEAT, _CODEC_RAW, 0, len(payload),
+                zlib.crc32(payload),
+            )
             + payload
         )
         with self._send_locks[dst]:

@@ -19,6 +19,14 @@ Capacity is allreduce-max'd so every host compiles the same shapes
 (lockstep parity, compute_thread_batch_nccl data_set.cc:2069-2135), and
 writeback is purely local: a host's trained device slice lands in its own
 host table — no cross-host traffic at pass end.
+
+Both rounds encode through ``ops/host_codec.py``: request key streams are
+delta+varint under the ``host_wire_codec`` flag (sorted unique uint64 →
+~1-2 bytes/key; marker byte keeps raw/codec ranks interoperable), and row
+replies always ride the narrow-int codec (width picked from the
+``n_mesh_shards * capacity`` bound, overflow is a loud codec error).
+``wire.ws_req_*`` / ``wire.ws_rep_*`` counters record raw-vs-encoded bytes
+per round — the per-round ratios chaos_probe's distributed soak reports.
 """
 
 from __future__ import annotations
@@ -29,11 +37,13 @@ from typing import List, Optional
 import numpy as np
 
 from paddlebox_tpu import config
+from paddlebox_tpu.ops import host_codec
 from paddlebox_tpu.table.sparse_table import (
     HostSparseTable,
     key_to_shard,
     merge_unique_keys,
 )
+from paddlebox_tpu.utils.monitor import STAT_ADD
 
 
 class DistributedWorkingSet:
@@ -118,13 +128,22 @@ class DistributedWorkingSet:
             self._key_chunks = []
         self.n_keys = len(referenced)
 
-        # round 1: route referenced keys to their owner hosts
+        # round 1: route referenced keys to their owner hosts. The keys per
+        # destination are a masked slice of np.unique output — sorted — so
+        # the delta+varint codec applies; the payload's marker byte keeps
+        # the format self-describing (a codec-on rank and a raw-ablation
+        # rank decode each other's frames identically)
+        use_codec = bool(config.get_flag("host_wire_codec"))
         owners = self._owner_host(referenced)
         req_out = []
         for h in range(t.n_ranks):
-            req_out.append(referenced[owners == h].tobytes())
+            req_out.append(
+                host_codec.encode_key_stream(referenced[owners == h], use_codec)
+            )
+        STAT_ADD("wire.ws_req_raw_bytes", int(len(referenced)) * 8)
+        STAT_ADD("wire.ws_req_bytes", sum(len(b) for b in req_out))
         req_in = t.alltoall(req_out, f"ws-req:{self.pass_id}@e{self.epoch}")
-        req_keys = [np.frombuffer(b, dtype=np.uint64) for b in req_in]
+        req_keys = [host_codec.decode_key_stream(b) for b in req_in]
 
         # owner side: union, per-shard rank assignment (ascending key order)
         owned = (
@@ -173,24 +192,38 @@ class DistributedWorkingSet:
             local_rows = shard_of * cap + rank_in_shard
             dev.reshape(self.shards_per_host * cap, -1)[local_rows] = vals
 
-        # round 2: reply global rows for each requester's keys (their order)
+        # round 2: reply global rows for each requester's keys (their
+        # order). Rows are shard*cap+rank, bounded by n_mesh_shards*cap —
+        # the narrow-int codec downcasts to the width that bound needs
+        # (uint16/uint32 in practice, never int64) and raises on overflow.
+        # Always on, raw ablation included: the width byte self-describes.
+        max_row = self.n_mesh_shards * cap - 1
         rep_out = []
         pos_all = np.searchsorted(owned, np.concatenate(req_keys)) if len(owned) else None
         off = 0
         for h in range(t.n_ranks):
             k = req_keys[h]
             if len(k):
-                rep_out.append(owned_rows[pos_all[off : off + len(k)]].astype(np.int64).tobytes())
+                rep_out.append(
+                    host_codec.encode_row_ids(
+                        owned_rows[pos_all[off : off + len(k)]], max_row
+                    )
+                )
             else:
-                rep_out.append(b"")
+                rep_out.append(host_codec.encode_row_ids(np.zeros(0, np.int64), max_row))
             off += len(k)
+        STAT_ADD(
+            "wire.ws_rep_raw_bytes",
+            8 * sum(len(k) for k in req_keys),
+        )
+        STAT_ADD("wire.ws_rep_bytes", sum(len(b) for b in rep_out))
         rep_in = t.alltoall(rep_out, f"ws-rep:{self.pass_id}@e{self.epoch}")
 
         # assemble local lookup over referenced keys
         rows = np.empty(len(referenced), dtype=np.int64)
         for h in range(t.n_ranks):
             sel = owners == h
-            got = np.frombuffer(rep_in[h], dtype=np.int64)
+            got = host_codec.decode_row_ids(rep_in[h])
             rows[sel] = got
         self.sorted_keys = referenced  # np.unique output: sorted
         self.row_of_sorted = rows

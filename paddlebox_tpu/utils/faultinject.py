@@ -35,6 +35,12 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
     transport.heartbeat     parallel/transport.py  before each peer beat —
                             suppressing beats starves acks and the peer's
                             failure detector
+    wire.host_decode        parallel/transport.py  reader loop, before a
+                            codec-framed (PBTX v3) payload is inflated —
+                            an injected failure is a corrupt-after-CRC
+                            decode: the connection dies pre-delivery and
+                            the sender's resync replays the frame
+                            exactly once
     boundary.premerge       data/dataset.py  boundary feed stage, before the
                             staged working set's key premerge (pipelined
                             boundary only)
@@ -110,6 +116,7 @@ KNOWN_SITES = (
     "transport.send",
     "transport.recv_frame",
     "transport.heartbeat",
+    "wire.host_decode",
     "boundary.premerge",
     "boundary.stage_pull",
     "boundary.writeback",

@@ -36,9 +36,12 @@ from paddlebox_tpu.parallel.transport import (
     TcpShuffleRouter,
     TcpTransport,
     TransportTimeout,
-    _ACK,
+    VersionMismatchError,
+    _CODEC_RAW,
+    _CODEC_ZLIB,
     _FRAME,
     _HELLO,
+    _HELLO_REPLY,
     _KIND_DATA,
     _MAGIC,
     _VERSION,
@@ -268,6 +271,99 @@ def test_faulted_pass_bitwise_equals_clean():
         i.split("-")[1] != str(r)
         for r in range(N_RANKS)
         for i in clean[r]["ins"]
+    )
+
+
+def _assert_pass_equal(clean, other):
+    for r in range(N_RANKS):
+        c, f = clean[r], other[r]
+        assert c["ins"] == f["ins"]
+        assert c["capacity"] == f["capacity"]
+        np.testing.assert_array_equal(c["sorted_keys"], f["sorted_keys"])
+        np.testing.assert_array_equal(c["rows"], f["rows"])
+        np.testing.assert_array_equal(c["preds"], f["preds"])
+        np.testing.assert_array_equal(c["host_keys"], f["host_keys"])
+        np.testing.assert_array_equal(c["host_vals"], f["host_vals"])
+
+
+def test_corrupt_frame_day_bitwise_equals_clean():
+    """Seeded corrupt-frame day (satellite of the host-wire codec): decode
+    faults at wire.host_decode — a codec frame that passes CRC but fails
+    inflate — kill connections mid-pass; the resync must replay each
+    killed frame exactly once, leaving every per-rank observable bitwise
+    equal to the clean run."""
+    tps = _cluster()
+    try:
+        clean = _distributed_pass(tps, epoch=0)
+    finally:
+        for t in tps:
+            t.close()
+
+    decode_before = STAT_GET("transport.decode_errors")
+    tps = _cluster()
+    try:
+        with inject(
+            fail_nth("wire.host_decode", 2, times=1),
+            fail_nth("wire.host_decode", 5, times=1),
+            fail_prob("transport.send", 0.1, seed=29, times=3),
+        ) as plan:
+            faulted = _distributed_pass(tps, epoch=0)
+        assert plan.failures("wire.host_decode") > 0, (
+            "no codec frame was ever decoded — the day shipped nothing "
+            "compressed and the test proved nothing"
+        )
+    finally:
+        for t in tps:
+            t.close()
+
+    # each injected decode fault surfaced as a killed connection...
+    assert (
+        STAT_GET("transport.decode_errors")
+        >= decode_before + plan.failures("wire.host_decode")
+    )
+    # ...and healed into a bitwise-identical pass (exactly-once delivery:
+    # a double-delivered shuffle chunk would change n_records/preds, a
+    # dropped one would change the working set)
+    _assert_pass_equal(clean, faulted)
+    assert _auc(clean) == _auc(faulted)
+
+
+def test_codec_ablation_bitwise_equal_and_fewer_bytes():
+    """THE host-wire gate at test scale: host_wire_codec on vs off (raw
+    ablation) produces bitwise-identical passes, while the wire.host_*
+    counters show the codec run shipping at least 2x fewer frame bytes
+    and the key-exchange round at least 2x fewer request bytes."""
+    def one_run():
+        tps = _cluster()
+        try:
+            sent0 = STAT_GET("wire.host_bytes_sent")
+            req0 = STAT_GET("wire.ws_req_bytes")
+            res = _distributed_pass(tps, epoch=0)
+            return res, (
+                STAT_GET("wire.host_bytes_sent") - sent0,
+                STAT_GET("wire.ws_req_bytes") - req0,
+            )
+        finally:
+            for t in tps:
+                t.close()
+
+    assert config.get_flag("host_wire_codec")  # default on
+    codec_res, (codec_sent, codec_req) = one_run()
+    config.set_flag("host_wire_codec", False)
+    try:
+        raw_res, (raw_sent, raw_req) = one_run()
+    finally:
+        config.set_flag("host_wire_codec", True)
+
+    _assert_pass_equal(codec_res, raw_res)
+    assert _auc(codec_res) == _auc(raw_res)
+    assert codec_sent > 0 and raw_sent > 0
+    assert raw_sent >= 2 * codec_sent, (
+        f"raw ablation shipped {raw_sent} frame bytes vs {codec_sent} "
+        "with the codec — the >=2x gate failed"
+    )
+    assert raw_req >= 2 * codec_req, (
+        f"key-exchange round: raw {raw_req} vs codec {codec_req} bytes"
     )
 
 
@@ -711,7 +807,31 @@ def _assert_closed(s):
     s.close()
 
 
+def _recv_exact_sock(s, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        assert chunk, "peer closed before the expected reply"
+        buf += chunk
+    return buf
+
+
+def _handshake(s, expect_delivered=None):
+    """Read the listener's _HELLO_REPLY off a raw test socket."""
+    magic, version, delivered = _HELLO_REPLY.unpack(
+        _recv_exact_sock(s, _HELLO_REPLY.size)
+    )
+    assert magic == _MAGIC and version == _VERSION
+    if expect_delivered is not None:
+        assert delivered == expect_delivered
+    return delivered
+
+
 def test_version_mismatch_rejected():
+    """v2-style sender vs v3 listener (the 'reverse' handshake direction):
+    the listener answers with a typed reply NAMING ITS VERSION before
+    closing — the raw peer can see exactly which versions disagree instead
+    of diagnosing a silent hangup."""
     tps = _cluster(2)
     try:
         before = STAT_GET("transport.protocol_errors")
@@ -720,11 +840,89 @@ def test_version_mismatch_rejected():
         while STAT_GET("transport.protocol_errors") == before:
             assert time.monotonic() < deadline
             time.sleep(0.01)
-        # the receiver hung up without ACKing
+        # the reject reply carries the listener's version (delivered=0)...
+        s.settimeout(5.0)
+        magic, version, delivered = _HELLO_REPLY.unpack(
+            _recv_exact_sock(s, _HELLO_REPLY.size)
+        )
+        assert magic == _MAGIC
+        assert version == _VERSION  # names the incompatible listener version
+        assert delivered == 0
+        # ...and then the connection closes, no frame loop entered
         _assert_closed(s)
     finally:
         for t in tps:
             t.close()
+
+
+def test_v3_sender_vs_v2_listener_typed_error():
+    """v3 sender vs a pre-v3 listener, which rejects unknown HELLO
+    versions by closing without any reply: the send must fail with the
+    typed VersionMismatchError naming both versions — not a hang, not a
+    generic ConnectionError after burning the retry budget."""
+    ports = _free_ports(2)
+
+    def v2_listener(srv):
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            c.recv(_HELLO.size)  # reads the v3 HELLO, rejects silently
+            c.close()
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", ports[1]))
+    srv.listen(4)
+    threading.Thread(target=v2_listener, args=(srv,), daemon=True).start()
+    t0 = TcpTransport(0, [f"127.0.0.1:{p}" for p in ports], timeout=5.0)
+    try:
+        before = STAT_GET("transport.send_retries")
+        with pytest.raises(VersionMismatchError) as ei:
+            t0.send(1, "x", b"hello")
+        assert ei.value.local_version == _VERSION
+        assert ei.value.peer_version is None  # no reply = pre-v3 signature
+        assert f"local v{_VERSION}" in str(ei.value)
+        assert "v2" in str(ei.value)
+        # fail-fast: protocol errors never burn the reconnect retry budget
+        assert STAT_GET("transport.send_retries") == before
+    finally:
+        t0.close()
+        srv.close()
+
+
+def test_v3_sender_vs_versioned_peer_typed_error():
+    """A peer that DOES speak the reply protocol but at another version:
+    the typed error names both sides' numbers."""
+    ports = _free_ports(2)
+
+    def listener(srv):
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            c.recv(_HELLO.size)
+            c.sendall(_HELLO_REPLY.pack(_MAGIC, _VERSION - 1, 0))
+            c.close()
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", ports[1]))
+    srv.listen(4)
+    threading.Thread(target=listener, args=(srv,), daemon=True).start()
+    t0 = TcpTransport(0, [f"127.0.0.1:{p}" for p in ports], timeout=5.0)
+    try:
+        with pytest.raises(VersionMismatchError) as ei:
+            t0.send(1, "x", b"hello")
+        assert ei.value.local_version == _VERSION
+        assert ei.value.peer_version == _VERSION - 1
+        msg = str(ei.value)
+        assert f"local v{_VERSION}" in msg and f"peer v{_VERSION - 1}" in msg
+    finally:
+        t0.close()
+        srv.close()
 
 
 def test_crc_corruption_drops_frame_and_connection():
@@ -732,12 +930,12 @@ def test_crc_corruption_drops_frame_and_connection():
     try:
         s = _raw_connect(tps[0].port, _HELLO.pack(_MAGIC, _VERSION, 1))
         s.settimeout(5.0)
-        assert _ACK.unpack(s.recv(_ACK.size))[0] == 0
+        _handshake(s, expect_delivered=0)
         tag, payload = b"evil", b"corrupted-payload"
         crc = zlib.crc32(tag + payload) ^ 0xDEADBEEF
         before = STAT_GET("transport.crc_errors")
         s.sendall(
-            _FRAME.pack(1, _KIND_DATA, len(tag), len(payload), crc)
+            _FRAME.pack(1, _KIND_DATA, _CODEC_RAW, len(tag), len(payload), crc)
             + tag
             + payload
         )
@@ -746,6 +944,36 @@ def test_crc_corruption_drops_frame_and_connection():
             assert time.monotonic() < deadline
             time.sleep(0.01)
         # connection was dropped, and the corrupt frame never delivered
+        _assert_closed(s)
+        with pytest.raises(TransportTimeout):
+            tps[0].recv("evil", 1, timeout=0.3)
+    finally:
+        for t in tps:
+            t.close()
+
+
+def test_bitflipped_codec_frame_kills_connection_before_delivery():
+    """A codec-framed payload whose CRC is VALID but whose compressed body
+    doesn't inflate (bit-flip after checksumming, or a lying sender): the
+    decode error kills the connection pre-delivery — the frame never
+    reaches the inbox, and a real sender's resync would replay it."""
+    tps = _cluster(2)
+    try:
+        s = _raw_connect(tps[0].port, _HELLO.pack(_MAGIC, _VERSION, 1))
+        s.settimeout(5.0)
+        _handshake(s, expect_delivered=0)
+        tag, payload = b"evil", b"this-is-not-a-zlib-frame"
+        crc = zlib.crc32(tag + payload)  # CRC itself is fine
+        before = STAT_GET("transport.decode_errors")
+        s.sendall(
+            _FRAME.pack(1, _KIND_DATA, _CODEC_ZLIB, len(tag), len(payload), crc)
+            + tag
+            + payload
+        )
+        deadline = time.monotonic() + 5.0
+        while STAT_GET("transport.decode_errors") == before:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
         _assert_closed(s)
         with pytest.raises(TransportTimeout):
             tps[0].recv("evil", 1, timeout=0.3)

@@ -1,0 +1,216 @@
+"""Host-wire codec unit coverage (ops/host_codec.py).
+
+Round-trip exactness and malformed-input rejection for all three codecs —
+delta+varint sorted-u64 key streams, narrow-int row ids, chunked zlib
+frames — plus the self-describing key-stream wrapper the working-set
+exchange ships. Edge cases named by the issue: empty stream, single key,
+max-gap uint64 deltas, non-monotonic rejection, truncated/bit-flipped
+compressed frames.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.ops import host_codec as hc
+from paddlebox_tpu.ops.host_codec import HostCodecError
+
+
+# ---------------------------------------------------------------------------
+# sorted-u64 delta+varint
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [0, 1, 2, 7, 1000, 50_000])
+def test_sorted_u64_roundtrip_exact(n):
+    rng = np.random.default_rng(n)
+    keys = np.unique(rng.integers(0, 2**63, n).astype(np.uint64))
+    out = hc.decode_sorted_u64(hc.encode_sorted_u64(keys))
+    assert out.dtype == np.uint64
+    np.testing.assert_array_equal(out, keys)
+
+
+def test_single_key_and_empty_stream():
+    assert len(hc.decode_sorted_u64(hc.encode_sorted_u64(np.zeros(0, np.uint64)))) == 0
+    one = np.array([2**64 - 1], np.uint64)
+    np.testing.assert_array_equal(
+        hc.decode_sorted_u64(hc.encode_sorted_u64(one)), one
+    )
+
+
+def test_max_gap_uint64_deltas():
+    """The widest representable gaps: 0 -> 2^64-1 is a 10-byte varint."""
+    keys = np.array([0, 1, 2**63, 2**64 - 1], np.uint64)
+    enc = hc.encode_sorted_u64(keys)
+    np.testing.assert_array_equal(hc.decode_sorted_u64(enc), keys)
+
+
+def test_duplicate_keys_roundtrip():
+    """Non-decreasing (not strictly increasing) streams are legal."""
+    keys = np.array([5, 5, 5, 9, 9], np.uint64)
+    np.testing.assert_array_equal(
+        hc.decode_sorted_u64(hc.encode_sorted_u64(keys)), keys
+    )
+
+
+def test_dense_keyspace_compresses_hard():
+    """The CTR shape the codec exists for: dense sign spaces land near
+    1 byte/key, an ~8x cut vs raw uint64."""
+    rng = np.random.default_rng(0)
+    keys = np.unique(rng.integers(0, 10**6, 100_000).astype(np.uint64))
+    enc = hc.encode_sorted_u64(keys)
+    assert keys.nbytes / len(enc) > 4.0
+
+
+def test_non_monotonic_input_rejected():
+    with pytest.raises(HostCodecError):
+        hc.encode_sorted_u64(np.array([7, 3], np.uint64))
+
+
+def test_truncated_stream_rejected():
+    keys = np.unique(np.random.default_rng(1).integers(0, 10**9, 500).astype(np.uint64))
+    enc = hc.encode_sorted_u64(keys)
+    for cut in (len(enc) - 1, len(enc) // 2, hc._U64_HDR.size - 1, 0):
+        with pytest.raises(HostCodecError):
+            hc.decode_sorted_u64(enc[:cut])
+
+
+def test_count_lie_rejected():
+    """A header claiming more values than the varint stream terminates."""
+    keys = np.arange(10, dtype=np.uint64)
+    enc = bytearray(hc.encode_sorted_u64(keys))
+    enc[:8] = hc._U64_HDR.pack(11)
+    with pytest.raises(HostCodecError):
+        hc.decode_sorted_u64(bytes(enc))
+
+
+def test_overlong_varint_rejected():
+    """11 continuation bytes can never be a uint64."""
+    bad = hc._U64_HDR.pack(1) + b"\x80" * 11 + b"\x00"
+    with pytest.raises(HostCodecError):
+        hc.decode_sorted_u64(bad)
+
+
+def test_uint64_overflow_rejected():
+    """A 10th varint byte above 1 overflows 64 bits — and a delta stream
+    whose cumsum wraps is corrupt, not a key set."""
+    bad = hc._U64_HDR.pack(1) + b"\xff" * 9 + b"\x7f"
+    with pytest.raises(HostCodecError):
+        hc.decode_sorted_u64(bad)
+    # two max-value deltas wrap the cumsum
+    wrap = (
+        hc._U64_HDR.pack(2)
+        + hc._varint_encode(np.array([2**64 - 1, 2**64 - 1], np.uint64)).tobytes()
+    )
+    with pytest.raises(HostCodecError):
+        hc.decode_sorted_u64(wrap)
+
+
+# ---------------------------------------------------------------------------
+# key-stream wrapper (marker byte: raw ablation interoperates with codec)
+# ---------------------------------------------------------------------------
+
+def test_key_stream_wrapper_both_markers():
+    keys = np.unique(np.random.default_rng(2).integers(0, 10**7, 3000).astype(np.uint64))
+    for codec in (True, False):
+        enc = hc.encode_key_stream(keys, codec)
+        np.testing.assert_array_equal(hc.decode_key_stream(enc), keys)
+    assert len(hc.encode_key_stream(keys, True)) < len(
+        hc.encode_key_stream(keys, False)
+    )
+
+
+def test_key_stream_wrapper_rejects_garbage():
+    with pytest.raises(HostCodecError):
+        hc.decode_key_stream(b"")
+    with pytest.raises(HostCodecError):
+        hc.decode_key_stream(bytes([99]) + b"whatever")
+    # raw marker with a non-multiple-of-8 body
+    with pytest.raises(HostCodecError):
+        hc.decode_key_stream(bytes([hc.KEYS_RAW]) + b"12345")
+
+
+# ---------------------------------------------------------------------------
+# narrow-int row ids
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "bound,width",
+    [(200, 1), (65_535, 2), (65_536, 4), (2**32 - 1, 4), (2**32, 8)],
+)
+def test_row_ids_narrowest_width(bound, width):
+    rng = np.random.default_rng(bound % 97)
+    rows = rng.integers(0, bound + 1, 257).astype(np.int64)
+    enc = hc.encode_row_ids(rows, bound)
+    assert len(enc) == hc._ROW_HDR.size + width * len(rows)
+    out = hc.decode_row_ids(enc)
+    assert out.dtype == np.int64
+    np.testing.assert_array_equal(out, rows)
+
+
+def test_row_ids_empty_roundtrip():
+    enc = hc.encode_row_ids(np.zeros(0, np.int64), 1000)
+    assert len(hc.decode_row_ids(enc)) == 0
+
+
+def test_row_ids_overflow_asserts():
+    with pytest.raises(HostCodecError):
+        hc.encode_row_ids(np.array([70_000], np.int64), 65_535)
+    with pytest.raises(HostCodecError):
+        hc.encode_row_ids(np.array([-1], np.int64), 65_535)
+
+
+def test_row_ids_malformed_rejected():
+    enc = hc.encode_row_ids(np.arange(10, dtype=np.int64), 1000)
+    with pytest.raises(HostCodecError):
+        hc.decode_row_ids(enc[:-1])  # truncated body
+    with pytest.raises(HostCodecError):
+        hc.decode_row_ids(enc[: hc._ROW_HDR.size - 1])  # truncated header
+    bad = bytearray(enc)
+    bad[0] = 3  # width not in {1,2,4,8}
+    with pytest.raises(HostCodecError):
+        hc.decode_row_ids(bytes(bad))
+
+
+# ---------------------------------------------------------------------------
+# chunked zlib frames
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("size", [0, 1, 511, 4096, 3_000_000])
+def test_chunked_zlib_roundtrip(size):
+    rng = np.random.default_rng(size % 101)
+    blob = bytes(rng.integers(0, 8, size, dtype=np.uint8))
+    enc = hc.compress_chunked(blob, level=1)
+    assert hc.decompress_chunked(enc) == blob
+
+
+def test_chunked_zlib_multi_chunk_bounded():
+    """chunk_bytes bounds each inflate; a 10-chunk frame round-trips."""
+    blob = b"paddlebox" * 5000
+    enc = hc.compress_chunked(blob, level=1, chunk_bytes=len(blob) // 10 + 1)
+    assert hc.decompress_chunked(enc) == blob
+
+
+def test_chunked_zlib_truncation_rejected():
+    enc = hc.compress_chunked(b"hello world" * 500, level=1)
+    for cut in (len(enc) - 2, hc._ZFRAME_HDR.size + 1, 3):
+        with pytest.raises(HostCodecError):
+            hc.decompress_chunked(enc[:cut])
+
+
+def test_chunked_zlib_bitflip_rejected():
+    enc = bytearray(hc.compress_chunked(b"hello world" * 500, level=1))
+    enc[hc._ZFRAME_HDR.size + 6] ^= 0xFF  # inside the deflate stream
+    with pytest.raises(HostCodecError):
+        hc.decompress_chunked(bytes(enc))
+
+
+def test_chunked_zlib_length_lie_rejected():
+    """A header that lies about the raw length is caught, not trusted."""
+    blob = b"x" * 1000
+    enc = bytearray(hc.compress_chunked(blob, level=1))
+    enc[: hc._ZFRAME_HDR.size] = hc._ZFRAME_HDR.pack(
+        999, hc.DEFAULT_CHUNK_BYTES, 1
+    )
+    with pytest.raises(HostCodecError):
+        hc.decompress_chunked(bytes(enc))

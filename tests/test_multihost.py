@@ -761,9 +761,10 @@ def test_duplicate_replayed_frames_dropped_by_seq():
 
     from paddlebox_tpu.parallel.transport import (
         TcpTransport,
-        _ACK,
+        _CODEC_RAW,
         _FRAME,
         _HELLO,
+        _HELLO_REPLY,
         _KIND_DATA,
         _MAGIC,
         _VERSION,
@@ -776,8 +777,8 @@ def test_duplicate_replayed_frames_dropped_by_seq():
     def frame(seq, tag, payload):
         body = tag.encode() + payload
         return (
-            _FRAME.pack(seq, _KIND_DATA, len(tag.encode()), len(payload),
-                        _zlib.crc32(body))
+            _FRAME.pack(seq, _KIND_DATA, _CODEC_RAW, len(tag.encode()),
+                        len(payload), _zlib.crc32(body))
             + body
         )
 
@@ -785,9 +786,11 @@ def test_duplicate_replayed_frames_dropped_by_seq():
         s = _socket.create_connection(("127.0.0.1", t0.port), timeout=5.0)
         s.sendall(_HELLO.pack(_MAGIC, _VERSION, 1))
         buf = b""
-        while len(buf) < _ACK.size:
-            buf += s.recv(_ACK.size - len(buf))
-        return s, _ACK.unpack(buf)[0]
+        while len(buf) < _HELLO_REPLY.size:
+            buf += s.recv(_HELLO_REPLY.size - len(buf))
+        magic, version, delivered = _HELLO_REPLY.unpack(buf)
+        assert magic == _MAGIC and version == _VERSION
+        return s, delivered
 
     try:
         s, acked = connect()

@@ -93,6 +93,104 @@ def test_select_and_concat():
         assert cat.record(i).ins_id == recs[i].ins_id
 
 
+# ---------------------------------------------------------------------------
+# wire format v2 (compact raw column blocks, shuffle router payloads)
+# ---------------------------------------------------------------------------
+
+_WIRE_COLS = (
+    "u64_values", "u64_offsets", "u64_base", "f_values", "f_offsets",
+    "f_base", "search_ids", "cmatch", "rank",
+)
+
+
+def _assert_stores_equal(a, b):
+    for col in _WIRE_COLS:
+        np.testing.assert_array_equal(getattr(a, col), getattr(b, col))
+    if a.ins_id_off is None:
+        assert b.ins_id_off is None
+    else:
+        np.testing.assert_array_equal(a.ins_id_off, b.ins_id_off)
+    assert bytes(a.ins_id_chars) == bytes(b.ins_id_chars)
+
+
+@pytest.mark.parametrize("with_meta", [False, True])
+def test_wire_v2_roundtrip(with_meta):
+    rng = np.random.default_rng(7)
+    schema = make_schema(with_logkey=with_meta)
+    store = ColumnarRecords.from_records(
+        make_records(rng, 23, with_meta=with_meta), schema
+    )
+    blob = store.to_bytes()
+    assert blob[:4] == ColumnarRecords._WIRE_MAGIC
+    back = ColumnarRecords.from_bytes(blob)
+    _assert_stores_equal(store, back)
+    assert back.record(5).ins_id == store.record(5).ins_id
+    # decoded arrays stay writable (slots_shuffle mutates keys in place)
+    assert back.u64_values.flags.writeable or len(back.u64_values) == 0
+
+
+def test_wire_v2_empty_store():
+    store = ColumnarRecords.empty(NS, 1)
+    back = ColumnarRecords.from_bytes(store.to_bytes())
+    assert len(back) == 0
+    assert back.n_sparse == NS and back.n_float == 1
+
+
+def test_wire_v2_smaller_than_npz():
+    """The point of v2: no zip container, no per-array .npy headers."""
+    import io
+
+    rng = np.random.default_rng(9)
+    store = ColumnarRecords.from_records(
+        make_records(rng, 30, with_meta=True), make_schema(with_logkey=True)
+    )
+    bio = io.BytesIO()
+    np.savez(
+        bio,
+        **{c: getattr(store, c) for c in _WIRE_COLS},
+        ins_id_off=store.ins_id_off,
+        ins_id_chars=np.frombuffer(store.ins_id_chars, np.uint8),
+    )
+    assert len(store.to_bytes()) < len(bio.getvalue())
+
+
+def test_wire_v1_npz_still_decodes():
+    """Back-compat: a legacy np.savez payload (zip magic) still loads."""
+    import io
+
+    rng = np.random.default_rng(11)
+    store = ColumnarRecords.from_records(
+        make_records(rng, 12, with_meta=True), make_schema(with_logkey=True)
+    )
+    bio = io.BytesIO()
+    np.savez(
+        bio,
+        **{c: getattr(store, c) for c in _WIRE_COLS},
+        ins_id_off=store.ins_id_off,
+        ins_id_chars=np.frombuffer(store.ins_id_chars, np.uint8),
+    )
+    back = ColumnarRecords.from_bytes(bio.getvalue())
+    _assert_stores_equal(store, back)
+
+
+def test_wire_v2_malformed_rejected():
+    rng = np.random.default_rng(13)
+    store = ColumnarRecords.from_records(
+        make_records(rng, 8, with_meta=True), make_schema(with_logkey=True)
+    )
+    blob = store.to_bytes()
+    with pytest.raises(ValueError):
+        ColumnarRecords.from_bytes(b"garbage-not-a-payload")
+    with pytest.raises(ValueError):
+        ColumnarRecords.from_bytes(blob[:-3])  # truncated columns
+    with pytest.raises(ValueError):
+        ColumnarRecords.from_bytes(blob + b"xx")  # trailing bytes
+    bad = bytearray(blob)
+    bad[4] = 99  # unsupported version
+    with pytest.raises(ValueError):
+        ColumnarRecords.from_bytes(bytes(bad))
+
+
 def _setup_pass(rng, n, n_mesh=1):
     schema = make_schema()
     recs = make_records(rng, n)

@@ -561,6 +561,46 @@ def _dist_soak_once(n_ranks, passes, rows, seed, rules):
     return results, plan, time.perf_counter() - t0
 
 
+_WIRE_COUNTERS = (
+    "wire.host_bytes_sent",
+    "wire.host_raw_bytes_sent",
+    "wire.host_bytes_recv",
+    "wire.host_raw_bytes_recv",
+    "wire.ws_req_bytes",
+    "wire.ws_req_raw_bytes",
+    "wire.ws_rep_bytes",
+    "wire.ws_rep_raw_bytes",
+)
+
+
+def _wire_snapshot():
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    return {k: int(STAT_GET(k)) for k in _WIRE_COUNTERS}
+
+
+def _wire_delta(before, after):
+    return {k: after[k] - before[k] for k in _WIRE_COUNTERS}
+
+
+def _ratio(num, den):
+    return round(num / den, 2) if den else None
+
+
+def _digests_equal(a, b, n):
+    equal = True
+    for r in range(n):
+        c, f = a[r], b[r]
+        equal &= np.array_equal(c["host_keys"], f["host_keys"])
+        equal &= np.array_equal(c["host_vals"], f["host_vals"])
+        for dc, df in zip(c["digest"], f["digest"]):
+            equal &= dc["n_records"] == df["n_records"]
+            equal &= dc["capacity"] == df["capacity"]
+            equal &= np.array_equal(dc["rows"], df["rows"])
+            equal &= np.array_equal(dc["sorted_keys"], df["sorted_keys"])
+    return bool(equal)
+
+
 def run_distributed(args):
     from paddlebox_tpu import config
     from paddlebox_tpu.utils.faultinject import fail_nth, fail_prob
@@ -572,34 +612,51 @@ def run_distributed(args):
     # impossible by construction, every injected schedule must heal
     config.set_flag("transport_send_retries", 6)
     n = args.distributed
+
+    # soak 1: clean, codec on (the default wire)
+    config.set_flag("host_wire_codec", True)
+    w0 = _wire_snapshot()
     clean, _, wall_c = _dist_soak_once(n, args.passes, args.rows, args.seed, ())
+    codec_wire = _wire_delta(w0, _wire_snapshot())
+
+    # soak 2: faulted, codec on — send/recv flakes plus decode faults at
+    # the new wire.host_decode site (a corrupt-after-CRC inflate kills the
+    # connection; resync must replay exactly-once)
     rules = [
         fail_prob("transport.send", args.send_flake_prob,
                   seed=args.seed + 1, times=6),
         fail_nth("transport.recv_frame", 7 + args.seed % 5, times=1),
         fail_nth("transport.recv_frame", 23 + args.seed % 7, times=1),
+        fail_nth("wire.host_decode", 2 + args.seed % 3, times=1),
+        fail_nth("wire.host_decode", 9 + args.seed % 5, times=1),
     ]
     faulted, plan, wall_i = _dist_soak_once(
         n, args.passes, args.rows, args.seed, rules
     )
 
-    equal = True
-    for r in range(n):
-        c, f = clean[r], faulted[r]
-        equal &= np.array_equal(c["host_keys"], f["host_keys"])
-        equal &= np.array_equal(c["host_vals"], f["host_vals"])
-        for dc, df in zip(c["digest"], f["digest"]):
-            equal &= dc["n_records"] == df["n_records"]
-            equal &= dc["capacity"] == df["capacity"]
-            equal &= np.array_equal(dc["rows"], df["rows"])
-            equal &= np.array_equal(dc["sorted_keys"], df["sorted_keys"])
+    # soak 3: clean, raw ablation — same results, more bytes; the
+    # cross-soak host_bytes_sent ratio is the measured compression win
+    config.set_flag("host_wire_codec", False)
+    w0 = _wire_snapshot()
+    try:
+        raw, _, wall_r = _dist_soak_once(
+            n, args.passes, args.rows, args.seed, ()
+        )
+    finally:
+        config.set_flag("host_wire_codec", True)
+    raw_wire = _wire_delta(w0, _wire_snapshot())
+
+    equal = _digests_equal(clean, faulted, n)
+    equal_raw = _digests_equal(clean, raw, n)
     report = {
         "mode": "distributed",
         "ranks": n,
         "passes": args.passes,
         "faults_injected": {
             site: plan.failures(site)
-            for site in ("transport.send", "transport.recv_frame")
+            for site in (
+                "transport.send", "transport.recv_frame", "wire.host_decode",
+            )
         },
         "transport_stats": {
             k: STAT_GET(k)
@@ -608,14 +665,43 @@ def run_distributed(args):
                 "transport.frames_resent",
                 "transport.reconnects",
                 "transport.dup_frames_dropped",
+                "transport.decode_errors",
             )
         },
-        "bitwise_equal_to_clean": bool(equal),
+        "host_wire": {
+            "codec": codec_wire,
+            "raw": raw_wire,
+            # ≥2x is the ROADMAP item 2 gate: actual frame bytes, codec
+            # soak vs raw-ablation soak of the identical schedule
+            "host_bytes_ratio_raw_over_codec": _ratio(
+                raw_wire["wire.host_bytes_sent"],
+                codec_wire["wire.host_bytes_sent"],
+            ),
+            # per-exchange-round ratios inside the codec soak: raw-
+            # equivalent bytes over encoded bytes
+            "ws_req_ratio": _ratio(
+                codec_wire["wire.ws_req_raw_bytes"],
+                codec_wire["wire.ws_req_bytes"],
+            ),
+            "ws_rep_ratio": _ratio(
+                codec_wire["wire.ws_rep_raw_bytes"],
+                codec_wire["wire.ws_rep_bytes"],
+            ),
+            # frame-level ratio inside the codec soak (what v2 framing
+            # would have shipped over what v3 shipped)
+            "frame_ratio": _ratio(
+                codec_wire["wire.host_raw_bytes_sent"],
+                codec_wire["wire.host_bytes_sent"],
+            ),
+        },
+        "bitwise_equal_to_clean": equal,
+        "bitwise_equal_raw_vs_codec": equal_raw,
         "wall_clean_s": round(wall_c, 2),
         "wall_injected_s": round(wall_i, 2),
+        "wall_raw_s": round(wall_r, 2),
     }
     print(json.dumps(report, indent=None if args.json else 2))
-    return 0 if equal else 1
+    return 0 if equal and equal_raw else 1
 
 
 def main(argv=None):
