@@ -5,7 +5,9 @@ CLI (tools/run_lint.py) and CI can run it on any box. Rule catalog lives
 in docs/STATIC_ANALYSIS.md.
 """
 
+from .callgraph import MAIN, CallGraph, FuncNode, get_callgraph
 from .core import (
+    DEFAULT_PROFILES,
     ERROR,
     WARNING,
     Finding,
@@ -13,16 +15,20 @@ from .core import (
     ModuleCtx,
     Rule,
     apply_baseline,
+    apply_profiles,
     iter_py_files,
     lint_paths,
     load_baseline,
     save_baseline,
 )
+from .rules_exceptions import ExceptionFlowRule
+from .rules_faultflow import FaultSiteCoverageRule
 from .rules_io import DurableWriteRule
 from .rules_jit import JitPurityRule
 from .rules_locks import LockDisciplineRule
 from .rules_registry import RegistryConsistencyRule
 from .rules_stats import StatNameRule
+from .rules_threads import RaceDetectorRule
 
 ALL_RULES = [
     JitPurityRule,
@@ -30,6 +36,9 @@ ALL_RULES = [
     RegistryConsistencyRule,
     DurableWriteRule,
     StatNameRule,
+    RaceDetectorRule,
+    ExceptionFlowRule,
+    FaultSiteCoverageRule,
 ]
 
 
@@ -40,21 +49,30 @@ def default_rules():
 
 __all__ = [
     "ALL_RULES",
+    "DEFAULT_PROFILES",
     "ERROR",
+    "MAIN",
     "WARNING",
+    "CallGraph",
     "Finding",
+    "FuncNode",
     "LintResult",
     "ModuleCtx",
     "Rule",
     "apply_baseline",
+    "apply_profiles",
     "default_rules",
+    "get_callgraph",
     "iter_py_files",
     "lint_paths",
     "load_baseline",
     "save_baseline",
     "DurableWriteRule",
+    "ExceptionFlowRule",
+    "FaultSiteCoverageRule",
     "JitPurityRule",
     "LockDisciplineRule",
+    "RaceDetectorRule",
     "RegistryConsistencyRule",
     "StatNameRule",
 ]

@@ -12,7 +12,8 @@ registries, durable-write rules). Architecture:
   baseline matching is (rule, path, message) — line numbers drift with
   unrelated edits, messages are stable because they name the symbol.
 - ``# pbox-lint: disable=RULE[,RULE2]`` (or ``disable=all``) on the
-  flagged line suppresses findings from that line.
+  flagged line suppresses findings from that line; on a comment-only
+  line it suppresses the line below (room for the justification).
 - A checked-in baseline (tools/lint_baseline.json) grandfathers known
   findings: the gate fails only on NEW errors, so the linter can be
   enforced as a tier-1 test without a flag-day cleanup.
@@ -73,6 +74,10 @@ class ModuleCtx:
     lines: List[str] = field(default_factory=list)
     # line number -> set of rule ids suppressed there ("all" wildcards)
     suppressions: Dict[int, set] = field(default_factory=dict)
+    # False for context-only modules: whole-program rules resolve through
+    # them (call graph, registries) but findings anchored there are
+    # dropped — the mechanism behind `run_lint.py --changed`
+    report: bool = True
 
     @classmethod
     def parse(cls, abspath: str, relpath: str) -> "ModuleCtx":
@@ -84,7 +89,12 @@ class ModuleCtx:
         for i, text in enumerate(lines, start=1):
             m = _SUPPRESS_RE.search(text)
             if m:
-                sup[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                # a directive on a comment-only line governs the NEXT line
+                # (the justified-suppression idiom); inline directives
+                # govern their own line
+                line = i + 1 if text.lstrip().startswith("#") else i
+                sup.setdefault(line, set()).update(rules)
         return cls(
             path=relpath, abspath=abspath, source=source, tree=tree,
             lines=lines, suppressions=sup,
@@ -115,6 +125,8 @@ class Rule:
         severity: Optional[str] = None,
     ) -> Optional[Finding]:
         line = getattr(node_or_line, "lineno", node_or_line)
+        if not ctx.report:
+            return None
         if ctx.suppressed(self.id, line):
             return None
         return Finding(
@@ -195,34 +207,84 @@ def lint_paths(
     paths: Sequence[str],
     rules: Sequence[Rule],
     root: Optional[str] = None,
+    context_paths: Sequence[str] = (),
+    profiles: Optional[Dict[str, Sequence[str]]] = None,
 ) -> LintResult:
     """Lint every .py under ``paths`` with ``rules``. ``root`` anchors the
-    relative paths used in findings (defaults to CWD)."""
+    relative paths used in findings (defaults to CWD).
+
+    ``context_paths`` are parsed and fed to every rule so whole-program
+    passes (call graph, registries, fault-site coverage) resolve over the
+    full set, but findings anchored in them are dropped — the machinery
+    behind ``--changed`` incremental runs.
+
+    ``profiles`` maps a path prefix to rule ids DISABLED under it (e.g.
+    ``{"tests/": ("JIT001", "THR006")}``); see DEFAULT_PROFILES.
+    """
     root = os.path.abspath(root or os.getcwd())
     modules: List[ModuleCtx] = []
     parse_errors: List[Finding] = []
-    for abspath in iter_py_files(paths):
-        abspath = os.path.abspath(abspath)
-        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
-        try:
-            modules.append(ModuleCtx.parse(abspath, rel))
-        except SyntaxError as e:
-            parse_errors.append(
-                Finding(
-                    rule="PARSE",
-                    severity=ERROR,
-                    path=rel,
-                    line=int(e.lineno or 0),
-                    message=f"syntax error: {e.msg}",
+    seen_report: set = set()
+    for report, group in ((True, paths), (False, context_paths)):
+        for abspath in iter_py_files(group):
+            abspath = os.path.abspath(abspath)
+            rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+            if report:
+                seen_report.add(rel)
+            elif rel in seen_report:
+                continue  # report wins when a file is in both sets
+            try:
+                ctx = ModuleCtx.parse(abspath, rel)
+                ctx.report = report
+                modules.append(ctx)
+            except SyntaxError as e:
+                if not report:
+                    continue  # context modules fail soft
+                parse_errors.append(
+                    Finding(
+                        rule="PARSE",
+                        severity=ERROR,
+                        path=rel,
+                        line=int(e.lineno or 0),
+                        message=f"syntax error: {e.msg}",
+                    )
                 )
-            )
     findings: List[Finding] = []
     for rule in rules:
         for ctx in modules:
             findings.extend(f for f in rule.check_module(ctx) if f is not None)
         findings.extend(f for f in rule.finalize(modules) if f is not None)
+    if profiles:
+        findings = apply_profiles(findings, profiles)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return LintResult(findings=findings, parse_errors=parse_errors)
+
+
+# Per-root rule profiles for the default three-root scan: tests spawn
+# threads with intentional shared state (harness fixtures), call jit only
+# through the package, and exercise the flag/fault-site registry machinery
+# with synthetic names (REG003's contract is about package code firing
+# real sites), so those rules would drown signal there; everything
+# IO/stat/exception-shaped stays on everywhere.
+DEFAULT_PROFILES: Dict[str, Sequence[str]] = {
+    "tests/": ("JIT001", "THR006", "REG003"),
+}
+
+
+def apply_profiles(
+    findings: Sequence[Finding], profiles: Dict[str, Sequence[str]]
+) -> List[Finding]:
+    """Drop findings whose rule is disabled for their path's root."""
+    out: List[Finding] = []
+    for f in findings:
+        disabled = False
+        for prefix, rules in profiles.items():
+            if f.path.startswith(prefix) and f.rule in rules:
+                disabled = True
+                break
+        if not disabled:
+            out.append(f)
+    return out
 
 
 # ---- baseline ---------------------------------------------------------------

@@ -1,0 +1,191 @@
+"""EXC007 — exception-flow gate: no silent swallows of broad excepts.
+
+The repo's headline guarantee is bitwise-equal recovery; its failure
+paths raise TYPED errors (``HostCodecError``, ``SpillIOError``,
+``DataPoisonedError``, ``TransportTimeout``, ``PeerDeadError``,
+``VersionMismatchError``, ``DeltaLineageError``, ...).  A broad
+``except Exception:``/``except OSError:`` between the raise and the
+supervisor turns any of them into silence: the pass "succeeds", the soak
+stays green, and the divergence surfaces days later as a parity failure
+nobody can bisect.  Two checks:
+
+- **error — silent swallow**: an ``except`` clause catching ``Exception``,
+  ``BaseException``, ``OSError`` (or bare ``except:``) whose body neither
+  *re-raises* (any ``raise``), *counts* (``STAT_ADD``/``STAT_SET``),
+  *records* (a call whose name looks like logging/incident machinery:
+  ``log*``/``warn*``/``*record*``/``*instant*``/``*alarm*``/``print``),
+  nor *stores the exception for later* — an assignment whose right side
+  uses the bound name (``except X as e: self._exc = e``), a
+  ``fut.set_exception(e)`` handoff, or any call taking the bound name as
+  an argument (``errors.append((r, e))``) all keep the error alive — they
+  are deferred re-raises, not swallows.  Handling by narrowing
+  (``except HostCodecError:``) never fires — the rule only polices the
+  catch-alls.
+- **warning — unhandled typed error**: a package-defined ``*Error`` class
+  that is raised somewhere in the scanned set but never named in ANY
+  ``except`` clause or ``pytest.raises(...)`` assertion (package or
+  tests): every path that can see it is a broad catch-all, so its type
+  carries no information to any handler.
+
+Suppress with ``# pbox-lint: disable=EXC007`` on the ``except`` line only
+where the swallow is the contract (e.g. ``__del__`` close paths) — and
+say why in the comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleCtx, Rule, call_name
+
+_BROAD = {"Exception", "BaseException", "OSError", "EnvironmentError", "IOError"}
+_COUNT_FUNCS = {"STAT_ADD", "STAT_SET"}
+_RECORD_RE = re.compile(
+    r"^(log|warn|print$|debug$|info$|exception$|critical$)|record|incident|"
+    r"instant|alarm|fail$|abort",
+    re.IGNORECASE,
+)
+
+
+def _broad_names(h: ast.ExceptHandler) -> List[str]:
+    """The broad type names this handler catches ([] when it is narrow)."""
+    if h.type is None:
+        return ["<bare except>"]
+    exprs = (
+        list(h.type.elts) if isinstance(h.type, ast.Tuple) else [h.type]
+    )
+    out: List[str] = []
+    for e in exprs:
+        name = e.attr if isinstance(e, ast.Attribute) else (
+            e.id if isinstance(e, ast.Name) else None
+        )
+        if name in _BROAD:
+            out.append(name)
+    return out
+
+
+def _handler_is_accounted(h: ast.ExceptHandler) -> bool:
+    bound = h.name  # "e" in `except X as e:` (None when unbound)
+    for node in ast.walk(h):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name is None:
+                continue
+            if name in _COUNT_FUNCS or name == "set_exception":
+                return True
+            if _RECORD_RE.search(name):
+                return True
+        if bound and isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = getattr(node, "value", None)
+            if value is not None and any(
+                isinstance(n, ast.Name) and n.id == bound
+                for n in ast.walk(value)
+            ):
+                return True  # exception stored for a later re-raise
+        if bound and isinstance(node, ast.Call) and any(
+            isinstance(n, ast.Name) and n.id == bound
+            for a in node.args + [kw.value for kw in node.keywords]
+            for n in ast.walk(a)
+        ):
+            # the exception object is handed onward (errors.append((r, e)),
+            # q.put(e), repr(e) into a collector) — a deferred surface,
+            # not a swallow
+            return True
+    return False
+
+
+class ExceptionFlowRule(Rule):
+    id = "EXC007"
+    doc = "broad except must re-raise, count, or record; typed errors handled"
+
+    def check_module(self, ctx: ModuleCtx) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = _broad_names(node)
+            if not broad:
+                continue
+            if _handler_is_accounted(node):
+                continue
+            f = self.finding(
+                ctx,
+                node,
+                f"broad `except {broad[0]}` silently swallows — re-raise, "
+                "count a STAT_ADD, or record an incident (typed errors "
+                "like TransportTimeout/HostCodecError die invisibly here)",
+            )
+            if f is not None:
+                findings.append(f)
+        return findings
+
+    def finalize(self, modules: Sequence[ModuleCtx]) -> List[Finding]:
+        # typed *Error classes defined inside the package
+        defined: Dict[str, Tuple[ModuleCtx, int]] = {}
+        raised: Set[str] = set()
+        handled: Set[str] = set()
+        have_tests = any(m.path.startswith("tests/") for m in modules)
+        for ctx in modules:
+            in_pkg = ctx.path.startswith("paddlebox_tpu/")
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef):
+                    if in_pkg and node.name.endswith("Error"):
+                        defined.setdefault(node.name, (ctx, node.lineno))
+                elif isinstance(node, ast.Raise) and node.exc is not None:
+                    exc = node.exc
+                    if isinstance(exc, ast.Call):
+                        exc = exc.func
+                    name = exc.attr if isinstance(exc, ast.Attribute) else (
+                        exc.id if isinstance(exc, ast.Name) else None
+                    )
+                    if name:
+                        raised.add(name)
+                elif isinstance(node, ast.ExceptHandler) and node.type is not None:
+                    exprs = (
+                        list(node.type.elts)
+                        if isinstance(node.type, ast.Tuple)
+                        else [node.type]
+                    )
+                    for e in exprs:
+                        name = e.attr if isinstance(e, ast.Attribute) else (
+                            e.id if isinstance(e, ast.Name) else None
+                        )
+                        if name:
+                            handled.add(name)
+                elif isinstance(node, ast.Call) and call_name(node) == "raises":
+                    # pytest.raises(X) asserts on the type by name — that
+                    # IS handling it (the usual place typed errors are
+                    # pinned down)
+                    for e in node.args:
+                        exprs = (
+                            list(e.elts) if isinstance(e, ast.Tuple) else [e]
+                        )
+                        for x in exprs:
+                            name = x.attr if isinstance(x, ast.Attribute) else (
+                                x.id if isinstance(x, ast.Name) else None
+                            )
+                            if name:
+                                handled.add(name)
+        if not have_tests:
+            # without the test tree in the module set, "never handled"
+            # cannot be concluded — most typed errors are asserted on
+            # exactly there
+            return []
+        findings: List[Finding] = []
+        for name, (ctx, line) in sorted(defined.items()):
+            if name in raised and name not in handled:
+                f = self.finding(
+                    ctx, line,
+                    f"typed error {name} is raised but never handled by "
+                    "name anywhere in the scanned set — only broad "
+                    "catch-alls ever see it, so its type is dead "
+                    "information (catch it somewhere or delete the class)",
+                    severity="warning",
+                )
+                if f is not None:
+                    findings.append(f)
+        return findings

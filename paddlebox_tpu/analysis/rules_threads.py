@@ -1,0 +1,361 @@
+"""THR006 — whole-program race detector over UNANNOTATED shared state.
+
+THR002 (rules_locks) checks lock discipline around state the author
+*annotated* with ``# guarded-by:`` — by construction it cannot see the
+races nobody thought about.  THR006 closes that hole with the call graph
+(analysis/callgraph): it flags every mutation of unannotated
+``self.*``/module-global state that happens in a function whose
+``runs_on`` set names **two or more threads** (the main thread counts),
+when **no lock is guaranteed held on any path** to the mutation.
+
+Fires when ALL of:
+
+- the mutated state is an instance attribute initialized in the owning
+  class (``self.x = ...`` in ``__init__``/``__post_init__`` or a
+  class-body assign) or a module-global assigned at top level;
+- the state has NO ``# guarded-by:`` annotation anywhere it is
+  initialized (annotated state is THR002's contract) and NO
+  ``# synchronized-by: <mechanism>`` annotation — the escape hatch for
+  state synchronized WITHOUT a lock (thread-join handoffs like the
+  preload double buffer: writer thread finishes, consumer joins it, the
+  join is the happens-before edge).  ``synchronized-by`` documents the
+  mechanism at the init site and exempts the attribute here while staying
+  invisible to THR002 (which would otherwise demand a ``with`` block that
+  does not exist);
+- the mutation site's function is reachable from >= 2 distinct thread
+  labels (each ``Thread(target=...)``/``executor.submit`` creation site
+  is a label; ``MAIN`` is the synthetic label for code the user's thread
+  drives);
+- no lock is held: the function's ``locks_held_in`` (meet over all call
+  paths) is empty AND the mutation is not inside a lock-like ``with``
+  block in the function body.
+
+Mutations are: assignment / augmented assignment, ``del``, subscript
+stores, and calls of known mutating methods (``append``/``update``/
+``pop``/...).  Exemptions that keep the rule quiet where a race is
+impossible or the object synchronizes itself:
+
+- ``__init__``/``__post_init__``/``__del__`` bodies (happens-before
+  thread spawn / teardown);
+- attributes initialized to synchronization or queue primitives
+  (``Lock``/``Condition``/``Event``/``Queue``/``deque``/...): their
+  methods carry their own synchronization;
+- single-thread functions (``runs_on`` of 0 or 1 labels) — no
+  concurrency, no race.
+
+Known approximations: name-based call resolution can over-link (a false
+``runs_on`` label -> false positive, suppress with justification) and a
+function never called in the scanned set but invoked via getattr from a
+thread is under-linked (false negative).  Reader-side races (unlocked
+read racing a locked write) are out of scope — annotate the state
+``# guarded-by:`` and THR002 takes over both sides.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import MAIN, CallGraph, FuncNode, get_callgraph, _is_lockish, _unparse
+from .core import Finding, ModuleCtx, Rule
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_SYNC_RE = re.compile(r"#\s*synchronized-by:\s*(\S.+)")
+_INIT_METHODS = {"__init__", "__post_init__"}
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__del__"}
+
+# attribute types whose instances synchronize their own mutations
+_SYNC_PRIMITIVES = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "deque",
+}
+
+_MUTATING_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "sort", "reverse",
+}
+
+
+def _init_value_is_sync(value: ast.AST) -> bool:
+    if isinstance(value, ast.Call):
+        name = value.func
+        attr = (
+            name.attr
+            if isinstance(name, ast.Attribute)
+            else (name.id if isinstance(name, ast.Name) else None)
+        )
+        return attr in _SYNC_PRIMITIVES
+    return False
+
+
+class _StateCatalog:
+    """(class, attr) and (module, global) states with annotation flags."""
+
+    def __init__(self) -> None:
+        # (cls, attr) -> (annotated, self_sync)
+        self.attrs: Dict[Tuple[str, str], Tuple[bool, bool]] = {}
+        # (module, name) -> (annotated, self_sync)
+        self.globals: Dict[Tuple[str, str], Tuple[bool, bool]] = {}
+
+    @staticmethod
+    def _merge(old: Optional[Tuple[bool, bool]], new: Tuple[bool, bool]):
+        if old is None:
+            return new
+        return (old[0] or new[0], old[1] or new[1])
+
+    def collect(self, modules: Sequence[ModuleCtx], cg: CallGraph) -> None:
+        for ctx in modules:
+            # module globals at top level
+            for stmt in ctx.tree.body:
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    annotated = _guard_on_line(ctx, stmt.lineno) is not None
+                    value = stmt.value
+                    sync = value is not None and _init_value_is_sync(value)
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            key = (ctx.path, t.id)
+                            self.globals[key] = self._merge(
+                                self.globals.get(key), (annotated, sync)
+                            )
+            # class bodies + __init__/__post_init__ self-assigns
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                        annotated = _guard_on_line(ctx, stmt.lineno) is not None
+                        value = stmt.value
+                        sync = value is not None and _init_value_is_sync(value)
+                        targets = (
+                            stmt.targets
+                            if isinstance(stmt, ast.Assign)
+                            else [stmt.target]
+                        )
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                key = (node.name, t.id)
+                                self.attrs[key] = self._merge(
+                                    self.attrs.get(key), (annotated, sync)
+                                )
+        for fn in cg.funcs:
+            if fn.cls is None or fn.name not in _INIT_METHODS:
+                continue
+            ctx = _ctx_for(modules, fn.module)
+            if ctx is None:
+                continue
+            for stmt in ast.walk(fn.node):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    continue
+                annotated = _guard_on_line(ctx, stmt.lineno) is not None
+                value = getattr(stmt, "value", None)
+                sync = value is not None and _init_value_is_sync(value)
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        key = (fn.cls, t.attr)
+                        self.attrs[key] = self._merge(
+                            self.attrs.get(key), (annotated, sync)
+                        )
+
+
+def _guard_on_line(ctx: ModuleCtx, line: int) -> Optional[str]:
+    """The annotation text when the init line carries ``guarded-by`` (lock
+    discipline, THR002 enforces) or ``synchronized-by`` (documented
+    non-lock mechanism, exempt here)."""
+    if 1 <= line <= len(ctx.lines):
+        text = ctx.lines[line - 1]
+        m = _GUARD_RE.search(text) or _SYNC_RE.search(text)
+        if m:
+            return m.group(1)
+    return None
+
+
+def _ctx_for(modules: Sequence[ModuleCtx], path: str) -> Optional[ModuleCtx]:
+    for ctx in modules:
+        if ctx.path == path:
+            return ctx
+    return None
+
+
+class _Mutation:
+    __slots__ = ("node", "kind", "state_key", "is_global")
+
+    def __init__(self, node: ast.AST, kind: str, state_key, is_global: bool):
+        self.node = node
+        self.kind = kind  # "assign" | "del" | "call"
+        self.state_key = state_key
+        self.is_global = is_global
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _collect_mutations(
+    fn: FuncNode, catalog: _StateCatalog
+) -> List[_Mutation]:
+    """Mutation sites in ``fn``'s own body (nested defs excluded — they
+    are their own FuncNodes)."""
+    out: List[_Mutation] = []
+
+    def target_state(t: ast.AST):
+        """(state_key, is_global) for an assignment/del target (possibly
+        through one subscript level: self.x[k] = v mutates self.x)."""
+        base = t
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        attr = _self_attr(base)
+        if attr is not None and fn.cls is not None:
+            key = (fn.cls, attr)
+            if key in catalog.attrs:
+                return key, False
+        if isinstance(base, ast.Name):
+            key = (fn.module, base.id)
+            if key in catalog.globals:
+                # plain rebinding of a local shadows the global unless
+                # `global` was declared; subscript stores always hit it
+                if isinstance(t, ast.Subscript) or base.id in _global_decls(fn):
+                    return key, True
+        return None, False
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn.node:
+                return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                key, is_glob = target_state(t)
+                if key is not None:
+                    out.append(_Mutation(node, "assign", key, is_glob))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                key, is_glob = target_state(t)
+                if key is not None:
+                    out.append(_Mutation(node, "del", key, is_glob))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATING_METHODS:
+                base = f.value
+                attr = _self_attr(base)
+                if attr is not None and fn.cls is not None:
+                    key = (fn.cls, attr)
+                    if key in catalog.attrs:
+                        out.append(_Mutation(node, "call", key, False))
+                elif isinstance(base, ast.Name):
+                    key = (fn.module, base.id)
+                    if key in catalog.globals:
+                        out.append(_Mutation(node, "call", key, True))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in getattr(fn.node, "body", []):
+        visit(stmt)
+    return out
+
+
+def _global_decls(fn: FuncNode) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _locks_at_site(fn: FuncNode, site: ast.AST) -> bool:
+    """True when ``site`` sits inside a lock-like ``with`` block of
+    ``fn``'s body."""
+    found = [False]
+
+    def visit(node: ast.AST, held: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn.node:
+                return
+        if node is site and held:
+            found[0] = True
+            return
+        if isinstance(node, ast.With):
+            lockish = any(
+                _is_lockish(_unparse(item.context_expr)) for item in node.items
+            )
+            for child in ast.iter_child_nodes(node):
+                visit(child, held or lockish)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in getattr(fn.node, "body", []):
+        visit(stmt, False)
+    return found[0]
+
+
+class RaceDetectorRule(Rule):
+    id = "THR006"
+    doc = "whole-program race detector over unannotated shared state"
+
+    def finalize(self, modules: Sequence[ModuleCtx]) -> List[Finding]:
+        cg = get_callgraph(modules)
+        catalog = _StateCatalog()
+        catalog.collect(modules, cg)
+        findings: List[Finding] = []
+        for fn in cg.funcs:
+            if fn.name in _EXEMPT_METHODS:
+                continue
+            if len(fn.runs_on) < 2:
+                continue
+            ctx = _ctx_for(modules, fn.module)
+            if ctx is None:
+                continue
+            for mut in _collect_mutations(fn, catalog):
+                annotated, self_sync = (
+                    catalog.globals[mut.state_key]
+                    if mut.is_global
+                    else catalog.attrs[mut.state_key]
+                )
+                if annotated or self_sync:
+                    continue
+                if fn.locks_held_in:
+                    continue  # every path in already holds a lock
+                if _locks_at_site(fn, mut.node):
+                    continue
+                state = (
+                    f"module global {mut.state_key[1]}"
+                    if mut.is_global
+                    else f"self.{mut.state_key[1]} "
+                    f"(class {mut.state_key[0]})"
+                )
+                threads = ", ".join(sorted(fn.runs_on))
+                f = self.finding(
+                    ctx,
+                    mut.node,
+                    f"{state} is mutated in {fn.qualname} which runs on "
+                    f">=2 threads [{threads}] with no lock held on the "
+                    "path and no guarded-by annotation — add a lock, "
+                    "annotate `# guarded-by:`, or justify a suppression",
+                )
+                if f is not None:
+                    findings.append(f)
+        return findings

@@ -250,8 +250,8 @@ class BoxPSDataset:
         # where dead-letter files land (None -> data_quarantine_dir flag ->
         # tempdir fallback); the supervisor wires <checkpoint_root>/quarantine
         self.quarantine_dir = quarantine_dir
-        self._dead_letter_seq = 0
-        self._loading_qlog: Optional[QuarantineLog] = None
+        self._dead_letter_seq = 0  # synchronized-by: load-thread exclusivity (one load/preload in flight)
+        self._loading_qlog: Optional[QuarantineLog] = None  # synchronized-by: load-thread exclusivity
 
         self.date: Optional[str] = None
         self.pass_id = 0
@@ -271,21 +271,30 @@ class BoxPSDataset:
         self.device_table: Optional[np.ndarray] = None
         self.stats = PassStats()
         self._preload_thread: Optional[threading.Thread] = None
-        self._preload_exc: Optional[BaseException] = None
+        self._preload_exc: Optional[BaseException] = None  # synchronized-by: preload join handoff (wait_preload_done)
         self._end_pass_fut = None  # pending end_pass_async worker
         self._in_pass = False
-        self._staged = None  # (records, ws, stats) loaded but not begun
+        # staged (store, order, records, ws, stats) loaded but not begun
+        self._staged = None  # synchronized-by: preload join handoff (wait_preload_done)
         # staged boundary prefetch {src, keys, rows, epoch} built by the
         # feed stage alongside _staged; consumed (or dropped) by begin_pass.
         # Same synchronization discipline as _staged: written only by the
         # load path, read after wait_preload_done joins it.
-        self._boundary_prefetch = None
+        self._boundary_prefetch = None  # synchronized-by: preload join handoff (wait_preload_done)
         # stage time hidden behind training (reported via overlap_hidden_s);
         # accumulated on the load/preload thread, settled on the trainer
         # thread at wait_end_pass
         self._stage_lock = threading.Lock()
         self._stage_hidden_s = 0.0  # guarded-by: _stage_lock
-        self._loading_stats = self.stats
+        # serializes the live-pass slot swap (store/_order/_records/ws/
+        # stats/_in_pass) between a finishing preload's publish and the
+        # end_pass worker's failure re-open: main flips _in_pass False
+        # BEFORE the worker runs, so without this lock a preload thread
+        # that reads the flag can publish pass N+1 concurrently with a
+        # failing worker restoring pass N — a torn mix of two passes.
+        # RLock: the publish decision and _publish itself both take it.
+        self._pass_lock = threading.RLock()
+        self._loading_stats = self.stats  # synchronized-by: load-thread exclusivity (one load/preload in flight; wait_preload_done joins)
 
     # ---- record access ---------------------------------------------------
 
@@ -638,11 +647,15 @@ class BoxPSDataset:
             # load_into_memory would refuse over the leftover staged slot
             self.discard_staged()
             raise
-        if not self._in_pass:
-            # no pass training right now: publish immediately so
-            # memory_data_size()/stats match reference post-load semantics
-            # (begin_pass still consumes the staged tuple)
-            self._publish(self._staged)
+        with self._pass_lock:
+            # flag read and publish are one atomic step: an end_pass
+            # worker's failure re-open must not interleave (it restores
+            # pass N's slots and would tear a concurrent N+1 publish)
+            if not self._in_pass:
+                # no pass training right now: publish immediately so
+                # memory_data_size()/stats match reference post-load
+                # semantics (begin_pass still consumes the staged tuple)
+                self._publish(self._staged)
 
     def _stage_boundary_prefetch(self, ws) -> None:
         """Stage 2 of the boundary feed pipeline: premerge the staged
@@ -841,13 +854,14 @@ class BoxPSDataset:
 
     def _publish(self, staged) -> None:
         store, order, records, ws, stats = staged
-        self.store = store
-        self._order = order
-        self._records = records if records is not None else []
-        self.ws = ws
-        self.stats = stats
-        # new data in memory: lockstep batch count must be renegotiated
-        self._load_gen = getattr(self, "_load_gen", 0) + 1
+        with self._pass_lock:
+            self.store = store
+            self._order = order
+            self._records = records if records is not None else []
+            self.ws = ws
+            self.stats = stats
+            # new data in memory: lockstep batch count must be renegotiated
+            self._load_gen = getattr(self, "_load_gen", 0) + 1
 
     def _normalize_and_shuffle(self, parts: list):
         """File-part chunks -> (store, order, records): columnar when every
@@ -1120,7 +1134,10 @@ class BoxPSDataset:
             try:
                 self.wait_end_pass()
             except Exception:
-                pass  # a failed publish is exactly what revert undoes
+                # a failed publish is exactly what revert undoes — but it
+                # is still an incident; revert erasing it would make the
+                # retry loop's root cause invisible
+                STAT_ADD("data.revert_end_pass_errors")
         guard = getattr(self, "_guard", None)
         if guard is None or not guard.armed:
             raise RuntimeError(
@@ -1136,7 +1153,9 @@ class BoxPSDataset:
             try:
                 self.wait_preload_done()
             except Exception:
-                pass  # a failed staged load is discarded with the stage
+                # a failed staged load is discarded with the stage; count
+                # it so a flaky reader doesn't hide behind the revert
+                STAT_ADD("data.revert_preload_errors")
         self.discard_staged()
         # new epoch for the retrain: the aborted attempt's in-flight
         # exchange frames (if any) must never reach the retried exchange
@@ -1352,10 +1371,13 @@ class BoxPSDataset:
                     "secs": time.perf_counter() - t_run,
                 }
             except BaseException:
-                # re-open the pass so the failure is recoverable
-                self.store, self._order, self._records = saved_state
-                self.ws = ws
-                self._in_pass = True
+                # re-open the pass so the failure is recoverable; under
+                # the pass lock so a preload thread publishing the next
+                # pass can't interleave with the restore
+                with self._pass_lock:
+                    self.store, self._order, self._records = saved_state
+                    self.ws = ws
+                    self._in_pass = True
                 raise
 
         from concurrent.futures import Future

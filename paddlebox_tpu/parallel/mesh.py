@@ -106,6 +106,12 @@ def make_mesh_2d(
 
             grid = mesh_utils.create_device_mesh((n_pp, n_dp), devices=devices)
         except Exception:
+            # the raw-enumeration fallback below is correct but loses the
+            # ICI-aware layout — count it so a fleet silently training on
+            # suboptimal pp hops is visible in the stats
+            from paddlebox_tpu.utils.monitor import STAT_ADD
+
+            STAT_ADD("mesh.device_mesh_fallbacks")
             grid = None
     if grid is None:
         grid = np.asarray(devices[:need]).reshape(n_pp, n_dp)

@@ -259,6 +259,11 @@ class TcpTransport:
             try:
                 conn, _ = self._server.accept()
             except OSError:
+                if not self._closed:
+                    # the listening socket died UNDER a live transport —
+                    # peers will see connect timeouts; make the root cause
+                    # visible on this side
+                    STAT_ADD("transport.accept_errors")
                 return
             threading.Thread(
                 target=self._reader, args=(conn,), daemon=True
@@ -284,6 +289,9 @@ class TcpTransport:
                     # VersionMismatchError instead of diagnosing a hangup
                     try:
                         conn.sendall(_HELLO_REPLY.pack(_MAGIC, _VERSION, 0))
+                    # best-effort courtesy reply; the mismatch itself was
+                    # counted above as transport.protocol_errors
+                    # pbox-lint: disable=EXC007
                     except (ConnectionError, OSError):
                         pass
                 return
@@ -361,6 +369,10 @@ class TcpTransport:
                 if stale:
                     STAT_ADD("transport.stale_frames_dropped")
         except (ConnectionError, OSError):
+            # a reader dying is how peer death first shows up on this
+            # side; the heartbeat plane diagnoses it seconds later — count
+            # the disconnect now so the two signals can be correlated
+            STAT_ADD("transport.reader_disconnects")
             return
         finally:
             self._close_sock(conn)

@@ -38,6 +38,8 @@ try:
     import jax
     import jax.numpy as jnp
     import optax
+# optional-dependency gate: serving falls back to numpy apply paths
+# pbox-lint: disable=EXC007
 except Exception:  # pragma: no cover
     jax = jnp = optax = None
 

@@ -199,6 +199,9 @@ class TableCarrier:
         if fut_pos is not None:
             try:
                 fut_pos[0].result()
+            # deferred handling by design (docstring): the failure stays
+            # armed in the future and join_push raises + un-departs it
+            # pbox-lint: disable=EXC007
             except BaseException:
                 pass
 

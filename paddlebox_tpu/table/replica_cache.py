@@ -38,6 +38,8 @@ from paddlebox_tpu.utils.monitor import STAT_SET
 try:  # jax only needed for to_device / device gathers
     import jax
     import jax.numpy as jnp
+# optional-dependency gate: host-only mode keeps the numpy rows
+# pbox-lint: disable=EXC007
 except Exception:  # pragma: no cover
     jax = jnp = None
 

@@ -264,6 +264,10 @@ class HostSparseTable:
                         init_cols, opt.initial_range, spill_dir,
                     )
             except Exception:
+                # silent fallback to the Python store loses native batch
+                # pull/push AND the disk tier — a box training 10x slower
+                # with no signal is the worst failure mode this init has
+                STAT_ADD("table.native_init_failures")
                 self._native = None
         if self._native is None and spill_dir is not None:
             raise RuntimeError(

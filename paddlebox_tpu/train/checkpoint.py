@@ -121,6 +121,10 @@ def verify_snapshot(snap_dir: str, require_manifest: bool = False) -> bool:
             if _file_crc32(p) != want["crc32"]:
                 return False
     except (OSError, ValueError, KeyError):
+        # a torn/unreadable manifest is a FAILED verification, not a mere
+        # "no": resume walks on to an older snapshot, which operators
+        # should see happening
+        STAT_ADD("ckpt_verify_failures")
         return False
     return True
 
@@ -133,6 +137,8 @@ def _manifest_crc(snap_dir: str) -> Optional[int]:
     mpath = os.path.join(snap_dir, MANIFEST_NAME)
     try:
         return _file_crc32(mpath)
+    # absence probe: None is the answer (no manifest, legacy snapshot)
+    # pbox-lint: disable=EXC007
     except OSError:
         return None
 
@@ -147,6 +153,9 @@ def read_watermark(root: str) -> Optional[Dict[str, Any]]:
     try:
         with open(path) as f:
             return json.load(f)
+    # absent-or-torn watermark reads as None by design: the atomic
+    # publish means a reader never has to distinguish the two
+    # pbox-lint: disable=EXC007
     except (OSError, ValueError):
         return None
 
@@ -199,6 +208,7 @@ class CheckpointManager:
         try:
             with open(path) as f:
                 return json.load(f)
+        # pbox-lint: disable=EXC007 — same contract as read_watermark
         except (OSError, ValueError):
             return None  # a torn cursor reads as absent, never as garbage
 

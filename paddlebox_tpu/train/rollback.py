@@ -35,9 +35,12 @@ class PassGuard:
     def __init__(self, table, trainer: Optional[Any] = None):
         self.table = table
         self.trainer = trainer
-        self._keys: Optional[np.ndarray] = None
-        self._vals: Optional[np.ndarray] = None
-        self._dense: Optional[tuple] = None
+        # confirm() runs on the end_pass worker; revert() only after
+        # wait_end_pass joins that worker (revert_pass waits first), so
+        # the Future handoff is the happens-before edge
+        self._keys: Optional[np.ndarray] = None  # synchronized-by: end-pass join handoff (wait_end_pass)
+        self._vals: Optional[np.ndarray] = None  # synchronized-by: end-pass join handoff (wait_end_pass)
+        self._dense: Optional[tuple] = None  # synchronized-by: end-pass join handoff (wait_end_pass)
 
     @property
     def armed(self) -> bool:

@@ -318,7 +318,30 @@ class PassSupervisor:
         )
         self.incidents.append(inc)
         STAT_ADD("supervisor_incidents")
-        STAT_ADD(f"supervisor_{kind}")
+        # one literal per kind (MON005): the incident vocabulary is closed
+        # (Incident.kind docstring), so the metric family stays enumerable
+        if kind == "load_error":
+            STAT_ADD("supervisor_load_error")
+        elif kind == "prefetch_error":
+            STAT_ADD("supervisor_prefetch_error")
+        elif kind == "data_poisoned":
+            STAT_ADD("supervisor_data_poisoned")
+        elif kind == "ckpt_save_error":
+            STAT_ADD("supervisor_ckpt_save_error")
+        elif kind == "peer_abort":
+            STAT_ADD("supervisor_peer_abort")
+        elif kind == "train_error":
+            STAT_ADD("supervisor_train_error")
+        elif kind == "escalate_resume":
+            STAT_ADD("supervisor_escalate_resume")
+        elif kind == "gave_up":
+            STAT_ADD("supervisor_gave_up")
+        elif kind == "gate_nan":
+            STAT_ADD("supervisor_gate_nan")
+        elif kind == "gate_auc":
+            STAT_ADD("supervisor_gate_auc")
+        else:  # pragma: no cover - new kinds must be added above
+            STAT_ADD("supervisor_other")
         PROFILER.instant(f"supervisor:{kind}", inc.as_dict())
         return inc
 
@@ -396,7 +419,9 @@ class PassSupervisor:
             try:
                 self.ds.wait_preload_done()
             except Exception:
-                pass
+                # the staged load is discarded either way, but a failed
+                # one is still a failed load: count it, don't erase it
+                STAT_ADD("supervisor_stale_preload_errors")
             self.ds.discard_staged()
         self._load_with_retry(date, files)
 

@@ -100,6 +100,8 @@ def _initialized_platform() -> Optional[str]:
             import jax
 
             return jax.default_backend()
+    # probe child: None IS the answer (the parent counts/alarms on it)
+    # pbox-lint: disable=EXC007
     except Exception:
         return None
     return None

@@ -71,8 +71,16 @@ def _build() -> bool:
         os.replace(tmp, _LIB)
         return True
     except Exception:
+        # every caller silently falls back to the pure-Python paths on
+        # False — a 10x parse/pull slowdown nobody asked for must at
+        # least leave a counter behind (lazy import: this module stays
+        # importable before the package does)
+        from paddlebox_tpu.utils.monitor import STAT_ADD
+
+        STAT_ADD("native.build_failures")
         try:
             os.unlink(tmp)
+        # pbox-lint: disable=EXC007 — tmp may never have been created
         except OSError:
             pass
         return False
@@ -83,6 +91,8 @@ def _stale() -> bool:
     try:
         t = os.path.getmtime(_LIB)
         return any(os.path.getmtime(s) > t for s in _SRCS)
+    # staleness probe: a vanished .so or source answers "rebuild"
+    # pbox-lint: disable=EXC007
     except OSError:
         return True
 
@@ -93,12 +103,23 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or _stale():
+        # PBOX_NATIVE_LIB points the whole native tier at a prebuilt .so
+        # (tools/native_sanitize.py replays the test suite against an
+        # ASan+UBSan-instrumented build this way); the override is never
+        # rebuilt or staleness-checked — the caller owns its lifecycle
+        lib_path = os.environ.get("PBOX_NATIVE_LIB") or _LIB
+        if lib_path == _LIB and (not os.path.exists(_LIB) or _stale()):
             if not (all(os.path.exists(s) for s in _SRCS) and _build()):
                 return None
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(lib_path)
         except OSError:
+            # a .so that BUILT but won't load (ABI skew, torn file from a
+            # pre-atomic-rename writer) is stranger than a missing
+            # compiler — count it separately from build failures
+            from paddlebox_tpu.utils.monitor import STAT_ADD
+
+            STAT_ADD("native.load_failures")
             return None
         lib.pbx_parse_buffer.restype = ctypes.c_void_p
         lib.pbx_parse_buffer.argtypes = [
@@ -325,6 +346,7 @@ class NativePacker:
     def __del__(self):  # best-effort; close() is the real contract
         try:
             self.close()
+        # pbox-lint: disable=EXC007 — finalizer; close() is the contract
         except Exception:
             pass
 
@@ -493,6 +515,7 @@ class NativeHostStore:
     def __del__(self):
         try:
             self.close()
+        # pbox-lint: disable=EXC007 — finalizer; close() is the contract
         except Exception:
             pass
 

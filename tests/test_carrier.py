@@ -40,6 +40,8 @@ def _write_pass(path, seed, lo, hi, n=48):
     """Records whose keys come from [lo, hi): consecutive passes overlap."""
     rng = np.random.default_rng(seed)
     path.parent.mkdir(parents=True, exist_ok=True)
+    # fixture writer: path derives from tmp_path (helper param hides it)
+    # pbox-lint: disable=IO004
     with open(path, "w") as f:
         for _ in range(n):
             parts = [f"1 {float(rng.integers(0, 2))}"]

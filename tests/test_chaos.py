@@ -73,6 +73,8 @@ def _schema():
 def _write(path, seed, lo, hi, n=64):
     rng = np.random.default_rng(seed)
     path.parent.mkdir(parents=True, exist_ok=True)
+    # fixture writer: path derives from tmp_path (helper param hides it)
+    # pbox-lint: disable=IO004
     with open(path, "w") as f:
         for _ in range(n):
             parts = [f"1 {float(rng.integers(0, 2))}"]

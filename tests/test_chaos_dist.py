@@ -802,6 +802,8 @@ def _assert_closed(s):
     s.settimeout(2.0)
     try:
         assert s.recv(1) == b""
+    # either outcome — EOF bytes or a reset — proves the peer hung up
+    # pbox-lint: disable=EXC007
     except (ConnectionError, OSError):
         pass
     s.close()
@@ -866,6 +868,8 @@ def test_v3_sender_vs_v2_listener_typed_error():
         while True:
             try:
                 c, _ = srv.accept()
+            # accept() raising = listener socket closed = shutdown signal
+            # pbox-lint: disable=EXC007
             except OSError:
                 return
             c.recv(_HELLO.size)  # reads the v3 HELLO, rejects silently
@@ -901,6 +905,8 @@ def test_v3_sender_vs_versioned_peer_typed_error():
         while True:
             try:
                 c, _ = srv.accept()
+            # accept() raising = listener socket closed = shutdown signal
+            # pbox-lint: disable=EXC007
             except OSError:
                 return
             c.recv(_HELLO.size)

@@ -56,6 +56,8 @@ def write_files(tmp, n_files, lines_per_file, rng, with_logkey=False, key_w=None
             parts += [f"1 {k}" for k in ks]
             lines.append(" ".join(parts))
         p = os.path.join(tmp, f"part-{fi:03d}.txt")
+        # fixture writer: tmp is the caller's tmp_path
+        # pbox-lint: disable=IO004
         open(p, "w").write("\n".join(lines) + "\n")
         paths.append(p)
     return paths

@@ -1,0 +1,168 @@
+"""Recovery tests for the fault sites no other test file targets.
+
+pbox-lint FLT008 demands every ``faultinject.KNOWN_SITES`` entry be
+exercised by at least one test — a site that fires in package code but has
+no test aimed at it guards a recovery path with zero coverage. This file
+closes the four gaps the rule found: ``fs.atomic_write``,
+``checkpoint.load``, ``transport.connect`` and ``transport.heartbeat``.
+Each test asserts the actual recovery CONTRACT around the site, not just
+that the fault fired.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.parallel.transport import TcpTransport
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train.checkpoint import CheckpointManager
+from paddlebox_tpu.utils.faultinject import (
+    InjectedFault,
+    fail_nth,
+    fail_once,
+    inject,
+)
+from paddlebox_tpu.utils.fs import atomic_write
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+from tests.test_chaos_dist import _free_ports
+
+
+# ---------------------------------------------------------------------------
+# fs.atomic_write: the site fires between write and publish — the exact
+# window the atomicity claim is about.
+
+
+def test_atomic_write_crash_window_keeps_previous_content(tmp_path):
+    path = str(tmp_path / "report.json")
+    with atomic_write(path) as f:
+        f.write("v1")
+    with inject(fail_once("fs.atomic_write")) as plan:
+        with pytest.raises(InjectedFault):
+            with atomic_write(path) as f:
+                f.write("v2-torn")
+        assert plan.failures("fs.atomic_write") == 1
+        # the torn bytes landed in the tmp file; the published path is
+        # untouched by the failed publish
+        with open(path) as f:
+            assert f.read() == "v1"
+        # fail_once heals: the retried publish commits and cleans the tmp
+        with atomic_write(path) as f:
+            f.write("v2")
+    with open(path) as f:
+        assert f.read() == "v2"
+    assert not os.path.exists(path + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint.load: resume() is read-only on the checkpoint tree, so a load
+# crash must be fully retryable — the retried resume lands on the same
+# state a never-crashed resume would have.
+
+
+LAYOUT = ValueLayout(embedx_dim=2)
+OPT = SparseOptimizerConfig()
+
+
+def _seeded_root(root):
+    cm = CheckpointManager(root)
+    t = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    rng = np.random.default_rng(7)
+    keys = np.unique(rng.integers(1, 300, 40).astype(np.uint64))
+    rows = t.pull_or_create(keys)
+    rows += rng.standard_normal(rows.shape).astype(np.float32)
+    t.push(keys, rows)
+    cm.save_base("20260101", t, None)
+    rows2 = t.pull_or_create(keys)
+    rows2 += 1.0
+    t.push(keys, rows2)
+    cm.save_delta("20260101", t, None)
+    return t
+
+
+def _resume_fresh(root):
+    t = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    st = CheckpointManager(root).resume(t, None)
+    return st, t
+
+
+@pytest.mark.parametrize("hit", [1, 2])  # base load, then delta apply
+def test_checkpoint_load_crash_is_retryable(tmp_path, hit):
+    root = str(tmp_path / "ckpt")
+    ref = _seeded_root(root)
+    with inject(fail_nth("checkpoint.load", hit)) as plan:
+        with pytest.raises(InjectedFault):
+            _resume_fresh(root)
+        assert plan.failures("checkpoint.load") == 1
+        # same plan, fault budget spent: the retry inside the same process
+        # (supervisor escalation re-enters resume) must succeed
+        st, t = _resume_fresh(root)
+    assert st["delta_idx"] == 1
+    keys = np.sort(ref.keys())
+    np.testing.assert_array_equal(np.sort(t.keys()), keys)
+    np.testing.assert_array_equal(
+        t.pull_or_create(keys), ref.pull_or_create(keys)
+    )
+
+
+# ---------------------------------------------------------------------------
+# transport.connect / transport.heartbeat: a connect flake is absorbed by
+# the send path's reconnect-with-backoff; a heartbeat flake is counted and
+# never takes down the beat loop or the data path.
+
+
+@pytest.fixture()
+def _fast_transport_flags():
+    names = ("transport_heartbeat_s", "transport_backoff_s",
+             "transport_send_retries")
+    prev = {n: config.get_flag(n) for n in names}
+    config.set_flag("transport_backoff_s", 0.005)
+    config.set_flag("transport_send_retries", 4)
+    yield
+    for n, v in prev.items():
+        config.set_flag(n, v)
+
+
+def _pair(hb=0.0):
+    config.set_flag("transport_heartbeat_s", hb)
+    eps = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+    return [TcpTransport(r, eps, timeout=10.0) for r in range(2)]
+
+
+def test_connect_flake_absorbed_by_send_retry(_fast_transport_flags):
+    ts = _pair()
+    try:
+        with inject(fail_once("transport.connect")) as plan:
+            ts[0].send(1, "t", b"payload-after-connect-flake")
+            assert ts[1].recv("t", 0) == b"payload-after-connect-flake"
+            assert plan.failures("transport.connect") == 1
+    finally:
+        for t in ts:
+            t.close()
+
+
+def test_heartbeat_flake_counted_and_survived(_fast_transport_flags):
+    ts = _pair(hb=0.05)
+    try:
+        before = STAT_GET("transport.heartbeat_errors")
+        with inject(fail_once("transport.heartbeat")) as plan:
+            deadline = time.monotonic() + 10.0
+            while plan.failures("transport.heartbeat") == 0:
+                assert time.monotonic() < deadline, "heartbeat never fired"
+                time.sleep(0.01)
+        assert STAT_GET("transport.heartbeat_errors") == before + 1
+        # the loop survived the flake and the data path never noticed
+        ts[0].send(1, "t", b"after-heartbeat-flake")
+        assert ts[1].recv("t", 0) == b"after-heartbeat-flake"
+    finally:
+        for t in ts:
+            t.close()

@@ -330,6 +330,267 @@ class TestStatNames:
         assert rule_findings(res, "MON005") == []
 
 
+# ---- THR006 ----------------------------------------------------------------
+
+
+class TestRaceDetector:
+    def test_positive(self, tmp_path):
+        # _push is reachable from BOTH the spawned thread (via _worker)
+        # and the main thread (via the uncalled root `add`) with no lock
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self.items = []
+                    threading.Thread(target=self._worker).start()
+
+                def _push(self):
+                    self.items.append(1)
+
+                def _worker(self):
+                    self._push()
+
+                def add(self):
+                    self._push()
+        """)
+        errs = rule_findings(res, "THR006")
+        assert errs, "two-thread unlocked mutation must fire"
+        assert any("items" in f.message for f in errs)
+
+    def test_locked_on_both_sides_is_quiet(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self.items = []
+                    self._lock = threading.Lock()
+                    threading.Thread(target=self._worker).start()
+
+                def _worker(self):
+                    with self._lock:
+                        self.items.append(1)
+
+                def add(self, x):
+                    with self._lock:
+                        self.items.append(x)
+        """)
+        assert rule_findings(res, "THR006") == []
+
+    def test_lock_held_on_call_path_is_quiet(self, tmp_path):
+        # the callee never takes the lock itself — every caller does; the
+        # meet-over-paths propagation must see it as protected
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self.items = []
+                    self._lock = threading.Lock()
+                    threading.Thread(target=self._worker).start()
+
+                def _grow(self):
+                    self.items.append(0)
+
+                def _worker(self):
+                    with self._lock:
+                        self._grow()
+
+                def add(self):
+                    with self._lock:
+                        self._grow()
+        """)
+        assert rule_findings(res, "THR006") == []
+
+    def test_single_thread_is_quiet(self, tmp_path):
+        res = lint_source(tmp_path, """
+            class Box:
+                def __init__(self):
+                    self.items = []
+
+                def add(self, x):
+                    self.items.append(x)
+        """)
+        assert rule_findings(res, "THR006") == []
+
+    def test_synchronized_by_annotation_is_quiet(self, tmp_path):
+        # same two-thread _stage shape as the positive, but the init site
+        # documents the non-lock mechanism — the annotation exempts it
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self.staged = None  # synchronized-by: worker join handoff
+                    self._t = threading.Thread(target=self._worker)
+                    self._t.start()
+
+                def _stage(self, v):
+                    self.staged = v
+
+                def _worker(self):
+                    self._stage([1])
+
+                def consume(self):
+                    self._t.join()
+                    self._stage(None)
+        """)
+        assert rule_findings(res, "THR006") == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self.items = []
+                    threading.Thread(target=self._worker).start()
+
+                def _push(self):
+                    self.items.append(1)  # pbox-lint: disable=THR006
+
+                def _worker(self):
+                    self._push()
+
+                def add(self):
+                    self._push()
+        """)
+        assert rule_findings(res, "THR006") == []
+
+
+# ---- EXC007 ----------------------------------------------------------------
+
+
+class TestExceptionFlow:
+    def test_positive_silent_swallow(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def f():
+                try:
+                    return 1
+                except Exception:
+                    pass
+        """)
+        errs = rule_findings(res, "EXC007")
+        assert len(errs) == 1
+        assert "silently swallows" in errs[0].message
+
+    def test_counted_or_recorded_swallow_is_quiet(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu.utils.monitor import STAT_ADD
+
+            def counted():
+                try:
+                    return 1
+                except OSError:
+                    STAT_ADD("x.oserrors")
+
+            def logged(log):
+                try:
+                    return 1
+                except Exception as e:
+                    log.warning("boom %r", e)
+        """)
+        assert rule_findings(res, "EXC007") == []
+
+    def test_deferred_surface_is_quiet(self, tmp_path):
+        # storing or handing off the bound exception is a deferred
+        # re-raise, not a swallow
+        res = lint_source(tmp_path, """
+            def stored(self):
+                try:
+                    return 1
+                except Exception as e:
+                    self._exc = e
+
+            def handed(errors):
+                try:
+                    return 1
+                except BaseException as e:
+                    errors.append(e)
+        """)
+        assert rule_findings(res, "EXC007") == []
+
+    def test_narrow_handler_is_quiet(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def f():
+                try:
+                    return 1
+                except (KeyError, ValueError):
+                    return None
+        """)
+        assert rule_findings(res, "EXC007") == []
+
+    def test_suppressed_next_line_directive(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def f():
+                try:
+                    return 1
+                # absence probe: None IS the answer
+                # pbox-lint: disable=EXC007
+                except OSError:
+                    return None
+        """)
+        assert rule_findings(res, "EXC007") == []
+
+
+# ---- FLT008 ----------------------------------------------------------------
+
+FAULT_CATALOG_STUB = """
+    KNOWN_SITES = (
+        "covered.site",
+        "dead.site",
+        "untested.site",
+    )
+
+    def fire(site):
+        pass
+"""
+
+
+class TestFaultSiteCoverage:
+    def fixture(self, tmp_path, test_src):
+        return lint_source(
+            tmp_path,
+            """
+            from paddlebox_tpu.utils.faultinject import fire
+
+            def a():
+                fire("covered.site")
+
+            def b():
+                fire("untested.site")
+            """,
+            name="pkg_mod.py",
+            extra_files=[
+                ("utils/faultinject.py", FAULT_CATALOG_STUB),
+                ("tests/test_cov.py", test_src),
+            ],
+        )
+
+    def test_dead_and_untested_sites_fire(self, tmp_path):
+        res = self.fixture(tmp_path, """
+            def test_covered():
+                assert "covered.site"
+        """)
+        msgs = [f.message for f in rule_findings(res, "FLT008")]
+        # dead.site draws both findings (never fired AND never referenced)
+        assert len(msgs) == 3
+        assert any("dead.site" in m and "never fired" in m for m in msgs)
+        assert any(
+            "untested.site" in m and "not referenced" in m for m in msgs
+        )
+        assert not any("covered.site" in m for m in msgs)
+
+    def test_full_coverage_is_quiet(self, tmp_path):
+        res = self.fixture(tmp_path, """
+            SCHEDULE = ["covered.site", "untested.site", "dead.site"]
+        """)
+        msgs = [f.message for f in rule_findings(res, "FLT008")]
+        # dead.site is still never FIRED by package code
+        assert len(msgs) == 1 and "dead.site" in msgs[0]
+
+
 # ---- baseline round-trip ---------------------------------------------------
 
 

@@ -1,0 +1,27 @@
+"""Opt-in (slow) gate: the native tier must replay clean under ASan+UBSan.
+
+Tier-1 runs ``-m 'not slow'`` so this never taxes the fast lane; the soak
+lane (and ``chaos_probe --native-sanitize``) runs it. The driver itself
+skips with exit 0 when the image has no g++ or sanitizer runtimes, so the
+assertion stays green on build-less lanes too.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_native_sanitize_quick_replay_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "native_sanitize.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=900, cwd=_REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -128,6 +128,8 @@ def _write_pv_file(path, rng, n_queries=60, n_slots=3):
             label = 1.0 if (keys % 5 == 0).any() else 0.0
             parts = [f"1 {_logkey(q, 222, r)}", f"1 {label}"] + [f"1 {k}" for k in keys]
             lines.append(" ".join(parts))
+    # fixture writer: path derives from tmp_path (helper param hides it)
+    # pbox-lint: disable=IO004
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
 

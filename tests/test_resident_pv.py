@@ -41,6 +41,8 @@ def _write_pv_file(path, rng, n_queries=40, n_slots=S):
                 f"1 {k}" for k in keys
             ]
             lines.append(" ".join(parts))
+    # fixture writer: path derives from tmp_path (helper param hides it)
+    # pbox-lint: disable=IO004
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
 

@@ -89,6 +89,8 @@ class PublishStack:
             lines.append(
                 f"1 {float(keys[0] % 2)} " + " ".join(f"1 {k}" for k in keys)
             )
+        # fixture writer: path derives from the harness tmp dir
+        # pbox-lint: disable=IO004
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
         if self.probe is None:
@@ -274,6 +276,8 @@ def test_corrupt_delta_skipped_and_alarmed(stack):
         if n.endswith(".npz")
     )
     original = open(victim, "rb").read()
+    # deliberate corruption of a published delta (raw bytes are the point)
+    # pbox-lint: disable=IO004
     with open(victim, "wb") as f:  # flip bytes, keep the size
         f.write(original[:10] + bytes([original[10] ^ 0xFF]) + original[11:])
 
@@ -284,6 +288,8 @@ def test_corrupt_delta_skipped_and_alarmed(stack):
     assert v.delta_idx == 0  # still the base
     np.testing.assert_array_equal(good, st.follower_scores(v))
 
+    # deliberate in-place repair of the corrupted delta (raw on purpose)
+    # pbox-lint: disable=IO004
     with open(victim, "wb") as f:  # repair: publisher re-copies the delta
         f.write(original)
     assert fol.poll_once() is True
@@ -302,6 +308,8 @@ def test_watermark_rewind_rejected(stack):
     # hand-roll a rewound watermark: same base, delta_idx back to 0
     wm = read_watermark(st.root)
     wm["delta_idx"], wm["deltas"] = 0, []
+    # hand-rolled torn watermark: bypassing atomic_write IS the point
+    # pbox-lint: disable=IO004
     with open(os.path.join(st.root, "latest.json"), "w") as f:
         json.dump(wm, f)
     with pytest.raises(DeltaLineageError, match="rewound"):

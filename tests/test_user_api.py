@@ -93,6 +93,8 @@ def _write_day(tmp, rng, name, n=128):
         lab = 1.0 if key_w[ks].sum() + rng.normal() * 0.3 > 0 else 0.0
         lines.append(f"1 {lab:.1f} " + " ".join(f"1 {k}" for k in ks))
     p = os.path.join(tmp, name)
+    # fixture writer: tmp is the caller's tmp_path
+    # pbox-lint: disable=IO004
     open(p, "w").write("\n".join(lines) + "\n")
     return p
 

@@ -77,6 +77,8 @@ def load_sweep_artifact(path: str):
     try:
         with open(path) as f:
             art = json.load(f)
+    # absence/corruption probe: None (cache miss, re-sweep) IS the answer
+    # pbox-lint: disable=EXC007
     except (OSError, ValueError):
         return None
     if (

@@ -11,9 +11,19 @@ Exit codes:
   2  usage / internal error
 
 Typical invocations:
-  python tools/run_lint.py paddlebox_tpu/
-  python tools/run_lint.py paddlebox_tpu/ --format=json
-  python tools/run_lint.py paddlebox_tpu/ --update-baseline
+  python tools/run_lint.py                             # full default scan
+  python tools/run_lint.py paddlebox_tpu/ tools/ tests/
+  python tools/run_lint.py --changed                   # files vs HEAD only
+  python tools/run_lint.py --changed=main --format=json
+  python tools/run_lint.py --update-baseline
+
+The default scan set is paddlebox_tpu/ + tools/ + tests/ with per-root
+rule profiles (analysis.DEFAULT_PROFILES): flow rules that would drown in
+test-harness noise (JIT001, THR006) are off under tests/, everything else
+is on everywhere.  ``--changed[=REF]`` lints only files that differ from
+a git ref (default HEAD) for sub-second pre-commit runs; whole-program
+rules still load the FULL default set for resolution (call graph,
+registries, fault-site coverage) but only report on the changed files.
 """
 
 from __future__ import annotations
@@ -22,10 +32,12 @@ import argparse
 import importlib.util
 import json
 import os
+import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _DEFAULT_BASELINE = os.path.join(_REPO, "tools", "lint_baseline.json")
+_DEFAULT_ROOTS = ("paddlebox_tpu", "tools", "tests")
 
 
 def _load_analysis():
@@ -42,10 +54,38 @@ def _load_analysis():
     return mod
 
 
+def _changed_files(ref: str) -> list:
+    """Tracked .py files differing from ``ref`` plus untracked .py files,
+    repo-relative."""
+    out = set()
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", "--diff-filter=d", ref, "--", "*.py"],
+        cwd=_REPO, capture_output=True, text=True, timeout=30,
+    )
+    if diff.returncode != 0:
+        raise RuntimeError(
+            f"git diff {ref} failed: {diff.stderr.strip() or diff.stdout.strip()}"
+        )
+    out.update(l for l in diff.stdout.splitlines() if l.strip())
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+        cwd=_REPO, capture_output=True, text=True, timeout=30,
+    )
+    if untracked.returncode == 0:
+        out.update(l for l in untracked.stdout.splitlines() if l.strip())
+    return sorted(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pbox-lint", description=__doc__)
     ap.add_argument("paths", nargs="*", default=None,
-                    help="files/dirs to lint (default: paddlebox_tpu/)")
+                    help="files/dirs to lint "
+                         "(default: paddlebox_tpu/ tools/ tests/)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only files differing from a git ref (default "
+                         "HEAD); whole-program rules still resolve over the "
+                         "full default scan set")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
                     help="baseline file (default: tools/lint_baseline.json)")
@@ -53,15 +93,12 @@ def main(argv=None) -> int:
                     help="ignore the baseline: every error gates")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current errors and exit 0")
+    ap.add_argument("--no-profiles", action="store_true",
+                    help="disable the per-root rule profiles (every rule "
+                         "applies everywhere)")
     ap.add_argument("--quiet", action="store_true",
                     help="suppress warnings and grandfathered findings")
     args = ap.parse_args(argv)
-
-    paths = args.paths or [os.path.join(_REPO, "paddlebox_tpu")]
-    for p in paths:
-        if not os.path.exists(p):
-            print(f"pbox-lint: no such path: {p}", file=sys.stderr)
-            return 2
 
     try:
         analysis = _load_analysis()
@@ -70,7 +107,47 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    result = analysis.lint_paths(paths, analysis.default_rules(), root=_REPO)
+    default_roots = [
+        os.path.join(_REPO, r) for r in _DEFAULT_ROOTS
+        if os.path.isdir(os.path.join(_REPO, r))
+    ]
+    context_paths: list = []
+    if args.changed is not None:
+        if args.paths:
+            print("pbox-lint: --changed and explicit paths are exclusive",
+                  file=sys.stderr)
+            return 2
+        try:
+            changed = _changed_files(args.changed)
+        except Exception as e:
+            print(f"pbox-lint: {e}", file=sys.stderr)
+            return 2
+        roots = tuple(r + os.sep for r in _DEFAULT_ROOTS)
+        paths = [
+            os.path.join(_REPO, f) for f in changed
+            if f.startswith(roots) and os.path.exists(os.path.join(_REPO, f))
+        ]
+        if not paths:
+            print(f"pbox-lint: no changed .py files vs {args.changed} "
+                  "under the scan roots")
+            return 0
+        context_paths = default_roots
+    else:
+        paths = args.paths or default_roots
+        for p in paths:
+            if not os.path.exists(p):
+                print(f"pbox-lint: no such path: {p}", file=sys.stderr)
+                return 2
+        # explicit single-file/dir runs still get whole-program resolution
+        # against the default roots (cheap, and THR006/FLT008 need it)
+        if args.paths:
+            context_paths = default_roots
+
+    profiles = None if args.no_profiles else analysis.DEFAULT_PROFILES
+    result = analysis.lint_paths(
+        paths, analysis.default_rules(), root=_REPO,
+        context_paths=context_paths, profiles=profiles,
+    )
 
     if args.update_baseline:
         analysis.save_baseline(args.baseline, result.findings)

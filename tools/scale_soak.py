@@ -162,7 +162,10 @@ def worker(rank: int, conf: dict) -> None:
     )
     tp.barrier("soak-done")
     tp.close()
-    with open(os.path.join(conf["workdir"], f"soak-{rank}.json"), "w") as f:
+    from paddlebox_tpu.utils.fs import atomic_write
+
+    # cross-process publish: the parent polls for this file
+    with atomic_write(os.path.join(conf["workdir"], f"soak-{rank}.json")) as f:
         json.dump(out, f)
     print(f"rank {rank}: {json.dumps(out)}", flush=True)
 
@@ -400,7 +403,9 @@ def zipf_main(argv) -> int:
         "ab": ab,
         "machine": {"cpus": os.cpu_count()},
     }
-    with open(args.out, "w") as f:
+    from paddlebox_tpu.utils.fs import atomic_write
+
+    with atomic_write(args.out) as f:
         json.dump(result, f, indent=1)
     print(json.dumps({"ab": ab}))
     return 0
@@ -434,7 +439,10 @@ def main() -> int:
             "workdir": workdir,
         }
         conf_path = os.path.join(workdir, "conf.json")
-        with open(conf_path, "w") as f:
+        from paddlebox_tpu.utils.fs import atomic_write
+
+        # cross-process publish: every spawned rank reads this
+        with atomic_write(conf_path) as f:
             json.dump(conf, f)
         t0 = time.perf_counter()
         procs = [
@@ -461,7 +469,9 @@ def main() -> int:
         "ranks": ranks,
         "machine": {"cpus": os.cpu_count()},
     }
-    with open(out_path, "w") as f:
+    from paddlebox_tpu.utils.fs import atomic_write
+
+    with atomic_write(out_path) as f:
         json.dump(result, f, indent=1)
     print(json.dumps({
         "keys": keys, "wall_s": round(wall, 1),

@@ -38,6 +38,7 @@ jax.config.update("jax_platforms", "cpu")
 import optax
 
 from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.utils.fs import atomic_write
 from paddlebox_tpu.data.parser import parse_line
 from paddlebox_tpu.models import DeepFM
 from paddlebox_tpu.serve import Follower, ScoreServer, Scorer, table_source, version_source
@@ -82,6 +83,8 @@ def write_pass_file(rng, path, rows, lo):
     for _ in range(rows):
         keys = rng.integers(lo, lo + 200, S)
         lines.append(f"1 {float(keys[0] % 2)} " + " ".join(f"1 {k}" for k in keys))
+    # fixture writer: path is this run's scratch space
+    # pbox-lint: disable=IO004
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
     return lines
@@ -254,7 +257,7 @@ def main():
         )
     print(json.dumps(report, indent=2))
     if args.json:
-        with open(args.json, "w") as f:
+        with atomic_write(args.json) as f:
             json.dump(report, f, indent=2)
     print("SERVE SOAK", "PASS" if report["ok"] else "FAIL")
     return 0 if report["ok"] else 1

@@ -41,6 +41,8 @@ from paddlebox_tpu.utils.backendguard import probe_backend  # noqa: E402
 
 
 def _log(entry: dict) -> None:
+    # append-only probe journal; atomic_write cannot append
+    # pbox-lint: disable=IO004
     with open(PROBE_LOOP_LOG, "a") as f:
         f.write(json.dumps(entry) + "\n")
 

@@ -9,6 +9,9 @@
 4. round-6 triad: committed kernel plan routes pull/push (native on CPU),
    persistent compile cache reports misses cold and hits warm in one
    process, and a wedged backend init falls back to CPU within deadline
+5. static gates: the full three-root pbox-lint scan must exit 0 with the
+   empty baseline, and the native tier must replay clean under ASan+UBSan
+   (quick set; skips green on images without g++)
 """
 import os, sys, tempfile
 import numpy as np
@@ -30,6 +33,8 @@ S = 4
 rng = np.random.default_rng(7)
 
 def write_file(path, n=2000):
+    # fixture writer: path is this run's scratch space
+    # pbox-lint: disable=IO004
     with open(path, "w") as f:
         for _ in range(n):
             keys = rng.integers(1, 500, S)
@@ -79,6 +84,8 @@ table.push = bad_push
 try:
     table.drain_pending()
     raised = False
+# the except IS the assertion: the injected error must surface here
+# pbox-lint: disable=EXC007
 except OSError:
     raised = True
 table.push = orig_push
@@ -188,4 +195,20 @@ assert report["parity"]["checked"] == 3 and not report["parity"]["mismatched"]
 print(f"[8] serve soak ok: {report['requests']} req @ {report['achieved_qps']} qps, "
       f"p50={report['latency']['p50_ms']:.1f}ms p99={report['latency']['p99_ms']:.1f}ms, "
       f"parity bitwise at {report['parity']['checked']} deltas")
+
+# --- 9. static gates: lint + native sanitize ----------------------------
+# the same commands CI runs, end to end: whole-repo lint (default roots,
+# empty baseline) and the ASan+UBSan quick replay of the native tier
+import subprocess
+
+_here = os.path.dirname(os.path.abspath(__file__))
+r = subprocess.run([sys.executable, os.path.join(_here, "run_lint.py")],
+                   capture_output=True, text=True, timeout=600)
+assert r.returncode == 0, f"lint gate red:\n{r.stdout}{r.stderr}"
+san = subprocess.run(
+    [sys.executable, os.path.join(_here, "native_sanitize.py"), "--quick"],
+    capture_output=True, text=True, timeout=900)
+assert san.returncode == 0, f"sanitize replay red:\n{san.stdout}{san.stderr}"
+san_line = san.stdout.strip().splitlines()[-1] if san.stdout.strip() else ""
+print(f"[9] static gates ok: lint clean (empty baseline); {san_line}")
 print("VERIFY DRIVE PASS")
