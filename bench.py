@@ -286,6 +286,7 @@ def main():
     )
     from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
     from paddlebox_tpu.utils.monitor import STAT_GET
+    from paddlebox_tpu.utils.monitor import all_histograms as _all_histograms
 
     pv = pv_mode_enabled()
     rng = np.random.default_rng(0)
@@ -504,6 +505,13 @@ def main():
                 "premerge_s", "prefetch_pull_s", "dedup_s", "pull_s",
                 "splice_s", "writeback_s", "overlap_hidden_s",
             )
+        },
+        # distribution view of the same stages (obs histograms): the
+        # gauges above are last-pass values, these are across-the-run
+        # count/mean/p50/p99 for every STAT_OBSERVE'd series
+        "distributions": {
+            name: hist.summary((0.5, 0.99))
+            for name, hist in sorted(_all_histograms().items())
         },
         "warmup_s": round(warmup_s, 3),
         # backend bring-up verdict (utils/backendguard): "ok" or

@@ -1,7 +1,8 @@
 """MON005 — stat-name hygiene.
 
 Dashboards and soak tooling enumerate the monitor registry by name; that
-only works if every ``STAT_ADD``/``STAT_SET`` site uses a string literal
+only works if every ``STAT_ADD``/``STAT_SET``/``STAT_OBSERVE`` site uses
+a string literal
 from the flat ``[a-z0-9_.]+`` namespace. An f-string name mints an
 unbounded metric family nothing can enumerate ahead of time; an uppercase
 or hyphenated name breaks the dashboards' parsing convention.
@@ -22,12 +23,12 @@ from typing import List
 from .core import Finding, ModuleCtx, Rule, call_name
 
 _NAME_RE = re.compile(r"[a-z0-9_.]+")
-_STAT_FUNCS = {"STAT_ADD", "STAT_SET"}
+_STAT_FUNCS = {"STAT_ADD", "STAT_SET", "STAT_OBSERVE"}
 
 
 class StatNameRule(Rule):
     id = "MON005"
-    doc = "STAT_ADD/STAT_SET names must be enumerable literals"
+    doc = "STAT_ADD/STAT_SET/STAT_OBSERVE names must be enumerable literals"
 
     def check_module(self, ctx: ModuleCtx) -> List[Finding]:
         if ctx.path.endswith("utils/monitor.py"):

@@ -49,7 +49,7 @@ from paddlebox_tpu.table.sparse_table import HostSparseTable, PassWorkingSet
 from paddlebox_tpu.utils.faultinject import fire
 from paddlebox_tpu.utils.fs import fs_glob
 from paddlebox_tpu.utils.line_reader import BufferedLineFileReader
-from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from paddlebox_tpu.utils.trace import record_event
 
 config.define_flag(
@@ -680,6 +680,7 @@ class BoxPSDataset:
             )
         premerge_s = time.perf_counter() - t0
         STAT_SET("boundary.premerge_s", premerge_s)
+        STAT_OBSERVE("boundary.premerge_s", premerge_s)
         if self._in_pass:
             with self._stage_lock:
                 self._stage_hidden_s += premerge_s
@@ -722,6 +723,7 @@ class BoxPSDataset:
             rows, epoch = table.prefetch_rows(need)
         pull_s = time.perf_counter() - t0
         STAT_SET("boundary.prefetch_pull_s", pull_s)
+        STAT_OBSERVE("boundary.prefetch_pull_s", pull_s)
         with self._stage_lock:
             self._stage_hidden_s += pull_s
         self._boundary_prefetch = {
@@ -1351,6 +1353,7 @@ class BoxPSDataset:
                         prev_carrier.supersede()
                     wb_s = time.perf_counter() - t_wb
                 STAT_SET("boundary.writeback_s", wb_s)
+                STAT_OBSERVE("boundary.writeback_s", wb_s)
                 dropped = table.decay_and_shrink() if shrink else 0
                 saved = table.save_delta(delta_dir) if need_save_delta else 0
                 # enforce the host-RAM cap: evict cold rows to the disk tier
@@ -1421,6 +1424,7 @@ class BoxPSDataset:
             with self._stage_lock:
                 stage_hidden, self._stage_hidden_s = self._stage_hidden_s, 0.0
             STAT_SET("boundary.overlap_hidden_s", hidden + stage_hidden)
+            STAT_OBSERVE("boundary.overlap_hidden_s", hidden + stage_hidden)
         # surface an already-stored eager-flush failure HERE too: a run's
         # final pass has no next begin_pass to raise it, and exiting 0
         # with carried values still owed would hide the durability gap

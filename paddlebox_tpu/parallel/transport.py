@@ -73,10 +73,12 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from paddlebox_tpu import config
+from paddlebox_tpu.obs.flight_recorder import FLIGHT_RECORDER
+from paddlebox_tpu.obs.trace_context import EXT_LEN, current_trace, decode_ext
 from paddlebox_tpu.ops import host_codec
 from paddlebox_tpu.utils.faultinject import fire
-from paddlebox_tpu.utils.monitor import STAT_ADD
-from paddlebox_tpu.utils.trace import PROFILER
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE
+from paddlebox_tpu.utils.trace import PROFILER, Profiler
 
 _MAGIC = b"PBTX"
 _VERSION = 3
@@ -96,6 +98,13 @@ _FRAME = struct.Struct("<QBBHII")
 
 _KIND_DATA = 0
 _KIND_HEARTBEAT = 1
+# high bit of ``kind``: the body is prefixed with a 24-byte trace-context
+# extension (obs/trace_context.py EXT_STRUCT) BEFORE the tag. Covered by
+# the frame CRC. Only ever set when flag transport_trace_frames is on —
+# a pre-extension v3 reader would mis-slice the body and CRC-fail, so the
+# sender opts in per deployment rather than per handshake.
+_KIND_FLAG_TRACE = 0x80
+_KIND_MASK = 0x7F
 
 # frame payload codecs (PBTX v3)
 _CODEC_RAW = 0
@@ -109,6 +118,15 @@ config.define_flag(
     "max serialized bytes per shuffle sub-chunk: bounds the sender's "
     "serialization RAM and keeps frames flowing so the receive timeout "
     "paces per-chunk gaps, not whole-pass serialization",
+)
+
+
+config.define_flag(
+    "transport_trace_frames", False,
+    "stamp outgoing PBTX data frames with the sender's active "
+    "trace-context (trace_id, span_id) as a header extension, so "
+    "obs_report --merge-traces can correlate spans across ranks; leave "
+    "off when any peer predates the extension",
 )
 
 
@@ -193,10 +211,15 @@ class _SendLink:
 class TcpTransport:
     """Tagged rank-to-rank byte transport over TCP (fault-tolerant)."""
 
-    def __init__(self, rank: int, endpoints: List[str], timeout: float = 120.0):
+    def __init__(self, rank: int, endpoints: List[str], timeout: float = 120.0,
+                 profiler: Optional[Profiler] = None):
         self.rank = rank
         self.n_ranks = len(endpoints)
         self.timeout = timeout
+        # per-instance so an in-process multi-rank cluster (tests, chaos
+        # soaks) can give each rank its own timeline; defaults to the
+        # process-global profiler in real one-rank-per-process deployments
+        self._profiler = profiler if profiler is not None else PROFILER
         self._endpoints = [self._parse(e) for e in endpoints]
         # (tag, src) -> FIFO of frames: a duplicate tag from one peer queues
         # behind the unconsumed first frame instead of overwriting it (a
@@ -278,7 +301,7 @@ class TcpTransport:
             magic, version, src = _HELLO.unpack(_recv_exact(conn, _HELLO.size))
             if magic != _MAGIC or version != _VERSION:
                 STAT_ADD("transport.protocol_errors")
-                PROFILER.instant(
+                self._profiler.instant(
                     "transport:protocol_error",
                     {"magic": repr(magic), "version": version,
                      "local_version": _VERSION},
@@ -306,7 +329,9 @@ class TcpTransport:
                 seq, kind, codec, tag_len, n, crc = _FRAME.unpack(
                     _recv_exact(conn, _FRAME.size)
                 )
-                body = _recv_exact(conn, tag_len + n)
+                ext_len = EXT_LEN if kind & _KIND_FLAG_TRACE else 0
+                kind &= _KIND_MASK
+                body = _recv_exact(conn, ext_len + tag_len + n)
                 with self._cond:
                     self._last_seen[src] = time.monotonic()
                 if zlib.crc32(body) != crc:
@@ -314,15 +339,17 @@ class TcpTransport:
                     # inflate; the sender's resync replays everything
                     # un-delivered
                     STAT_ADD("transport.crc_errors")
-                    PROFILER.instant(
+                    self._profiler.instant(
                         "transport:crc_error", {"src": src, "seq": seq}
                     )
                     return
-                tag = body[:tag_len].decode()
-                payload = body[tag_len:]
+                tctx = decode_ext(body[:ext_len]) if ext_len else None
+                tag = body[ext_len:ext_len + tag_len].decode()
+                payload = body[ext_len + tag_len:]
                 if kind == _KIND_DATA:
                     STAT_ADD(
-                        "wire.host_bytes_recv", _FRAME.size + tag_len + n
+                        "wire.host_bytes_recv",
+                        _FRAME.size + ext_len + tag_len + n,
                     )
                 if codec != _CODEC_RAW:
                     try:
@@ -338,7 +365,7 @@ class TcpTransport:
                         # frame was never counted delivered, so the
                         # sender's resync replays it exactly once
                         STAT_ADD("transport.decode_errors")
-                        PROFILER.instant(
+                        self._profiler.instant(
                             "transport:decode_error",
                             {"src": src, "seq": seq, "error": repr(e)},
                         )
@@ -368,6 +395,15 @@ class TcpTransport:
                     STAT_ADD("transport.dup_frames_dropped")
                 if stale:
                     STAT_ADD("transport.stale_frames_dropped")
+                if tctx is not None and not dup and not stale:
+                    # the cross-rank correlation point: this instant and
+                    # the sender's transport:send share one trace_id
+                    STAT_ADD("transport.trace_frames_recv")
+                    args = tctx.as_args()
+                    args.update({"src": src, "tag": tag, "seq": seq})
+                    self._profiler.instant(
+                        "transport:deliver", args, category="transport"
+                    )
         except (ConnectionError, OSError):
             # a reader dying is how peer death first shows up on this
             # side; the heartbeat plane diagnoses it seconds later — count
@@ -389,7 +425,23 @@ class TcpTransport:
         self, pairs: List[Tuple[str, int]], op: str, timeout: Optional[float]
     ) -> List[bytes]:
         """Wait for one frame per (tag, src); deadline-aware with a
-        straggler report, and fail-fast on detector-dead peers."""
+        straggler report, and fail-fast on detector-dead peers. A dead
+        peer also snapshots the flight recorder: the incident bundle
+        (when flag obs_incident_dir is set) carries the last spans and
+        stats leading up to the death."""
+        try:
+            return self._take_all_inner(pairs, op, timeout)
+        except PeerDeadError as e:
+            self._profiler.instant(
+                "transport:peer_dead",
+                {"op": op, "dead": list(e.dead), "rank": self.rank},
+            )
+            FLIGHT_RECORDER.dump("peer_dead", detail=str(e))
+            raise
+
+    def _take_all_inner(
+        self, pairs: List[Tuple[str, int]], op: str, timeout: Optional[float]
+    ) -> List[bytes]:
         budget = self.timeout if timeout is None else timeout
         deadline = time.monotonic() + budget
         dead_s = float(config.get_flag("transport_peer_dead_s"))
@@ -580,7 +632,7 @@ class TcpTransport:
                         # data-path exhaustion; heartbeat callers count
                         # their own transport.heartbeat_errors instead
                         STAT_ADD("transport.send_errors")
-                    PROFILER.instant(
+                    self._profiler.instant(
                         "transport:send_error",
                         {
                             "dst": dst,
@@ -630,14 +682,30 @@ class TcpTransport:
         # worker thread: one peer's compression overlaps another peer's
         # socket write instead of serializing behind it
         codec, wire_payload = self._encode_payload(payload)
-        body = tb + wire_payload
+        kind = _KIND_DATA
+        ext = b""
+        if config.get_flag("transport_trace_frames"):
+            ctx = current_trace()
+            if ctx is not None:
+                # fresh span id per frame, same trace id: the receiver's
+                # transport:deliver correlates back to this send
+                wire_ctx = ctx.child()
+                ext = wire_ctx.encode_ext()
+                kind |= _KIND_FLAG_TRACE
+                STAT_ADD("transport.trace_frames_sent")
+                args = wire_ctx.as_args()
+                args.update({"dst": dst, "tag": tag})
+                self._profiler.instant(
+                    "transport:send", args, category="transport"
+                )
+        body = ext + tb + wire_payload
         crc = zlib.crc32(body)
         with self._send_locks[dst]:
             link = self._links[dst]
             link.next_seq += 1
             frame = (
                 _FRAME.pack(
-                    link.next_seq, _KIND_DATA, codec, len(tb),
+                    link.next_seq, kind, codec, len(tb),
                     len(wire_payload), crc,
                 )
                 + body
@@ -651,6 +719,7 @@ class TcpTransport:
                 "wire.host_raw_bytes_sent",
                 _FRAME.size + len(tb) + len(payload),
             )
+            STAT_OBSERVE("wire.frame_bytes", len(frame))
             # the frame is retained BEFORE the first wire attempt, so every
             # failure path (including a fault injected on the very first
             # send) replays it through the reconnect resync

@@ -50,7 +50,8 @@ from paddlebox_tpu.metrics.auc import auc_init
 from paddlebox_tpu.serve.scoring_table import TableVersion
 from paddlebox_tpu.table.sparse_table import PassWorkingSet
 from paddlebox_tpu.train.train_step import TrainState, make_train_step
-from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
+from paddlebox_tpu.obs.histogram import Histogram
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 
 
 class _RowSource:
@@ -195,7 +196,10 @@ class ScoreServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
-        self.latencies_s: List[float] = []  # guarded-by: _lock
+        # per-server latency distribution (the soak report's source of
+        # truth) — mirrored into the global registry via STAT_OBSERVE so
+        # obs_report sees serve latency next to every other series
+        self.latency_hist = Histogram()  # thread-safe itself
         self.served_indices: List[int] = []  # guarded-by: _lock
         self.staleness: List[Tuple[int, float]] = []  # guarded-by: _lock
 
@@ -286,7 +290,9 @@ class ScoreServer:
                 req.preds = preds[lo : lo + len(req.records)]
                 req.delta_idx = v.delta_idx
                 lo += len(req.records)
-                self.latencies_s.append(t_done - req.t_submit)
+                lat_ms = (t_done - req.t_submit) * 1000.0
+                self.latency_hist.observe(lat_ms)
+                STAT_OBSERVE("serve.latency_ms", lat_ms)
                 self.served_indices.append(v.delta_idx)
         for req in reqs:
             req.done.set()
@@ -298,14 +304,16 @@ class ScoreServer:
     # ---- reporting -------------------------------------------------------
 
     def latency_percentiles(self) -> dict:
-        with self._lock:
-            lats = list(self.latencies_s)
-        if not lats:
+        """Same report keys as the pre-histogram implementation (the soak
+        JSON golden-diff depends on them): n, p50_ms, p99_ms, max_ms."""
+        h = self.latency_hist
+        n = h.count
+        if n == 0:
             return {"n": 0}
-        arr = np.sort(np.asarray(lats))
+        p50, p99 = h.quantiles((0.5, 0.99))
         return {
-            "n": len(arr),
-            "p50_ms": float(np.percentile(arr, 50) * 1000.0),
-            "p99_ms": float(np.percentile(arr, 99) * 1000.0),
-            "max_ms": float(arr[-1] * 1000.0),
+            "n": n,
+            "p50_ms": float(p50),
+            "p99_ms": float(p99),
+            "max_ms": float(h.max),
         }

@@ -47,7 +47,7 @@ from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
 from paddlebox_tpu.utils.faultinject import InjectedFault, fire as _fault_fire
 from paddlebox_tpu.utils.fs import atomic_write
-from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from paddlebox_tpu.utils.trace import record_event
 
 config.define_flag(
@@ -545,6 +545,7 @@ class HostSparseTable:
             if len(sel) == 0:
                 continue
             shard = self._shards[s]
+            t_shard = time.perf_counter()
             with shard.lock:
                 idx = shard.index
                 klist = keys[sel].tolist()
@@ -563,6 +564,11 @@ class HostSparseTable:
                     created += len(miss)
                 shard.values[trows] = rows[sel]
                 shard.touched.update(klist)
+            # per-shard writeback time distribution: skew across shards
+            # is the writeback wall the ROADMAP finalize item chases
+            STAT_OBSERVE(
+                "table.push_shard_s", time.perf_counter() - t_shard
+            )
         if created:
             with self._size_lock:
                 self._size += created

@@ -79,8 +79,14 @@ import numpy as np
 
 from paddlebox_tpu import config
 from paddlebox_tpu.data.quarantine import DataPoisonedError
-from paddlebox_tpu.utils.monitor import STAT_ADD
+from paddlebox_tpu.obs.flight_recorder import FLIGHT_RECORDER
+from paddlebox_tpu.obs.metrics_writer import MetricsWriter
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE
 from paddlebox_tpu.utils.trace import PROFILER
+
+# incident kinds that end a pass (or the day) rather than healing in
+# place: each one flushes the flight recorder into an incident bundle
+_FATAL_INCIDENT_KINDS = ("data_poisoned", "peer_abort", "gave_up")
 
 config.define_flag(
     "supervisor_max_retries",
@@ -293,6 +299,17 @@ class PassSupervisor:
         )
         if cache_dir is not None:
             compilecache.enable(cache_dir)
+        # telemetry plane: metric series + incident bundles live under the
+        # durable checkpoint root (obs/) so postmortems travel with the
+        # artifacts they explain; without a checkpoint both stay off
+        # unless the obs_incident_dir flag points somewhere explicitly
+        self.metrics: Optional[MetricsWriter] = None
+        self._incident_dir: Optional[str] = None
+        if checkpoint is not None:
+            obs_dir = os.path.join(checkpoint.root, "obs")
+            rank = getattr(transport, "rank", 0) if transport is not None else 0
+            self.metrics = MetricsWriter(obs_dir, rank=rank)
+            self._incident_dir = os.path.join(obs_dir, "incidents")
         self.incidents: List[Incident] = []
         self._auc_history: deque = deque(maxlen=self.gates.auc_window)
         self._pass_seq = 0
@@ -343,6 +360,12 @@ class PassSupervisor:
         else:  # pragma: no cover - new kinds must be added above
             STAT_ADD("supervisor_other")
         PROFILER.instant(f"supervisor:{kind}", inc.as_dict())
+        if kind in _FATAL_INCIDENT_KINDS and action != "degrade":
+            # the pass is lost: publish the last N spans + stat snapshot
+            # + this incident as an atomic incident-<ts>.json bundle
+            FLIGHT_RECORDER.dump(
+                f"supervisor_{kind}", detail, dir_path=self._incident_dir
+            )
         return inc
 
     # ---- pieces ----------------------------------------------------------
@@ -619,6 +642,7 @@ class PassSupervisor:
         self._pass_seq += 1
         self._date = date if date is not None else self._date
         self._admit_poisoned = False
+        pass_t0 = time.monotonic()
         if self.coord is None:
             self._adopt_prefetch(date, files)
         else:
@@ -709,6 +733,19 @@ class PassSupervisor:
             self._auc_history.append(float(auc))
         if save is not None:
             self._save_checkpoint(save)
+        STAT_OBSERVE("supervisor.pass_s", time.monotonic() - pass_t0)
+        if self.metrics is not None:
+            # pass-boundary series point: counters + per-pass deltas +
+            # histogram summaries, labeled so obs_report can build the
+            # per-pass table without guessing at boundaries
+            self.metrics.snapshot(
+                f"pass:{self._pass_seq}",
+                extra={
+                    k: float(v)
+                    for k, v in out.items()
+                    if isinstance(v, (int, float)) and np.isfinite(v)
+                },
+            )
         return out
 
     def run_day(
@@ -736,4 +773,8 @@ class PassSupervisor:
                     prefetch=nxt,
                 )
             )
+            if self.metrics is not None:
+                # wall-clock cadence between the per-pass points: on long
+                # passes obs_metrics_interval_s paces extra ticks
+                self.metrics.maybe_snapshot()
         return outs

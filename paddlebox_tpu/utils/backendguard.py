@@ -241,6 +241,15 @@ def ensure_backend(
     # clearly labeled instead of silently degraded or hung.
     STAT_SET("backend.init_wedged", 1)
     err = probe_log[-1]["detail"] if probe_log else "no probe ran"
+    # a wedge is a flight-recorder incident: the bundle (when a dump dir
+    # is configured) captures the probe log and every stat leading up to
+    # the fallback, which is the whole postmortem for "why was this run
+    # on CPU"
+    from paddlebox_tpu.obs.flight_recorder import FLIGHT_RECORDER
+
+    FLIGHT_RECORDER.note_incident(
+        "backend_wedge", {"error": err, "probes": len(probe_log)})
+    FLIGHT_RECORDER.dump("backend_wedge", detail=err)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

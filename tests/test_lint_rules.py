@@ -329,6 +329,22 @@ class TestStatNames:
         """)
         assert rule_findings(res, "MON005") == []
 
+    def test_observe_covered(self, tmp_path):
+        # STAT_OBSERVE mints histogram names into the same enumerable
+        # namespace as the counters — same literal discipline
+        res = lint_source(tmp_path, """
+            from paddlebox_tpu.utils.monitor import STAT_OBSERVE
+
+            def f(name, v):
+                STAT_OBSERVE("serve.latency_ms", v)  # ok
+                STAT_OBSERVE("Bad-Hist", v)
+                STAT_OBSERVE(name, v)
+        """)
+        msgs = [f.message for f in rule_findings(res, "MON005")]
+        assert len(msgs) == 2
+        assert any("Bad-Hist" in m for m in msgs)
+        assert any("string literal" in m for m in msgs)
+
 
 # ---- THR006 ----------------------------------------------------------------
 

@@ -1,0 +1,473 @@
+"""Unified telemetry plane: histograms, metric series, trace context on
+the wire, and the incident flight recorder.
+
+Covers the observability tentpole's acceptance bar end to end, on CPU,
+deterministically:
+
+- log2 histograms: exact aggregates, quantile accuracy against numpy,
+  and thread-safety under concurrent observers;
+- metric series: JSONL rotation + round trip, torn-tail tolerance;
+- PBTX trace-context frames: flag-off frames are byte-compatible with a
+  pre-extension v3 peer, flag-on frames correlate sender and receiver
+  instants under one trace_id, and N ranks' traces merge into a single
+  timeline with one process row per rank;
+- flight recorder: bounded ring, and a REAL mid-collective peer death
+  must leave an ``incident-*.json`` bundle with the last spans, the
+  incident record, and a full stat snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from paddlebox_tpu import config
+from paddlebox_tpu.obs.flight_recorder import FLIGHT_RECORDER, FlightRecorder
+from paddlebox_tpu.obs.histogram import Histogram, merge_all
+from paddlebox_tpu.obs.metrics_writer import (
+    MetricsWriter,
+    read_series,
+    series_files,
+    series_ranks,
+)
+from paddlebox_tpu.obs.trace_context import (
+    EXT_LEN,
+    TraceContext,
+    current_trace,
+    decode_ext,
+    trace_span,
+)
+from paddlebox_tpu.parallel.transport import PeerDeadError, TcpTransport
+from paddlebox_tpu.utils.monitor import (
+    STAT_GET,
+    STAT_HIST,
+    STAT_OBSERVE,
+    all_histograms,
+)
+from paddlebox_tpu.utils.trace import Profiler
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_exact_aggregates(self):
+        h = Histogram()
+        h.observe_many([3.0, 1.0, 4.0, 1.0, 5.0])
+        assert h.count == 5
+        assert h.sum == pytest.approx(14.0)
+        assert h.min == 1.0 and h.max == 5.0
+
+    def test_quantiles_vs_numpy(self):
+        rng = np.random.default_rng(7)
+        data = rng.lognormal(mean=2.0, sigma=1.2, size=20000)
+        h = Histogram()
+        h.observe_many(float(v) for v in data)
+        for q in (0.5, 0.9, 0.99):
+            est = h.quantile(q)
+            ref = float(np.quantile(data, q))
+            # log2 buckets: ~1 bit of relative error on the estimate
+            assert abs(est - ref) / ref < 0.35, (q, est, ref)
+        # extremes are exact, quantiles monotone and clamped
+        qs = h.quantiles((0.0, 0.25, 0.5, 0.75, 0.99, 1.0))
+        assert qs[0] == float(data.min())
+        assert qs[-1] == float(data.max())
+        assert all(a <= b for a, b in zip(qs, qs[1:])), qs
+
+    def test_concurrent_observers(self):
+        h = Histogram()
+        n_threads, per = 8, 5000
+
+        def pound(seed):
+            r = np.random.default_rng(seed)
+            for v in r.uniform(0.1, 100.0, per):
+                h.observe(float(v))
+
+        threads = [
+            threading.Thread(target=pound, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.count == n_threads * per  # no lost updates
+        assert 0.1 <= h.min <= h.max <= 100.0
+        assert h.sum == pytest.approx(h.count * 50.0, rel=0.05)
+
+    def test_nonpositive_and_roundtrip(self):
+        h = Histogram()
+        h.observe_many([0.0, -3.5, 2.0, 8.0])
+        assert h.count == 4 and h.min == -3.5
+        h2 = Histogram.from_dict(json.loads(json.dumps(h.to_dict())))
+        assert h2.summary() == h.summary()
+        merged = merge_all([h, h2, None])
+        assert merged.count == 8 and merged.min == -3.5
+
+    def test_stat_observe_registry(self):
+        STAT_OBSERVE("obs_test.unique_series_ms", 5.0)
+        STAT_OBSERVE("obs_test.unique_series_ms", 9.0)
+        h = STAT_HIST("obs_test.unique_series_ms")
+        assert h is not None and h.count == 2
+        assert "obs_test.unique_series_ms" in all_histograms()
+        assert STAT_HIST("obs_test.never_observed") is None
+
+
+# ---------------------------------------------------------------------------
+# metric series
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsSeries:
+    def test_rotation_and_roundtrip(self, tmp_path):
+        out = str(tmp_path)
+        w = MetricsWriter(out, rank=2, interval_s=0.0, rotate_bytes=2000)
+        STAT_OBSERVE("obs_test.rotate_ms", 1.0)
+        for i in range(10):
+            w.snapshot(f"pass:{i}", extra={"i": i})
+        assert w.rotations >= 1
+        files = series_files(out, rank=2)
+        assert len(files) == w.rotations + 1
+        assert series_ranks(out) == [2]
+        recs = list(read_series(out, rank=2))
+        assert [r["seq"] for r in recs] == list(range(1, 11))
+        assert [r["label"] for r in recs] == [f"pass:{i}" for i in range(10)]
+        assert all(r["rank"] == 2 for r in recs)
+        assert recs[3]["extra"] == {"i": 3}
+        assert "obs_test.rotate_ms" in recs[0]["histograms"]
+
+    def test_deltas_are_per_window(self, tmp_path):
+        from paddlebox_tpu.utils.monitor import STAT_ADD
+
+        w = MetricsWriter(str(tmp_path), rank=0, interval_s=0.0)
+        STAT_ADD("obs_test.window_ctr", 5)
+        r1 = w.snapshot("pass:0")
+        STAT_ADD("obs_test.window_ctr", 3)
+        r2 = w.snapshot("pass:1")
+        assert r1["deltas"]["obs_test.window_ctr"] >= 5
+        assert r2["deltas"]["obs_test.window_ctr"] == 3  # window, not total
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        w = MetricsWriter(str(tmp_path), rank=0, interval_s=0.0)
+        w.snapshot("pass:0")
+        w.snapshot("pass:1")
+        # simulate a crash mid-append: a torn, non-JSON final line
+        # pbox-lint: disable=IO004
+        with open(w.path, "a") as f:
+            f.write('{"t": 1.0, "rank": 0, "seq')
+        before = STAT_GET("obs.metrics_bad_lines")
+        recs = list(read_series(str(tmp_path), rank=0))
+        assert [r["label"] for r in recs] == ["pass:0", "pass:1"]
+        assert STAT_GET("obs.metrics_bad_lines") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# trace context + wire compat
+# ---------------------------------------------------------------------------
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture
+def fast_transport():
+    names = (
+        "transport_heartbeat_s",
+        "transport_backoff_s",
+        "transport_send_retries",
+        "transport_peer_dead_s",
+        "transport_trace_frames",
+        "obs_incident_dir",
+    )
+    prev = {n: config.get_flag(n) for n in names}
+    config.set_flag("transport_heartbeat_s", 0.05)
+    config.set_flag("transport_backoff_s", 0.005)
+    config.set_flag("transport_peer_dead_s", 60.0)
+    yield
+    for n, v in prev.items():
+        config.set_flag(n, v)
+
+
+class TestTraceContext:
+    def test_ext_roundtrip_and_child(self):
+        ctx = TraceContext.new()
+        child = ctx.child()
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id != ctx.span_id
+        raw = child.encode_ext()
+        assert len(raw) == EXT_LEN
+        back = decode_ext(raw)
+        assert back.trace_id_hex == ctx.trace_id_hex
+
+    def test_trace_span_nesting(self):
+        assert current_trace() is None
+        with trace_span("outer"):
+            outer = current_trace()
+            assert outer is not None
+            with trace_span("inner"):
+                inner = current_trace()
+                assert inner.trace_id == outer.trace_id
+                assert inner.span_id != outer.span_id
+            assert current_trace() is outer
+        assert current_trace() is None
+
+    def test_flag_off_frames_match_pre_extension_v3(self, fast_transport):
+        """With ``transport_trace_frames`` off (the default) the sender
+        emits byte-identical frames to a pre-extension v3 peer — even
+        inside an active trace span — so old and new readers interop."""
+        config.set_flag("transport_trace_frames", False)  # the default
+        eps = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        tps = [TcpTransport(r, eps, timeout=30.0) for r in range(2)]
+        sent0 = STAT_GET("transport.trace_frames_sent")
+        recv0 = STAT_GET("transport.trace_frames_recv")
+        try:
+            with trace_span("compat"):
+                tps[0].send(1, "plain", b"payload")
+            assert tps[1].recv("plain", 0, timeout=10.0) == b"payload"
+        finally:
+            for t in tps:
+                t.close()
+        assert STAT_GET("transport.trace_frames_sent") == sent0
+        assert STAT_GET("transport.trace_frames_recv") == recv0
+
+    def test_flag_on_correlates_across_ranks(self, fast_transport, tmp_path):
+        """Flag on: the receiver's transport:deliver instant carries the
+        SAME trace_id as the sender's span, and the two per-rank chrome
+        traces merge into one timeline with one process row per rank and
+        a cross-rank trace_id pair (the acceptance bar)."""
+        import obs_report
+
+        config.set_flag("transport_trace_frames", True)
+        profs = []
+        for r in range(2):
+            p = Profiler(max_events=512)
+            p.enable()
+            p.set_process(r)
+            profs.append(p)
+        eps = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        tps = [
+            TcpTransport(r, eps, timeout=30.0, profiler=profs[r])
+            for r in range(2)
+        ]
+        recv0 = STAT_GET("transport.trace_frames_recv")
+        try:
+            with trace_span("xrank"):
+                want_tid = current_trace().trace_id_hex
+                tps[0].send(1, "traced", b"x")
+            assert tps[1].recv("traced", 0, timeout=10.0) == b"x"
+            # the deliver instant lands just after the inbox notify
+            deadline = time.monotonic() + 5.0
+            while STAT_GET("transport.trace_frames_recv") == recv0:
+                assert time.monotonic() < deadline, "deliver never recorded"
+                time.sleep(0.01)
+        finally:
+            for t in tps:
+                t.close()
+        paths = []
+        for r, p in enumerate(profs):
+            out = str(tmp_path / f"trace-{r}.json")
+            p.export_chrome_trace(out)
+            paths.append(out)
+        with open(paths[0]) as f:
+            send_evs = [
+                e for e in json.load(f)["traceEvents"]
+                if e.get("name") == "transport:send"
+            ]
+        with open(paths[1]) as f:
+            dlv_evs = [
+                e for e in json.load(f)["traceEvents"]
+                if e.get("name") == "transport:deliver"
+            ]
+        assert send_evs and dlv_evs
+        assert send_evs[0]["args"]["trace_id"] == want_tid
+        assert dlv_evs[0]["args"]["trace_id"] == want_tid
+
+        rep = obs_report.merge_traces(paths, str(tmp_path / "merged.json"))
+        assert rep["process_rows"] == [0, 1]  # one row per rank
+        assert rep["cross_rank_trace_ids"] >= 1
+        with open(str(tmp_path / "merged.json")) as f:
+            merged = json.load(f)
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert pids == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_bound_and_dump(self, tmp_path):
+        fr = FlightRecorder(capacity=4)
+        for i in range(10):
+            fr.note_span(f"span{i}", "test", float(i), 1.0, {})
+        fr.note_incident("test_kind", {"detail": 42})
+        snap = fr.snapshot()
+        assert [s["name"] for s in snap["spans"]] == [
+            "span6", "span7", "span8", "span9"
+        ]  # newest survive
+        assert snap["incidents"][0]["kind"] == "test_kind"
+        path = fr.dump("test_reason", detail="why", dir_path=str(tmp_path))
+        assert path is not None and os.path.basename(path).startswith("incident-")
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "test_reason" and bundle["detail"] == "why"
+        assert len(bundle["spans"]) == 4
+        assert "stats" in bundle and "histograms" in bundle
+
+    def test_dump_disabled_without_dir(self):
+        fr = FlightRecorder(capacity=2)
+        prev = config.get_flag("obs_incident_dir")
+        config.set_flag("obs_incident_dir", "")
+        try:
+            assert fr.dump("nowhere") is None
+        finally:
+            config.set_flag("obs_incident_dir", prev)
+
+    def test_recorder_fed_with_tracing_disabled(self):
+        """The always-on property: spans reach the recorder ring even
+        when the profiler is disabled (no chrome trace being kept)."""
+        from paddlebox_tpu.utils.trace import Profiler
+
+        p = Profiler(max_events=16)
+        assert not p.enabled
+        with p.record_event("invisible_to_trace", category="test"):
+            pass
+        spans = FLIGHT_RECORDER.snapshot()["spans"]
+        assert any(s["name"] == "invisible_to_trace" for s in spans)
+        assert len(p._events) == 0  # nothing in the trace ring itself
+
+    def test_peer_death_leaves_incident_bundle(self, fast_transport, tmp_path):
+        """The acceptance bar: a rank dying mid-collective must leave an
+        ``incident-<ts>.json`` with the last spans, the stat snapshot,
+        and the peer_dead reason — written by the _take_all dump hook,
+        with no tracing enabled anywhere."""
+        inc_dir = str(tmp_path / "incidents")
+        config.set_flag("transport_peer_dead_s", 0.6)
+        config.set_flag("obs_incident_dir", inc_dir)
+        n = 3
+        eps = [f"127.0.0.1:{p}" for p in _free_ports(n)]
+        tps = [TcpTransport(r, eps, timeout=30.0) for r in range(n)]
+        try:
+            # mid-pass shape: real frames flow first, then rank 2 dies
+            for dst in (1, 2):
+                tps[0].send(dst, "warm", b"w")
+                assert tps[dst].recv("warm", 0, timeout=10.0) == b"w"
+            deadline = time.monotonic() + 5.0
+            while any(tps[0].peer_status(r) != "alive" for r in (1, 2)):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            tps[2].close()  # dies: no more heartbeats
+            with pytest.raises(PeerDeadError) as ei:
+                tps[0].barrier("dead-rank-obs", timeout=30.0)
+            assert ei.value.dead == [2]
+        finally:
+            for t in tps:
+                t.close()
+        bundles = sorted(
+            f for f in os.listdir(inc_dir) if f.startswith("incident-")
+        )
+        assert bundles, "peer death left no incident bundle"
+        with open(os.path.join(inc_dir, bundles[-1])) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "peer_dead"
+        assert "dead rank" in bundle["detail"] or "rank(s)" in bundle["detail"]
+        assert bundle["stats"], "bundle lost the stat snapshot"
+        # the warm-up transfers above were recorded by the always-on ring
+        assert bundle["spans"], "bundle lost the recent spans"
+
+
+# ---------------------------------------------------------------------------
+# golden-diff: soak report keys unchanged by the histogram port
+# ---------------------------------------------------------------------------
+
+
+class TestSoakReportGolden:
+    def test_serve_latency_percentile_keys(self):
+        """ScoreServer.latency_percentiles moved onto the shared
+        histogram; the soak JSON keys must not have changed."""
+        from paddlebox_tpu.serve.server import ScoreServer
+
+        srv = ScoreServer(follower=None, scorer=None, schema=None)
+        assert srv.latency_percentiles() == {"n": 0}
+        for ms in (4.0, 8.0, 15.0, 16.0, 23.0, 42.0):
+            srv.latency_hist.observe(ms)
+        rep = srv.latency_percentiles()
+        assert set(rep) == {"n", "p50_ms", "p99_ms", "max_ms"}  # golden
+        assert rep["n"] == 6
+        assert 0 < rep["p50_ms"] <= rep["p99_ms"] <= rep["max_ms"] == 42.0
+
+    def test_scale_soak_zipf_pass_keys(self, tmp_path):
+        """run_zipf_policy per-pass entries keep their exact key set; the
+        histogram port only ADDS the pass_s_dist summary."""
+        from paddlebox_tpu.utils import native
+
+        if not native.available():
+            pytest.skip("zipf soak needs the native table")
+        import scale_soak
+
+        conf = {
+            "keys": 2000, "draws": 1000, "passes": 2, "mem_cap_rows": 200,
+            "zipf_a": 1.2, "decay": 0.98, "pin_show": 0.0, "admit_show": 0.0,
+            "admit_rate": 0.0, "n_shards": 4, "seed": 0, "embedx_dim": 4,
+            "digest": False, "workdir": str(tmp_path),
+        }
+        out = scale_soak.run_zipf_policy("fifo", conf)
+        golden = {
+            "pass", "pass_s", "uniq_keys", "promotes", "spilled",
+            "admitted_disk_first", "spill_hit_rate", "mem_rows", "disk_rows",
+        }
+        assert all(set(p) == golden for p in out["passes"])
+        assert out["pass_s_dist"]["count"] == conf["passes"]
+        assert out["pass_s_dist"]["max"] >= out["pass_s_dist"]["p50"] > 0
+
+
+# ---------------------------------------------------------------------------
+# obs_report CLI pieces
+# ---------------------------------------------------------------------------
+
+
+class TestObsReport:
+    def test_pass_table_and_slo(self, tmp_path):
+        import obs_report
+        from paddlebox_tpu.utils.monitor import STAT_ADD
+
+        w = MetricsWriter(str(tmp_path), rank=0, interval_s=0.0)
+        for i in range(3):
+            STAT_ADD("obs_test.report_rows", 100 + i)
+            STAT_OBSERVE("obs_test.report_ms", 10.0 * (i + 1))
+            w.snapshot(f"pass:{i}")
+        records = obs_report.load_series(str(tmp_path))
+        assert len(records) == 3
+        table = obs_report.render_pass_table(records)
+        assert "pass:0" in table and "pass:2" in table
+        hists = obs_report.summarize_histograms(records)
+        assert "obs_test.report_ms" in hists
+        verdicts = obs_report.slo_verdicts(hists, [
+            "obs_test.report_ms:p99<=1000",
+            "obs_test.report_ms:p50>=1000000",
+            "obs_test.missing_ms:p50<=1",
+        ])
+        assert [v["verdict"] for v in verdicts] == ["PASS", "FAIL", "NODATA"]
+
+    def test_selfcheck_green(self):
+        import obs_report
+
+        assert obs_report.selfcheck() == 0

@@ -156,12 +156,60 @@ def test_profiler_chrome_trace(tmp_path):
     PROFILER.disable()
     out = str(tmp_path / "trace.json")
     n = PROFILER.export_chrome_trace(out)
-    assert n == 2
+    assert n == 2  # data events only; metadata rows don't count
     with open(out) as f:
         data = json.load(f)
-    names = {e["name"] for e in data["traceEvents"]}
-    assert names == {"pack_batch", "train_step"}
-    assert all(e["ph"] == "X" for e in data["traceEvents"])
+    spans = [e for e in data["traceEvents"] if e["ph"] != "M"]
+    meta = [e for e in data["traceEvents"] if e["ph"] == "M"]
+    assert {e["name"] for e in spans} == {"pack_batch", "train_step"}
+    assert all(e["ph"] == "X" for e in spans)
+    # chrome metadata rows: a labeled process + the recording thread
+    meta_names = {m["name"] for m in meta}
+    assert {"process_name", "thread_name"} <= meta_names
+    # stable small tids, consistent between span and its thread_name row
+    tids = {e["tid"] for e in spans}
+    assert tids <= {m["tid"] for m in meta if m["name"] == "thread_name"}
+    assert all(isinstance(t, int) and 0 < t < 1000 for t in tids)
+    PROFILER.reset()
+
+
+def test_profiler_ring_bounds_and_drop_counter(tmp_path):
+    from paddlebox_tpu.utils.monitor import STAT_GET
+    from paddlebox_tpu.utils.trace import Profiler
+
+    before = STAT_GET("trace.dropped_events")
+    p = Profiler(max_events=4)
+    p.enable()
+    for i in range(10):
+        with p.record_event(f"span{i}"):
+            pass
+    out = str(tmp_path / "ring.json")
+    assert p.export_chrome_trace(out) == 4
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    names = [e["name"] for e in events if e["ph"] == "X"]
+    # ring keeps the NEWEST spans, drops the oldest
+    assert names == ["span6", "span7", "span8", "span9"]
+    assert p.dropped_events == 6
+    assert STAT_GET("trace.dropped_events") - before == 6
+
+
+def test_profiler_set_process_stamps_rank(tmp_path):
+    from paddlebox_tpu.utils.trace import Profiler
+
+    p = Profiler()
+    p.enable()
+    with p.record_event("before_label"):
+        pass
+    p.set_process(3)  # after recording: export restamps coherently
+    out = str(tmp_path / "rank.json")
+    p.export_chrome_trace(out)
+    with open(out) as f:
+        doc = json.load(f)
+    assert all(e["pid"] == 3 for e in doc["traceEvents"])
+    pname = [e for e in doc["traceEvents"] if e["name"] == "process_name"]
+    assert pname and pname[0]["args"]["name"] == "rank3"
+    assert doc["otherData"]["rank"] == 3
 
 
 def test_fs_open_retry_until_available(tmp_path):

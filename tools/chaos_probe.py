@@ -473,14 +473,17 @@ def _dist_rank_records(rank, rows, seed, schema, pass_idx):
     return ColumnarRecords.from_records(recs, schema)
 
 
-def _dist_soak_once(n_ranks, passes, rows, seed, rules):
+def _dist_soak_once(n_ranks, passes, rows, seed, rules, trace_dir=None):
     """One N-rank in-process soak under the given fault rules. Returns the
-    per-rank observable digest the equality check compares."""
+    per-rank observable digest the equality check compares. With
+    ``trace_dir`` each rank records into its OWN Profiler (pid=rank) and
+    exports ``trace-<rank>.json`` there — the merge-traces input."""
     import threading
 
     from paddlebox_tpu.data import SlotInfo, SlotSchema
     from paddlebox_tpu.data.dataset import shuffle_route_store
     from paddlebox_tpu.data.record_store import ColumnarRecords
+    from paddlebox_tpu.obs.trace_context import trace_span
     from paddlebox_tpu.parallel.transport import TcpShuffleRouter, TcpTransport
     from paddlebox_tpu.table import (
         HostSparseTable,
@@ -489,6 +492,7 @@ def _dist_soak_once(n_ranks, passes, rows, seed, rules):
     )
     from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
     from paddlebox_tpu.utils.faultinject import inject
+    from paddlebox_tpu.utils.trace import Profiler
 
     schema = SlotSchema(
         [SlotInfo("label", type="float", dense=True, dim=1)]
@@ -496,8 +500,22 @@ def _dist_soak_once(n_ranks, passes, rows, seed, rules):
         label_slot="label",
         parse_ins_id=True,
     )
+    profilers = None
+    if trace_dir is not None:
+        profilers = []
+        for r in range(n_ranks):
+            pr = Profiler()
+            pr.enable()
+            pr.set_process(r)
+            profilers.append(pr)
     eps = [f"127.0.0.1:{p}" for p in _dist_free_ports(n_ranks)]
-    tps = [TcpTransport(r, eps, timeout=60.0) for r in range(n_ranks)]
+    tps = [
+        TcpTransport(
+            r, eps, timeout=60.0,
+            profiler=profilers[r] if profilers else None,
+        )
+        for r in range(n_ranks)
+    ]
     routers = [TcpShuffleRouter(t) for t in tps]
     layout = ValueLayout(embedx_dim=4)
     tables = [
@@ -514,30 +532,34 @@ def _dist_soak_once(n_ranks, passes, rows, seed, rules):
         t = tps[rank]
         digest = []
         for p in range(passes):
-            store = _dist_rank_records(rank, rows, seed, schema, p)
-            dest = shuffle_route_store(store, n_ranks, "ins_id", seed=seed)
-            routers[rank].exchange(
-                rank,
-                [store.select(np.nonzero(dest == d)[0])
-                 for d in range(n_ranks)],
-            )
-            got = [c for c in routers[rank].collect(rank) if len(c)]
-            mine = ColumnarRecords.concat(got)
-            ws = DistributedWorkingSet(t, n_ranks, pass_id=p)
-            ws.add_keys(mine.u64_values)
-            dev = ws.finalize(tables[rank], round_to=8)
-            dev = dev * 1.01 + 0.25  # deterministic "training"
-            ws.writeback(dev)
-            rows_of = ws.lookup(mine.u64_values)
-            digest.append(
-                dict(
-                    n_records=len(mine),
-                    capacity=ws.capacity,
-                    rows=rows_of,
-                    sorted_keys=ws.sorted_keys,
+            # the span context rides outbound PBTX frames (when
+            # transport_trace_frames is on), so every rank's deliver
+            # instants share this rank's trace_id — the merge evidence
+            with trace_span(f"pass-{p}"):
+                store = _dist_rank_records(rank, rows, seed, schema, p)
+                dest = shuffle_route_store(store, n_ranks, "ins_id", seed=seed)
+                routers[rank].exchange(
+                    rank,
+                    [store.select(np.nonzero(dest == d)[0])
+                     for d in range(n_ranks)],
                 )
-            )
-            t.barrier(f"probe-pass-{p}")
+                got = [c for c in routers[rank].collect(rank) if len(c)]
+                mine = ColumnarRecords.concat(got)
+                ws = DistributedWorkingSet(t, n_ranks, pass_id=p)
+                ws.add_keys(mine.u64_values)
+                dev = ws.finalize(tables[rank], round_to=8)
+                dev = dev * 1.01 + 0.25  # deterministic "training"
+                ws.writeback(dev)
+                rows_of = ws.lookup(mine.u64_values)
+                digest.append(
+                    dict(
+                        n_records=len(mine),
+                        capacity=ws.capacity,
+                        rows=rows_of,
+                        sorted_keys=ws.sorted_keys,
+                    )
+                )
+                t.barrier(f"probe-pass-{p}")
         keys = np.sort(tables[rank].keys())
         return dict(
             digest=digest,
@@ -567,6 +589,9 @@ def _dist_soak_once(n_ranks, passes, rows, seed, rules):
             t.close()
     if errors:
         raise errors[0][1]
+    if profilers is not None:
+        for r, pr in enumerate(profilers):
+            pr.export_chrome_trace(os.path.join(trace_dir, f"trace-{r}.json"))
     return results, plan, time.perf_counter() - t0
 
 
@@ -610,10 +635,52 @@ def _digests_equal(a, b, n):
     return bool(equal)
 
 
+def _flight_recorder_smoke(inc_dir):
+    """Provoke a REAL mid-collective peer death and check the flight
+    recorder left an incident bundle: rank 1 stops beating, rank 0's
+    barrier must raise PeerDeadError, and the dump hook on _take_all must
+    land exactly one ``incident-*.json`` in ``inc_dir``."""
+    from paddlebox_tpu import config
+    from paddlebox_tpu.parallel.transport import PeerDeadError, TcpTransport
+
+    saved = {
+        n: config.get_flag(n)
+        for n in ("transport_peer_dead_s", "obs_incident_dir")
+    }
+    config.set_flag("transport_peer_dead_s", 0.6)
+    config.set_flag("obs_incident_dir", inc_dir)
+    eps = [f"127.0.0.1:{p}" for p in _dist_free_ports(2)]
+    tps = [TcpTransport(r, eps, timeout=30.0) for r in range(2)]
+    raised = False
+    try:
+        tps[0].send(1, "fr-smoke", b"x")
+        assert tps[1].recv("fr-smoke", 0, timeout=5.0) == b"x"
+        deadline = time.monotonic() + 5.0
+        while tps[0].peer_status(1) != "alive":
+            assert time.monotonic() < deadline, "peers never connected"
+            time.sleep(0.01)
+        tps[1].close()  # rank 1 dies mid-run: no more heartbeats
+        try:
+            tps[0].barrier("fr-smoke-dead", timeout=30.0)
+        except PeerDeadError:
+            raised = True  # expected: detector names the dead rank
+    finally:
+        for t in tps:
+            t.close()
+        for name, v in saved.items():
+            config.set_flag(name, v)
+    bundles = sorted(
+        f for f in os.listdir(inc_dir) if f.startswith("incident-")
+    ) if os.path.isdir(inc_dir) else []
+    return raised, bundles
+
+
 def run_distributed(args):
     from paddlebox_tpu import config
     from paddlebox_tpu.utils.faultinject import fail_nth, fail_prob
     from paddlebox_tpu.utils.monitor import STAT_GET
+
+    import obs_report
 
     config.set_flag("transport_heartbeat_s", 0.05)
     config.set_flag("transport_backoff_s", 0.005)
@@ -630,7 +697,10 @@ def run_distributed(args):
 
     # soak 2: faulted, codec on — send/recv flakes plus decode faults at
     # the new wire.host_decode site (a corrupt-after-CRC inflate kills the
-    # connection; resync must replay exactly-once)
+    # connection; resync must replay exactly-once). This soak also runs
+    # with per-rank profilers AND the PBTX trace-context frame extension
+    # on: tracing must survive the fault schedule, and the exported
+    # traces must merge into one timeline with cross-rank trace_id pairs.
     rules = [
         fail_prob("transport.send", args.send_flake_prob,
                   seed=args.seed + 1, times=6),
@@ -639,9 +709,19 @@ def run_distributed(args):
         fail_nth("wire.host_decode", 2 + args.seed % 3, times=1),
         fail_nth("wire.host_decode", 9 + args.seed % 5, times=1),
     ]
-    faulted, plan, wall_i = _dist_soak_once(
-        n, args.passes, args.rows, args.seed, rules
-    )
+    with tempfile.TemporaryDirectory(prefix="chaos-traces-") as trace_dir:
+        config.set_flag("transport_trace_frames", True)
+        try:
+            faulted, plan, wall_i = _dist_soak_once(
+                n, args.passes, args.rows, args.seed, rules,
+                trace_dir=trace_dir,
+            )
+        finally:
+            config.set_flag("transport_trace_frames", False)
+        merge = obs_report.merge_traces(
+            [os.path.join(trace_dir, f"trace-{r}.json") for r in range(n)],
+            os.path.join(trace_dir, "merged.json"),
+        )
 
     # soak 3: clean, raw ablation — same results, more bytes; the
     # cross-soak host_bytes_sent ratio is the measured compression win
@@ -655,8 +735,17 @@ def run_distributed(args):
         config.set_flag("host_wire_codec", True)
     raw_wire = _wire_delta(w0, _wire_snapshot())
 
+    # flight-recorder smoke: real peer death -> incident bundle on disk
+    with tempfile.TemporaryDirectory() as inc_dir:
+        fr_raised, fr_bundles = _flight_recorder_smoke(inc_dir)
+
     equal = _digests_equal(clean, faulted, n)
     equal_raw = _digests_equal(clean, raw, n)
+    trace_ok = (
+        len(merge["process_rows"]) == n
+        and merge["cross_rank_trace_ids"] >= 1
+    )
+    fr_ok = fr_raised and len(fr_bundles) >= 1
     report = {
         "mode": "distributed",
         "ranks": n,
@@ -703,6 +792,20 @@ def run_distributed(args):
                 codec_wire["wire.host_bytes_sent"],
             ),
         },
+        "trace_merge": {
+            "process_rows": merge["process_rows"],
+            "events": merge["events"],
+            "trace_ids": merge["trace_ids"],
+            "cross_rank_trace_ids": merge["cross_rank_trace_ids"],
+            "trace_frames_sent": int(STAT_GET("transport.trace_frames_sent")),
+            "trace_frames_recv": int(STAT_GET("transport.trace_frames_recv")),
+            "ok": trace_ok,
+        },
+        "flight_recorder": {
+            "peer_dead_raised": fr_raised,
+            "incident_bundles": len(fr_bundles),
+            "ok": fr_ok,
+        },
         "bitwise_equal_to_clean": equal,
         "bitwise_equal_raw_vs_codec": equal_raw,
         "wall_clean_s": round(wall_c, 2),
@@ -710,7 +813,7 @@ def run_distributed(args):
         "wall_raw_s": round(wall_r, 2),
     }
     print(json.dumps(report, indent=None if args.json else 2))
-    return 0 if equal and equal_raw else 1
+    return 0 if equal and equal_raw and trace_ok and fr_ok else 1
 
 
 def main(argv=None):

@@ -160,6 +160,14 @@ def worker(rank: int, conf: dict) -> None:
     out["peak_rss_gb"] = round(
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20), 2
     )
+    # registry distributions accumulated during the soak (push shard
+    # times, wire frame sizes, ...) via the shared histogram API
+    from paddlebox_tpu.utils.monitor import all_histograms
+
+    out["distributions"] = {
+        name: h.summary((0.5, 0.99))
+        for name, h in sorted(all_histograms().items())
+    }
     tp.barrier("soak-done")
     tp.close()
     from paddlebox_tpu.utils.fs import atomic_write
@@ -219,11 +227,13 @@ def run_zipf_policy(policy: str, conf: dict) -> dict:
     import numpy as np
 
     from paddlebox_tpu import config
+    from paddlebox_tpu.obs.histogram import Histogram
     from paddlebox_tpu.table import (
         HostSparseTable,
         SparseOptimizerConfig,
         ValueLayout,
     )
+    from paddlebox_tpu.utils.monitor import STAT_OBSERVE
 
     layout = ValueLayout(embedx_dim=conf["embedx_dim"])
     opt = SparseOptimizerConfig(
@@ -238,6 +248,7 @@ def run_zipf_policy(policy: str, conf: dict) -> dict:
         for n in ("spill_policy", "spill_pin_show", "spill_admit_show")
     }
     out = {"policy": policy, "passes": []}
+    pass_hist = Histogram()  # per-pass wall-time distribution (shared API)
     try:
         config.set_flag("spill_policy", policy)
         config.set_flag("spill_pin_show", conf["pin_show"])
@@ -267,6 +278,8 @@ def run_zipf_policy(policy: str, conf: dict) -> dict:
                 )
             table.maybe_spill()
             pass_s = time.perf_counter() - t0
+            pass_hist.observe(pass_s)
+            STAT_OBSERVE("soak.pass_s", pass_s)
             st = table.tier_stats()
             promotes = st["promoted_total"] - prev["promoted_total"]
             spilled = st["spilled_total"] - prev["spilled_total"]
@@ -287,6 +300,10 @@ def run_zipf_policy(policy: str, conf: dict) -> dict:
                 "disk_rows": int(st["disk_rows"]),
             })
         out["wall_s"] = round(time.perf_counter() - t_all, 3)
+        # p50/p99 of the degradation curve via the shared histogram (the
+        # hand-rolled percentile math this tool used to grow lives in
+        # obs/histogram.py now); per-pass exact values stay in "passes"
+        out["pass_s_dist"] = pass_hist.summary((0.5, 0.99))
         st = table.tier_stats()
         per_shard = st.pop("per_shard")
         out["tier_stats"] = {k: int(v) for k, v in st.items()}

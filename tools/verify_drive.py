@@ -196,12 +196,36 @@ print(f"[8] serve soak ok: {report['requests']} req @ {report['achieved_qps']} q
       f"p50={report['latency']['p50_ms']:.1f}ms p99={report['latency']['p99_ms']:.1f}ms, "
       f"parity bitwise at {report['parity']['checked']} deltas")
 
-# --- 9. static gates: lint + native sanitize ----------------------------
-# the same commands CI runs, end to end: whole-repo lint (default roots,
-# empty baseline) and the ASan+UBSan quick replay of the native tier
+# --- 8b. obs plane: selfcheck + flight-recorder smoke -------------------
+# obs_report --selfcheck smokes the whole telemetry read/write path
+# (histogram quantiles, metric-series round trip, incident bundle,
+# 2-rank trace merge with a shared trace_id) in a subprocess; then an
+# in-process flight-recorder dump proves THIS process's ring has the
+# spans the sections above recorded.
 import subprocess
 
 _here = os.path.dirname(os.path.abspath(__file__))
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "obs_report.py"), "--selfcheck"],
+    capture_output=True, text=True, timeout=300)
+assert r.returncode == 0, f"obs selfcheck red:\n{r.stdout}{r.stderr}"
+from paddlebox_tpu.obs.flight_recorder import FLIGHT_RECORDER
+import json as _json
+
+_inc_dir = os.path.join(tmp, "incidents")
+FLIGHT_RECORDER.note_incident("verify_drive_smoke", {"section": "8b"})
+_bundle_path = FLIGHT_RECORDER.dump("verify_drive_smoke", dir_path=_inc_dir)
+assert _bundle_path is not None and os.path.exists(_bundle_path)
+with open(_bundle_path) as _f:
+    _bundle = _json.load(_f)
+assert any(i["kind"] == "verify_drive_smoke" for i in _bundle["incidents"])
+assert _bundle["spans"], "flight recorder saw no spans from the run above"
+print(f"[8b] obs plane ok: selfcheck green, incident bundle has "
+      f"{len(_bundle['spans'])} span(s) + {len(_bundle['incidents'])} incident(s)")
+
+# --- 9. static gates: lint + native sanitize ----------------------------
+# the same commands CI runs, end to end: whole-repo lint (default roots,
+# empty baseline) and the ASan+UBSan quick replay of the native tier
 r = subprocess.run([sys.executable, os.path.join(_here, "run_lint.py")],
                    capture_output=True, text=True, timeout=600)
 assert r.returncode == 0, f"lint gate red:\n{r.stdout}{r.stderr}"
