@@ -259,6 +259,10 @@ class BoxPSDataset:
         # exchange tags so a retried pass never consumes frames from the
         # aborted attempt (see TcpTransport.discard_epochs_below)
         self.pass_epoch = 0
+        # explicit key-ownership map (parallel/membership.OwnershipMap),
+        # installed/replaced by the elastic supervisor on membership or
+        # placement changes; None = even split over all transport ranks
+        self.ownership = None
         self.current_phase = 1  # 1 join, 0 update (data_set.h:291)
         self._filelist: List[str] = []
         # pass data lives EITHER columnar (store + shuffle order — the fast
@@ -843,7 +847,10 @@ class BoxPSDataset:
         diverge."""
         if self.transport is not None and self.transport.n_ranks > 1:
             # multi-host: host-sharded table ownership + key exchange;
-            # n_mesh_shards is the GLOBAL mesh shard count
+            # n_mesh_shards is the GLOBAL mesh shard count. ``ownership``
+            # (an OwnershipMap, set by the elastic supervisor on membership
+            # or placement changes) pins the key routing; None keeps the
+            # default even split over all ranks.
             from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
 
             return DistributedWorkingSet(
@@ -851,6 +858,7 @@ class BoxPSDataset:
                 self.n_mesh_shards,
                 pass_id=self.pass_id,
                 epoch=self.pass_epoch,
+                ownership=getattr(self, "ownership", None),
             )
         return PassWorkingSet(n_mesh_shards=self.n_mesh_shards)
 
@@ -1277,7 +1285,8 @@ class BoxPSDataset:
                 # key->shard->device pinning is pass-stable (writeback is
                 # host-local for the same reason, dist_ws.py:20-22)
                 carrier = MultiHostCarrier(
-                    trained_table, ws.owned_shard_keys, table.layout
+                    trained_table, ws.owned_shard_keys, table.layout,
+                    ownership_epoch=ws.ownership.epoch,
                 )
         if carrier is not None:
             table.add_pending_carrier(carrier)

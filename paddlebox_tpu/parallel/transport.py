@@ -229,6 +229,9 @@ class TcpTransport:
         self._delivered: Dict[int, int] = {}  # guarded-by: _cond
         self._last_seen: Dict[int, float] = {}  # guarded-by: _cond
         self._epoch_min = 0  # guarded-by: _cond
+        # ranks the membership layer confirmed dead: collectives skip them
+        # (send nothing, wait on nothing, b"" placeholder in results)
+        self._dead: set = set()  # guarded-by: _cond
         self._send_locks: Dict[int, threading.Lock] = {
             r: threading.Lock() for r in range(self.n_ranks)
         }
@@ -456,8 +459,13 @@ class TcpTransport:
                         src
                         for _tag, src in missing
                         if src != self.rank
-                        and src in self._last_seen
-                        and now - self._last_seen[src] >= dead_s
+                        and (
+                            src in self._dead  # membership-confirmed
+                            or (
+                                src in self._last_seen
+                                and now - self._last_seen[src] >= dead_s
+                            )
+                        )
                     }
                 )
                 if dead:
@@ -516,8 +524,39 @@ class TcpTransport:
                 r
                 for r in range(self.n_ranks)
                 if r != self.rank
-                and self._peer_status_locked(r, now) == "dead"
+                and (
+                    r in self._dead
+                    or self._peer_status_locked(r, now) == "dead"
+                )
             ]
+
+    # ---- membership ------------------------------------------------------
+
+    def mark_dead(self, ranks) -> None:
+        """Confirm ranks dead at the membership layer: collectives stop
+        sending to / waiting on them (their result slots become b""),
+        direct sends fail fast, heartbeats stop. Irreversible for the
+        transport's lifetime — a recovered host rejoins with a fresh
+        transport, not a resurrection."""
+        with self._cond:
+            for r in ranks:
+                r = int(r)
+                if r != self.rank:
+                    self._dead.add(r)
+            # wake collectives blocked on a now-dead rank immediately
+            self._cond.notify_all()
+
+    def live_ranks(self) -> List[int]:
+        """Ranks not membership-confirmed dead (always includes self).
+        Detector state (suspect/dead by silence) does NOT remove a rank
+        here — only an explicit mark_dead does, so collectives keep their
+        fail-loudly semantics until membership actually changes."""
+        with self._cond:
+            return [r for r in range(self.n_ranks) if r not in self._dead]
+
+    def is_marked_dead(self, rank: int) -> bool:
+        with self._cond:
+            return int(rank) in self._dead
 
     # ---- epoch discard ---------------------------------------------------
 
@@ -666,6 +705,16 @@ class TcpTransport:
 
     def send(self, dst: int, tag: str, payload: bytes) -> None:
         tb = tag.encode()
+        with self._cond:
+            dst_dead = dst in self._dead
+        if dst_dead:
+            # fail fast instead of burning the retry budget against a rank
+            # membership already buried
+            raise PeerDeadError(
+                f"rank {self.rank}: send to rank {dst} (tag={tag!r}) "
+                "refused — rank is membership-confirmed dead",
+                [dst],
+            )
         if dst == self.rank:
             stale = False
             with self._cond:
@@ -731,8 +780,10 @@ class TcpTransport:
         while not self._hb_stop.wait(interval):
             if self._closed:
                 return
+            with self._cond:
+                dead = set(self._dead)
             for dst in range(self.n_ranks):
-                if dst == self.rank:
+                if dst == self.rank or dst in dead:
                     continue
                 try:
                     fire("transport.heartbeat")
@@ -766,16 +817,36 @@ class TcpTransport:
     def alltoall(
         self, payloads: List[bytes], tag: str, timeout: Optional[float] = None
     ) -> List[bytes]:
-        """payloads[d] goes to rank d; returns what every rank sent here."""
+        """payloads[d] goes to rank d; returns what every rank sent here.
+
+        Membership-aware: ranks marked dead (``mark_dead``) are skipped on
+        both sides — nothing is sent to them, nothing awaited from them,
+        and their result slot is ``b""``. Callers that unpack typed
+        payloads must skip non-live slots (see ``allreduce_max``)."""
         if len(payloads) != self.n_ranks:
             raise ValueError(f"need {self.n_ranks} payloads, got {len(payloads)}")
-        for dst in range(self.n_ranks):
-            self.send(dst, tag, payloads[dst])
-        return self._take_all(
-            [(tag, src) for src in range(self.n_ranks)],
+        live = self.live_ranks()
+        for dst in live:
+            try:
+                self.send(dst, tag, payloads[dst])
+            except PeerDeadError:
+                raise
+            except (ConnectionError, OSError):
+                # the frame was retained before the first wire attempt, so
+                # a transient drop heals via the heartbeat reconnect resync;
+                # a real death fails the wait below with the detector's
+                # typed PeerDeadError naming the rank — strictly more
+                # information than a raw ConnectionError here
+                STAT_ADD("transport.collective_send_deferred")
+        got = self._take_all(
+            [(tag, src) for src in live],
             f"alltoall(tag={tag!r})",
             timeout,
         )
+        if len(live) == self.n_ranks:
+            return got
+        by_src = dict(zip(live, got))
+        return [by_src.get(src, b"") for src in range(self.n_ranks)]
 
     def allgather(
         self, payload: bytes, tag: str, timeout: Optional[float] = None
@@ -786,7 +857,8 @@ class TcpTransport:
         self, value: int, tag: str, timeout: Optional[float] = None
     ) -> int:
         vals = self.allgather(struct.pack("<q", int(value)), tag, timeout=timeout)
-        return max(struct.unpack("<q", v)[0] for v in vals)
+        # dead ranks contribute b"" placeholder slots, not votes
+        return max(struct.unpack("<q", v)[0] for v in vals if len(v) == 8)
 
     def barrier(self, tag: str, timeout: Optional[float] = None) -> None:
         self.allgather(b"", "barrier:" + tag, timeout=timeout)

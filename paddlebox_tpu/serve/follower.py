@@ -113,9 +113,15 @@ class Follower:
         wm = read_watermark(self.root)
         if wm is None:
             return False
+        # validate_watermark also rejects mixed-epoch chains (a base and
+        # deltas spanning an elastic membership change) with the typed
+        # MembershipEpochError — the trainer re-anchors on a fresh base at
+        # every ownership-epoch flip, so a mixed chain is always a publish
+        # bug, never a state the follower should try to apply
         validate_watermark(wm)
         date, idx = wm["date"], int(wm["delta_idx"])
         base_crc = wm["base"].get("manifest_crc")
+        epoch = int(wm.get("ownership_epoch", 0))
 
         applied = self._applied
         same_lineage = (
@@ -123,6 +129,20 @@ class Follower:
             and applied["date"] == date
             and applied["base_crc"] == base_crc
         )
+        if (
+            applied is not None
+            and applied["date"] == date
+            and not same_lineage
+            and epoch != applied.get("ownership_epoch", 0)
+        ):
+            # trainer rank set changed mid-day: the re-anchored base under
+            # the new ownership epoch supersedes the old chain wholesale
+            STAT_ADD("serve.epoch_reanchors")
+            logger.info(
+                "follower: ownership epoch %s -> %s mid-day (%s) — "
+                "reloading from the re-anchored base",
+                applied.get("ownership_epoch", 0), epoch, date,
+            )
         if same_lineage and idx < applied["delta_idx"]:
             raise DeltaLineageError(
                 f"watermark rewound: serving {applied['date']}/delta_idx "
@@ -221,8 +241,10 @@ class Follower:
             "date": wm["date"],
             "delta_idx": delta_idx,
             "base_crc": base_crc,
+            "ownership_epoch": int(wm.get("ownership_epoch", 0)),
         }
         STAT_SET("serve.applied_delta_idx", delta_idx)
+        STAT_SET("serve.ownership_epoch", int(wm.get("ownership_epoch", 0)))
         STAT_ADD("serve.applies")
 
     def _load_dense(self, wm: Dict[str, Any]) -> None:

@@ -273,13 +273,19 @@ class MultiHostCarrier:
     exactly like a single-host carrier.
     """
 
-    def __init__(self, global_table, owned_shard_keys, layout):
+    def __init__(self, global_table, owned_shard_keys, layout,
+                 ownership_epoch: int = 0):
         # global_table: jax [ns, cap, W] sharded on axis 0 over the mesh;
         # only this process's addressable shard blocks are touched.
         # owned_shard_keys: the ending pass's per-local-shard key lists
         # (DistributedWorkingSet.owned_shard_keys) — snapshotted into
         # per-device _ShardViews; the working set itself is NOT retained.
+        # ownership_epoch pins the shard->host placement this snapshot was
+        # taken under: a later finalize under a DIFFERENT epoch must not
+        # splice these blocks (the ranges re-homed) — it flushes instead
+        # (DistributedWorkingSet.finalize checks the pin).
         self.layout = layout
+        self.ownership_epoch = int(ownership_epoch)
         self.sharding = global_table.sharding
         self.ns, self.cap, self.width = global_table.shape
         shards = sorted(

@@ -38,6 +38,7 @@ import numpy as np
 
 from paddlebox_tpu import config
 from paddlebox_tpu.ops import host_codec
+from paddlebox_tpu.parallel.membership import OwnershipMap
 from paddlebox_tpu.table.sparse_table import (
     HostSparseTable,
     key_to_shard,
@@ -51,17 +52,31 @@ class DistributedWorkingSet:
     PassWorkingSet (n_mesh_shards / capacity / padding_row / lookup)."""
 
     def __init__(
-        self, transport, n_mesh_shards: int, pass_id: int = 0, epoch: int = 0
+        self, transport, n_mesh_shards: int, pass_id: int = 0, epoch: int = 0,
+        ownership: Optional[OwnershipMap] = None,
     ):
         self.transport = transport
         self.n_mesh_shards = n_mesh_shards
         n_hosts = transport.n_ranks
-        if n_mesh_shards % n_hosts:
+        # ownership is an explicit versioned map (largest-remainder
+        # contiguous ranges), not rank arithmetic: uneven splits are fine
+        # and the live set may be smaller than the endpoint list after a
+        # membership shrink. Default reproduces the historical even split.
+        if ownership is None:
+            ownership = OwnershipMap.even(n_mesh_shards, n_hosts)
+        if ownership.n_mesh_shards != n_mesh_shards:
             raise ValueError(
-                f"{n_mesh_shards} mesh shards not divisible by {n_hosts} hosts"
+                f"ownership map covers {ownership.n_mesh_shards} shards, "
+                f"pass has {n_mesh_shards}"
             )
-        self.shards_per_host = n_mesh_shards // n_hosts
-        self.shard_lo = transport.rank * self.shards_per_host
+        if not ownership.is_live(transport.rank):
+            raise ValueError(
+                f"rank {transport.rank} is not live in {ownership!r}"
+            )
+        self.ownership = ownership
+        lo, hi = ownership.range_of(transport.rank)
+        self.shard_lo = lo
+        self.shards_per_host = hi - lo  # THIS rank's owned count (uneven ok)
         self.pass_id = pass_id
         # pass-retry epoch: tags carry ``@e<epoch>`` so the transport can
         # discard a reverted attempt's frames instead of feeding them to
@@ -96,7 +111,9 @@ class DistributedWorkingSet:
         return merged
 
     def _owner_host(self, keys: np.ndarray) -> np.ndarray:
-        return key_to_shard(keys, self.n_mesh_shards) // self.shards_per_host
+        return self.ownership.owner_of_shard(
+            key_to_shard(keys, self.n_mesh_shards)
+        )
 
     def finalize(
         self, table: HostSparseTable, round_to: int = 512, carrier=None,
@@ -143,7 +160,14 @@ class DistributedWorkingSet:
         STAT_ADD("wire.ws_req_raw_bytes", int(len(referenced)) * 8)
         STAT_ADD("wire.ws_req_bytes", sum(len(b) for b in req_out))
         req_in = t.alltoall(req_out, f"ws-req:{self.pass_id}@e{self.epoch}")
-        req_keys = [host_codec.decode_key_stream(b) for b in req_in]
+        # ranks outside the ownership live set contribute b"" placeholder
+        # slots (membership-aware alltoall), never decodable payloads
+        live = set(self.ownership.live_ranks)
+        req_keys = [
+            host_codec.decode_key_stream(b) if h in live
+            else np.zeros(0, np.uint64)
+            for h, b in enumerate(req_in)
+        ]
 
         # owner side: union, per-shard rank assignment (ascending key order)
         owned = (
@@ -173,13 +197,17 @@ class DistributedWorkingSet:
         # table when one is live, else classic pull from the local host
         # table
         self.boundary_stats = None
-        if carrier is not None and not carrier.flushed and len(owned):
+        same_epoch = carrier is None or (
+            getattr(carrier, "ownership_epoch", 0) == self.ownership.epoch
+        )
+        if carrier is not None and same_epoch and not carrier.flushed and len(owned):
             dev = self._finalize_spliced(table, carrier, cap)
         else:
             if carrier is not None:
-                # no splice possible (empty pass, or already flushed):
-                # everything the carrier owes must land before the classic
-                # pull reads host rows
+                # no splice possible (empty pass, already flushed, or the
+                # carrier's shard->host pinning predates this ownership
+                # epoch): everything the carrier owes must land before the
+                # classic pull reads host rows
                 table.drain_pending()
             vals = (
                 table.pull_or_create(owned)
@@ -189,8 +217,11 @@ class DistributedWorkingSet:
             dev = np.zeros(
                 (self.shards_per_host, cap, table.layout.width), np.float32
             )
-            local_rows = shard_of * cap + rank_in_shard
-            dev.reshape(self.shards_per_host * cap, -1)[local_rows] = vals
+            if len(owned):
+                # guarded: reshape(0, -1) on a zero-width ownership range
+                # cannot infer the trailing dim
+                local_rows = shard_of * cap + rank_in_shard
+                dev.reshape(self.shards_per_host * cap, -1)[local_rows] = vals
 
         # round 2: reply global rows for each requester's keys (their
         # order). Rows are shard*cap+rank, bounded by n_mesh_shards*cap —
@@ -219,9 +250,12 @@ class DistributedWorkingSet:
         STAT_ADD("wire.ws_rep_bytes", sum(len(b) for b in rep_out))
         rep_in = t.alltoall(rep_out, f"ws-rep:{self.pass_id}@e{self.epoch}")
 
-        # assemble local lookup over referenced keys
+        # assemble local lookup over referenced keys; non-live slots carry
+        # no keys (ownership routing never maps a shard to a dead rank)
         rows = np.empty(len(referenced), dtype=np.int64)
         for h in range(t.n_ranks):
+            if h not in live:
+                continue
             sel = owners == h
             got = host_codec.decode_row_ids(rep_in[h])
             rows[sel] = got
@@ -329,7 +363,9 @@ class DistributedWorkingSet:
         """Flush THIS host's trained shard slice into its own host table —
         ownership == device placement, so nothing crosses hosts (EndPass
         parity, box_wrapper.cc:627)."""
-        if self.owned_shard_keys is None:
+        if self.owned_shard_keys is None or self.shards_per_host == 0:
+            # a zero-width ownership range (uneven map, more ranks than
+            # shards) trains nothing and owes the host table nothing
             return
         flat = np.asarray(local_slice).reshape(self.shards_per_host, self.capacity, -1)
         for s, keys in enumerate(self.owned_shard_keys):

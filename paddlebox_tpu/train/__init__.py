@@ -8,12 +8,14 @@ from paddlebox_tpu.train.async_dense import AsyncDenseTable
 from paddlebox_tpu.train.checkpoint import (
     CheckpointManager,
     DeltaLineageError,
+    MembershipEpochError,
     read_watermark,
     validate_watermark,
 )
 from paddlebox_tpu.data.quarantine import DataPoisonedError
 from paddlebox_tpu.train.supervisor import (
     CoordinatedAbort,
+    ElasticConfig,
     EpochCoordinator,
     HealthGates,
     PassFailure,
@@ -36,6 +38,8 @@ __all__ = [
     "CoordinatedAbort",
     "DataPoisonedError",
     "DeltaLineageError",
+    "ElasticConfig",
+    "MembershipEpochError",
     "read_watermark",
     "validate_watermark",
     "EpochCoordinator",

@@ -68,6 +68,29 @@ class DeltaLineageError(RuntimeError):
     """
 
 
+class MembershipEpochError(DeltaLineageError):
+    """A delta chain spanning more than one ownership epoch.
+
+    Each delta snapshots the keys ONE rank owned when it was published; if
+    ownership re-sharded mid-chain (rank death, planned migration), deltas
+    before and after the flip cover different key ranges and their
+    composition is not any state one trainer held. Producers refuse to
+    extend a chain across an epoch flip (they re-anchor with a fresh base
+    instead), and ``validate_watermark`` rejects a mixed-epoch chain with
+    this typed error so a follower alarms instead of serving a chimera.
+    """
+
+
+def rank_root(root: str, rank: int) -> str:
+    """Per-rank checkpoint root under a shared day root.
+
+    Every rank publishes its owned shard slice under ``rank-<r>`` so a
+    survivor can open a DEAD rank's chain read-only and adopt its ranges
+    through the same manifest-verified resume path (membership epoch
+    protocol, docs/ROBUSTNESS.md)."""
+    return os.path.join(root, f"rank-{int(rank)}")
+
+
 def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
     crc = 0
     with open(path, "rb") as f:
@@ -174,6 +197,19 @@ def validate_watermark(wm: Dict[str, Any]) -> None:
         raise DeltaLineageError(f"malformed watermark {wm!r}: {e}") from e
     if idx < 0:
         raise DeltaLineageError(f"watermark delta_idx {idx} is negative")
+    # one chain, one ownership epoch: entries published under different
+    # epochs cover different key ranges and must never compose
+    epochs = {
+        e.get("ownership_epoch")
+        for e in [wm["base"]] + list(wm["deltas"])
+        if isinstance(e, dict) and "ownership_epoch" in e
+    }
+    if len(epochs) > 1:
+        raise MembershipEpochError(
+            f"watermark chain for {date!r} mixes ownership epochs "
+            f"{sorted(epochs)} — an epoch flip must re-anchor with a new "
+            "base, not extend the old chain"
+        )
     if base != f"{date}/base":
         raise DeltaLineageError(
             f"watermark base {base!r} does not belong to date {date!r}"
@@ -189,6 +225,11 @@ def validate_watermark(wm: Dict[str, Any]) -> None:
 class CheckpointManager:
     def __init__(self, root: str):
         self.root = root
+        # the key-ownership epoch this manager currently publishes under
+        # (parallel/membership.py); single-host stays at 0. Set by the
+        # supervisor when membership changes — the next save_base
+        # re-anchors the chain, and save_delta refuses to straddle a flip.
+        self.ownership_epoch = 0
         os.makedirs(root, exist_ok=True)
 
     # ---- paths -----------------------------------------------------------
@@ -219,7 +260,11 @@ class CheckpointManager:
         return self._read_cursor(self._prev_cursor_path())
 
     def _write_cursor(self, date: str, delta_idx: int, dense: Optional[str]) -> None:
-        cur = {"date": date, "delta_idx": delta_idx}
+        cur = {
+            "date": date,
+            "delta_idx": delta_idx,
+            "ownership_epoch": self.ownership_epoch,
+        }
         if dense is not None:
             cur["dense"] = dense  # the dense file this sparse state pairs with
         # keep the superseded cursor as the fallback anchor: if every
@@ -249,16 +294,22 @@ class CheckpointManager:
         previous complete watermark or this one — never a half-published
         save."""
         date, idx = cur["date"], cur["delta_idx"]
+        epoch = int(cur.get("ownership_epoch", 0))
 
         def entry(rel: str) -> Dict[str, Any]:
             return {
                 "path": rel,
                 "manifest_crc": _manifest_crc(os.path.join(self.root, rel)),
+                # save_delta refuses to straddle an epoch flip, so every
+                # entry of one chain carries the base's epoch — a follower
+                # validates exactly that (validate_watermark)
+                "ownership_epoch": epoch,
             }
 
         wm: Dict[str, Any] = {
             "date": date,
             "delta_idx": idx,
+            "ownership_epoch": epoch,
             "base": entry(f"{date}/base"),
             "deltas": [entry(f"{date}/delta-{i:04d}") for i in range(1, idx + 1)],
             "published_unix": time.time(),
@@ -329,6 +380,13 @@ class CheckpointManager:
             raise RuntimeError(
                 f"no base saved for date {date!r} — save_base first "
                 "(deltas are relative to a base)"
+            )
+        if int(cur.get("ownership_epoch", 0)) != int(self.ownership_epoch):
+            raise MembershipEpochError(
+                f"chain for {date!r} was published under ownership epoch "
+                f"{cur.get('ownership_epoch', 0)} but this rank is now at "
+                f"epoch {self.ownership_epoch} — save_base to re-anchor "
+                "(a delta must not straddle a membership flip)"
             )
         _fault_fire("checkpoint.save")  # window: nothing written yet
         idx = cur["delta_idx"] + 1

@@ -69,6 +69,7 @@ global, every rank exhausts the same retry budget on the same attempt.
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from collections import deque
@@ -81,7 +82,10 @@ from paddlebox_tpu import config
 from paddlebox_tpu.data.quarantine import DataPoisonedError
 from paddlebox_tpu.obs.flight_recorder import FLIGHT_RECORDER
 from paddlebox_tpu.obs.metrics_writer import MetricsWriter
-from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE
+from paddlebox_tpu.parallel import membership as _membership
+from paddlebox_tpu.parallel.transport import PeerDeadError
+from paddlebox_tpu.train.checkpoint import MembershipEpochError
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from paddlebox_tpu.utils.trace import PROFILER
 
 # incident kinds that end a pass (or the day) rather than healing in
@@ -145,6 +149,12 @@ class EpochCoordinator:
         self.transport = transport
         self.timeout = timeout
         self.epoch = 0
+        # elastic mode re-raises PeerDeadError instead of folding it into
+        # an abort vote: a dead peer is a MEMBERSHIP event (verdict round,
+        # ownership shrink, adoption), not a retryable pass failure — the
+        # supervisor's death handler owns it. Off by default so
+        # non-elastic runs keep the historical fail-as-abort behavior.
+        self.raise_peer_dead = False
 
     def exchange_verdict(self, key: str, ok: bool, detail: str = ""):
         """Returns (global_ok, detail) after every rank has voted."""
@@ -152,13 +162,24 @@ class EpochCoordinator:
         tag = f"ctl:verdict:{key}@e{self.epoch}"
         try:
             votes = self.transport.allgather(payload, tag, timeout=self.timeout)
+        except PeerDeadError as e:
+            if self.raise_peer_dead:
+                raise
+            STAT_ADD("supervisor_verdict_exchange_errors")
+            return False, f"verdict exchange failed: {e!r}"
         except (OSError, TimeoutError) as e:
             STAT_ADD("supervisor_verdict_exchange_errors")
             return False, f"verdict exchange failed: {e!r}"
+        # membership-confirmed dead ranks contribute b"" placeholder slots,
+        # not NO votes
+        live_fn = getattr(self.transport, "live_ranks", None)
+        live = set(live_fn()) if live_fn is not None else set(
+            range(self.transport.n_ranks)
+        )
         bad = [
             f"rank {r}: {v[1:].decode(errors='replace') or 'aborted'}"
             for r, v in enumerate(votes)
-            if v[:1] != b"\x01"
+            if r in live and v[:1] != b"\x01"
         ]
         if bad:
             return False, "; ".join(bad)
@@ -169,6 +190,24 @@ class EpochCoordinator:
         revert_pass bumps — keeping the two in lockstep)."""
         self.epoch = self.epoch + 1 if epoch is None else epoch
         self.transport.discard_epochs_below(self.epoch)
+
+
+@dataclass
+class ElasticConfig:
+    """Opt-in elastic membership for a coordinated supervisor.
+
+    ``shared_root`` is the day root every rank publishes its checkpoint
+    tree under (``rank-<r>`` subdirs, checkpoint.rank_root): the adoption
+    path opens a DEAD rank's tree read-only through it. ``migrate_skew``
+    > 1.0 additionally arms planned migration: at a confirmed pass
+    boundary, when the max/mean per-rank key-load ratio crosses it, the
+    supervisor recuts ownership boundaries and streams the moving ranges
+    (see docs/ROBUSTNESS.md, "Elastic membership & key migration")."""
+
+    shared_root: str
+    migrate_skew: float = 0.0  # <= 1.0 disables planned migration
+    adopt_retries: int = 2
+    member_timeout: Optional[float] = None
 
 
 @dataclass
@@ -210,7 +249,8 @@ class Incident:
     date: Optional[str]
     kind: str      # load_error | train_error | gate_nan | gate_auc |
                    # prefetch_error | ckpt_save_error | escalate_resume |
-                   # gave_up | skipped | peer_abort | data_poisoned
+                   # gave_up | skipped | peer_abort | data_poisoned |
+                   # rank_death | migrate | migrate_abort
     action: str    # retry | revert_retry | resume | raise | skip
     attempt: int
     detail: str = ""
@@ -248,6 +288,7 @@ class PassSupervisor:
         on_give_up: str = "raise",  # raise | skip (drop the pass, keep the day)
         transport=None,
         on_poisoned: Optional[str] = None,  # None -> on_poisoned_pass flag
+        elastic: Optional[ElasticConfig] = None,
     ):
         if on_give_up not in ("raise", "skip"):
             raise ValueError(f"on_give_up must be 'raise' or 'skip', got {on_give_up!r}")
@@ -271,6 +312,16 @@ class PassSupervisor:
         )
         if self.coord is not None:
             self.coord.epoch = getattr(dataset, "pass_epoch", 0)
+        # elastic membership: a dead peer becomes a verdict round + owner-
+        # ship shrink + shard adoption instead of a dead day. Requires the
+        # coordinator (single-rank runs have no membership to lose) and a
+        # dataset that carries an OwnershipMap.
+        self.elastic = elastic
+        if elastic is not None and self.coord is not None:
+            self.coord.raise_peer_dead = True
+        # set when ownership flipped mid-chain: the next checkpoint save
+        # re-anchors with a base (a delta must not straddle an epoch flip)
+        self._force_base = False
         self.round_to = round_to
         self.shrink = shrink
         self.on_give_up = on_give_up
@@ -357,6 +408,12 @@ class PassSupervisor:
             STAT_ADD("supervisor_gate_nan")
         elif kind == "gate_auc":
             STAT_ADD("supervisor_gate_auc")
+        elif kind == "rank_death":
+            STAT_ADD("supervisor_rank_death")
+        elif kind == "migrate":
+            STAT_ADD("supervisor_migrate")
+        elif kind == "migrate_abort":
+            STAT_ADD("supervisor_migrate_abort")
         else:  # pragma: no cover - new kinds must be added above
             STAT_ADD("supervisor_other")
         PROFILER.instant(f"supervisor:{kind}", inc.as_dict())
@@ -597,11 +654,21 @@ class PassSupervisor:
         assert self.checkpoint is not None
         for attempt in range(self.retry.retries + 1):
             try:
-                if mode == "base":
+                if mode == "base" or self._force_base:
+                    # an ownership flip mid-day re-anchors the chain: the
+                    # old chain's deltas cover the pre-flip key ranges and
+                    # must not be extended across the epoch
                     self.checkpoint.save_base(self._date, self.table, self.tr)
+                    self._force_base = False
                 else:
                     self.checkpoint.save_delta(self._date, self.table, self.tr)
                 return
+            except MembershipEpochError as e:
+                # belt-and-braces: the cursor says the chain predates this
+                # rank's ownership epoch — re-anchor instead of retrying
+                # the refused delta
+                self._record("ckpt_save_error", "retry", attempt, repr(e))
+                self._force_base = True
             except Exception as e:
                 # atomic publishing means a failed attempt left nothing
                 # under a final name — a retry starts clean
@@ -613,6 +680,213 @@ class PassSupervisor:
                     ) from e
                 self._record("ckpt_save_error", "retry", attempt, repr(e))
                 self.retry.sleep(self.retry.backoff(attempt + 1))
+        raise PassFailure(
+            f"checkpoint {mode} save failed: retry budget exhausted "
+            "re-anchoring across an ownership-epoch flip"
+        )
+
+    # ---- elastic membership ---------------------------------------------
+
+    def _ownership_map(self):
+        """The dataset's current OwnershipMap, defaulting to the even
+        split over all transport ranks (epoch 0) when none was installed
+        yet — identical to what DistributedWorkingSet defaults to."""
+        omap = getattr(self.ds, "ownership", None)
+        if omap is None:
+            omap = _membership.OwnershipMap.even(
+                self.ds.n_mesh_shards, self.coord.transport.n_ranks
+            )
+        return omap
+
+    def _install_ownership(self, new_map) -> None:
+        """Atomically adopt a successor OwnershipMap: dataset routing,
+        checkpoint epoch, and the forced chain re-anchor flip together."""
+        self.ds.ownership = new_map
+        if self.checkpoint is not None:
+            self.checkpoint.ownership_epoch = new_map.epoch
+        self._force_base = True
+        STAT_SET("membership.epoch", new_map.epoch)
+
+    def _handle_rank_death(self, e: PeerDeadError) -> None:
+        """Survivor-side membership change: verdict round -> shrunk map ->
+        shard adoption from the dead ranks' durable checkpoint shards.
+
+        On return the retried pass runs on N-1 ranks over exactly the
+        table state a fresh shrunk-membership run would hold (adoption is
+        an idempotent upsert from the last pass boundary, and keys never
+        checkpointed are recreated from the seeded init — both bitwise-
+        equal to the fresh run, pinned by tests/test_elastic.py)."""
+        assert self.elastic is not None and self.coord is not None
+        tp = self.coord.transport
+        tp.mark_dead(e.dead)
+        # revert anything the dying attempt armed before touching the table
+        if getattr(self.ds, "_in_pass", False):
+            try:
+                self.ds.revert_pass()
+            except Exception as re_err:
+                self._record(
+                    "rank_death", "revert_failed", 0,
+                    f"{e!r}; revert: {re_err!r}",
+                )
+                raise PassFailure(
+                    f"revert failed after peer death {e!r}: {re_err}"
+                ) from re_err
+        self.coord.advance(getattr(self.ds, "pass_epoch", None))
+        # membership verdict round: every survivor converges on one dead
+        # set (the proposal is encoded in the collective tag)
+        agreed = _membership.agree_membership(
+            tp, self._pass_seq, timeout=self.elastic.member_timeout
+        )
+        old_map = self._ownership_map()
+        newly_dead = [d for d in agreed if old_map.is_live(d)]
+        new_map = old_map.shrink(agreed)
+        my_rank = tp.rank
+        adopted_ranges = []
+        for d in newly_dead:
+            dlo, dhi = old_map.range_of(d)
+            mlo, mhi = new_map.range_of(my_rank)
+            lo, hi = max(dlo, mlo), min(dhi, mhi)
+            if lo < hi:
+                adopted_ranges.append([lo, hi])
+        # adoption: bounded retries in ISOLATION — the pass must not retry
+        # under a half-installed map (keys routed to a dead owner would
+        # silently vanish from the exchange)
+        adopt_err: Optional[Exception] = None
+        adopted_keys = 0
+        for a in range(self.elastic.adopt_retries + 1):
+            try:
+                adopted_keys = sum(
+                    _membership.adopt_dead_shards(
+                        self.table, self.elastic.shared_root, d,
+                        old_map, new_map, my_rank,
+                    )
+                    for d in newly_dead
+                )
+                adopt_err = None
+                break
+            except Exception as ae:
+                adopt_err = ae
+                if a < self.elastic.adopt_retries:
+                    self._record("rank_death", "retry", a, repr(ae))
+                    self.retry.sleep(self.retry.backoff(a + 1))
+        # every survivor must finish adopting before anyone re-enters the
+        # pass — and one survivor failing adoption aborts all (the dead
+        # ranges would be served by nobody)
+        ok, detail = self.coord.exchange_verdict(
+            f"member:{self._pass_seq}:{new_map.epoch}",
+            adopt_err is None,
+            repr(adopt_err) if adopt_err else "",
+        )
+        if adopt_err is not None:
+            self._record("rank_death", "raise", 0, repr(adopt_err))
+            raise PassFailure(
+                f"shard adoption failed after {self.elastic.adopt_retries + 1} "
+                f"attempts: {adopt_err}"
+            ) from adopt_err
+        if not ok:
+            self._record("rank_death", "raise", 0, detail)
+            raise PassFailure(f"peer shard adoption failed: {detail}")
+        self._install_ownership(new_map)
+        self._record(
+            "rank_death", "revert_retry", 0,
+            f"dead={list(agreed)} survivors={list(new_map.live_ranks)} "
+            f"ownership_epoch={new_map.epoch} adopted_keys={adopted_keys}",
+        )
+        bundle = {
+            "dead": [int(d) for d in agreed],
+            "survivors": [int(r) for r in new_map.live_ranks],
+            "ownership_epoch": new_map.epoch,
+            "adopted_ranges": adopted_ranges,
+            "adopted_keys": int(adopted_keys),
+        }
+        FLIGHT_RECORDER.note_incident("membership_change", bundle)
+        FLIGHT_RECORDER.dump(
+            "rank_death", json.dumps(bundle), dir_path=self._incident_dir
+        )
+        PROFILER.instant("supervisor:membership_change", bundle)
+
+    def _maybe_migrate(self) -> None:
+        """Planned migration at a confirmed pass boundary: recut ownership
+        boundaries when per-rank key-load skew crosses the threshold and
+        stream the moving shard ranges owner->owner. Atomic at the
+        boundary: receivers stage, a commit verdict decides, and only a
+        global YES flips the epoch — any failure leaves the old epoch
+        serving and the plan is re-derived at the next boundary."""
+        from paddlebox_tpu.table.sparse_table import key_to_shard
+
+        assert self.elastic is not None and self.coord is not None
+        tp = self.coord.transport
+        omap = self._ownership_map()
+        if len(omap.live_ranks) < 2:
+            return
+        # the carried device table may hold rows the host store lags on —
+        # migration reads host rows, so everything owed must land first
+        drain = getattr(self.table, "drain_pending", None)
+        if drain is not None:
+            drain()
+        lo, hi = omap.range_of(tp.rank)
+        keys = self.table.keys()
+        sh = key_to_shard(keys, omap.n_mesh_shards)
+        mine = sh[(sh >= lo) & (sh < hi)]
+        local = np.bincount(mine - lo, minlength=hi - lo).astype("<i8")
+        views = tp.allgather(
+            local.tobytes(),
+            f"ctl:load:{self._pass_seq}@e{self.coord.epoch}",
+            timeout=self.elastic.member_timeout,
+        )
+        loads = np.zeros(omap.n_mesh_shards, np.int64)
+        for r in omap.live_ranks:
+            rlo, rhi = omap.range_of(r)
+            v = views[r]
+            if len(v) == (rhi - rlo) * 8:
+                loads[rlo:rhi] = np.frombuffer(v, dtype="<i8")
+        new_map = _membership.plan_rebalance(
+            omap, loads, self.elastic.migrate_skew
+        )
+        if new_map is None:
+            # every rank derived None from the identical global vector —
+            # no verdict round needed for a unanimous no-op
+            return
+        seq = f"{self._pass_seq}.{new_map.epoch}"
+        xfer = None
+        xfer_err: Optional[Exception] = None
+        try:
+            xfer = _membership.migrate_ranges(
+                tp, self.table, omap, new_map, seq, self.coord.epoch,
+                timeout=self.elastic.member_timeout,
+            )
+        except Exception as me:
+            xfer_err = me
+        ok, detail = self.coord.exchange_verdict(
+            f"migrate:{seq}", xfer_err is None,
+            repr(xfer_err) if xfer_err else "",
+        )
+        if not ok or xfer_err is not None:
+            # old epoch still serves; staged pieces are discarded and the
+            # plan is re-derived at the next boundary (FLT008 contract)
+            STAT_ADD("membership.migrations_aborted")
+            self._record(
+                "migrate_abort", "retry", 0,
+                detail or repr(xfer_err),
+            )
+            return
+        _membership.commit_staged(self.table, xfer["staged"])
+        self._install_ownership(new_map)
+        STAT_ADD("membership.migrated_keys", int(xfer["recv_keys"]))
+        STAT_ADD("membership.migration_bytes", int(xfer["sent_bytes"]))
+        self._record(
+            "migrate", "commit", 0,
+            f"ownership_epoch={new_map.epoch} moves={xfer['moves']} "
+            f"recv_keys={xfer['recv_keys']} sent_bytes={xfer['sent_bytes']}",
+        )
+        FLIGHT_RECORDER.note_incident(
+            "migration", {
+                "ownership_epoch": new_map.epoch,
+                "moves": xfer["moves"],
+                "recv_keys": int(xfer["recv_keys"]),
+                "sent_bytes": int(xfer["sent_bytes"]),
+            },
+        )
 
     # ---- the supervised pass --------------------------------------------
 
@@ -649,16 +923,26 @@ class PassSupervisor:
             # coordinate the load the same way as the pass verdict: a rank
             # whose input never materialized must take every peer down with
             # it NOW, not leave them hanging in the first exchange
-            load_err: Optional[PassFailure] = None
-            try:
-                self._load_with_retry(date, files)
-            except PassFailure as e:
-                load_err = e
-            ok, detail = self.coord.exchange_verdict(
-                f"load:{self._pass_seq}",
-                load_err is None,
-                repr(load_err) if load_err else "",
-            )
+            while True:
+                load_err: Optional[PassFailure] = None
+                try:
+                    self._load_with_retry(date, files)
+                except PassFailure as e:
+                    load_err = e
+                try:
+                    ok, detail = self.coord.exchange_verdict(
+                        f"load:{self._pass_seq}",
+                        load_err is None,
+                        repr(load_err) if load_err else "",
+                    )
+                except PeerDeadError as e:
+                    # only raised in elastic mode: shrink membership and
+                    # redo the (unarmed) load on the survivors
+                    if self.elastic is None:
+                        raise
+                    self._handle_rank_death(e)
+                    continue
+                break
             if load_err is not None:
                 raise load_err
             if not ok:
@@ -700,6 +984,19 @@ class PassSupervisor:
                 # deterministic — never burn backoff retries on it.
                 self._record("data_poisoned", "raise", attempt, repr(e))
                 raise
+            except PeerDeadError as e:
+                if self.elastic is None or self.coord is None:
+                    # hardware loss without elastic membership stays what
+                    # it always was: terminal for the day
+                    raise
+                # membership event, not a pass failure: verdict round,
+                # ownership shrink, adoption — then retry the pass on the
+                # survivors with a FRESH budget (the hardware loss costs
+                # one pass retry, never the day)
+                self._handle_rank_death(e)
+                attempt = 0
+                escalated = False
+                continue
             except Exception as e:
                 self._revert(attempt, e)
                 if self.coord is not None:
@@ -773,6 +1070,20 @@ class PassSupervisor:
                     prefetch=nxt,
                 )
             )
+            if (
+                self.elastic is not None
+                and self.coord is not None
+                and self.elastic.migrate_skew > 1.0
+            ):
+                # confirmed + published boundary: the one place ownership
+                # may move planned ranges (atomic epoch flip on a global
+                # commit verdict)
+                try:
+                    self._maybe_migrate()
+                except PeerDeadError as e:
+                    # a rank died during the boundary round: membership
+                    # handling, then the next pass runs on the survivors
+                    self._handle_rank_death(e)
             if self.metrics is not None:
                 # wall-clock cadence between the per-pass points: on long
                 # passes obs_metrics_interval_s paces extra ticks

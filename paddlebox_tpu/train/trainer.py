@@ -1072,6 +1072,17 @@ class CTRTrainer:
                     f"{jax.process_index()} — order the transport endpoint "
                     "list by jax process id"
                 )
+            omap = getattr(dataset, "ownership", None)
+            if tp is not None and omap is not None and not omap.is_live(tp.rank):
+                # after an elastic shrink the ownership map is the source of
+                # truth for which ranks may train; a rank outside the live
+                # set would pull shard ranges nobody routes to it
+                raise RuntimeError(
+                    f"transport rank {tp.rank} is not in the live set of "
+                    f"ownership epoch {omap.epoch} "
+                    f"(live={list(omap.live_ranks)}) — this process was "
+                    "voted out of the membership and must not train"
+                )
         from paddlebox_tpu.utils.timer import Timer
 
         t_feed, t_disp, t_dev, t_host = Timer(), Timer(), Timer(), Timer()

@@ -77,6 +77,21 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             SpillIOError and counted under
                             table.spill_errors (the end_pass worker's
                             failure path then reopens the pass for retry)
+    membership.adopt_shard  parallel/membership.py  adopt_dead_shards,
+                            after the dead rank's checkpoint shard is
+                            resumed but before its keys are pushed into
+                            the survivor's table — a failure is a crash
+                            mid-adoption; the retry re-runs the same
+                            CRC-verified resume and the push is a pure
+                            upsert, so the retried adoption lands
+                            bitwise-identical
+    migrate.transfer        parallel/membership.py  migrate_ranges, on the
+                            sender before a shard range is encoded onto
+                            the wire — a failure aborts the planned
+                            migration; the verdict round then keeps the
+                            OLD ownership epoch serving (stale-epoch
+                            frames are unreceivable) and the plan is
+                            simply retried at the next pass boundary
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -125,6 +140,8 @@ KNOWN_SITES = (
     "backend.init",
     "serve.apply_delta",
     "spill.io",
+    "membership.adopt_shard",
+    "migrate.transfer",
 )
 
 

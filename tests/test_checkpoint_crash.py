@@ -126,7 +126,8 @@ def test_base_crash_windows_keep_previous_state(tmp_path, hit):
     if hit <= 2:
         # nothing published under the final name, only (at most) a .tmp
         assert not os.path.isdir(os.path.join(root, DATE2, "base"))
-    assert cm.cursor() == {"date": DATE, "delta_idx": 1, "dense": "dense-0001.npz"}
+    assert cm.cursor() == {"date": DATE, "delta_idx": 1,
+                           "ownership_epoch": 0, "dense": "dense-0001.npz"}
     assert_same_resume(root, ref)
     # the retried save commits, and a restart then sees the live state
     cm.save_base(DATE2, t, d)
@@ -157,7 +158,8 @@ def test_delta_crash_windows_keep_previous_pair(tmp_path, hit):
         # torn attempt is invisible: only the .tmp sibling exists
         assert os.path.isdir(os.path.join(root, DATE, "delta-0002.tmp"))
         assert not os.path.isdir(os.path.join(root, DATE, "delta-0002"))
-    assert cm.cursor() == {"date": DATE, "delta_idx": 1, "dense": "dense-0001.npz"}
+    assert cm.cursor() == {"date": DATE, "delta_idx": 1,
+                           "ownership_epoch": 0, "dense": "dense-0001.npz"}
     assert_same_resume(root, ref)
     # retry: same delta index, same keys (deferred touched-clear), commits
     cm.save_delta(DATE, t, d)

@@ -33,6 +33,7 @@ from paddlebox_tpu.train import (
     CheckpointManager,
     CTRTrainer,
     DeltaLineageError,
+    MembershipEpochError,
     TrainStepConfig,
     read_watermark,
     validate_watermark,
@@ -315,6 +316,63 @@ def test_watermark_rewind_rejected(stack):
     with pytest.raises(DeltaLineageError, match="rewound"):
         fol.poll_once()
     assert fol.version().delta_idx == 1  # still serving, unregressed
+
+
+# ---- elastic membership on the serve plane --------------------------------
+
+def test_mixed_epoch_watermark_rejected():
+    """A chain whose base and deltas were published under different
+    ownership epochs covers different key ranges and must never compose:
+    validate_watermark rejects it with the typed error."""
+    wm = {
+        "date": DATE,
+        "delta_idx": 1,
+        "base": {"path": f"{DATE}/base", "ownership_epoch": 0},
+        "deltas": [{"path": f"{DATE}/delta-0001", "ownership_epoch": 1}],
+    }
+    with pytest.raises(MembershipEpochError, match="mixes ownership epochs"):
+        validate_watermark(wm)
+    # the typed error IS a DeltaLineageError: every existing alarm-and-
+    # keep-serving path (Follower.run, supervisor resume) already catches it
+    assert issubclass(MembershipEpochError, DeltaLineageError)
+    # one uniform epoch — any epoch — composes fine
+    wm["deltas"][0]["ownership_epoch"] = 0
+    validate_watermark(wm)
+
+
+def test_follower_reanchors_across_epoch_flip(stack):
+    """The trainer rank set changes mid-day: the re-anchored base under
+    the new ownership epoch supersedes the old chain wholesale, and the
+    follower reloads it without a restart — score parity holds across
+    the flip."""
+    st = stack
+    fol = st.follower
+    st.publish_base()
+    st.publish_delta(lo=120)
+    assert fol.poll_once() is True
+    assert fol.version().delta_idx == 1
+    reanchors0 = STAT_GET("serve.epoch_reanchors")
+
+    # a membership change bumps the manager's epoch; the next save_base
+    # re-anchors the chain under the SAME date (what the supervisor does
+    # after a rank death or a committed migration)
+    st.mgr.ownership_epoch = 1
+    st.publish_base()
+    wm = read_watermark(st.root)
+    assert wm["ownership_epoch"] == 1 and wm["delta_idx"] == 0
+    assert fol.poll_once() is True
+    assert STAT_GET("serve.epoch_reanchors") == reanchors0 + 1
+    v = fol.version()
+    assert v.delta_idx == 0  # the old chain's position was abandoned
+    np.testing.assert_array_equal(st.trainer_scores(), st.follower_scores())
+    assert STAT_GET("serve.ownership_epoch") == 1
+
+    # the new-epoch chain tails normally from here
+    st.publish_delta(lo=260)
+    ref = st.trainer_scores()
+    assert fol.poll_once() is True
+    assert fol.version().delta_idx == 1
+    np.testing.assert_array_equal(ref, st.follower_scores())
 
 
 def test_staleness_and_served_index_monotonic(stack):

@@ -33,6 +33,16 @@ train + writeback — under seeded ``transport.send`` /
 
   JAX_PLATFORMS=cpu python tools/chaos_probe.py --distributed 3 \
       [--passes N] [--rows N] [--seed N] [--send-flake-prob P] [--json]
+
+``--kill-rank R`` is the elastic-membership soak: an N-rank supervised
+day (``--ranks``, default 4) loses rank R at the top of pass 1; the
+survivors run the membership verdict round, adopt the dead rank's shard
+ranges from its last checkpoint, revert the in-flight pass and finish
+the day — and the final ownership-filtered digest plus per-pass global
+AUC must be bitwise-equal to a FRESH (N-1)-rank run of the same day:
+
+  JAX_PLATFORMS=cpu python tools/chaos_probe.py --kill-rank 1 \
+      [--ranks N] [--passes N] [--rows N] [--seed N] [--json]
 """
 
 from __future__ import annotations
@@ -816,6 +826,368 @@ def run_distributed(args):
     return 0 if equal and equal_raw and trace_ok and fr_ok else 1
 
 
+class _ProbeRankKilled(BaseException):
+    """Escapes every supervisor except-Exception tier, like a real death."""
+
+
+_ELASTIC_MESH = 8
+
+
+def _elastic_records(seed, pass_idx, n_records):
+    """One pass's GLOBAL record stream — identical for every membership;
+    routing (record i -> sorted(live)[i % n_live]) decides who trains it."""
+    rng = np.random.default_rng(1000 * seed + pass_idx)
+    pool = rng.integers(1, 160, 4096).astype(np.uint64)
+    recs = []
+    for _ in range(n_records):
+        nk = int(rng.integers(1, 4))
+        keys = np.unique(rng.choice(pool, nk))
+        recs.append((keys, float(rng.integers(0, 2))))
+    return recs
+
+
+def _elastic_mk_sup(rank, tps, root, seed, n_records, recorder, kill_at=None):
+    from types import SimpleNamespace
+
+    from paddlebox_tpu.parallel.membership import OwnershipMap
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+    from paddlebox_tpu.train.checkpoint import CheckpointManager, rank_root
+    from paddlebox_tpu.train.supervisor import (
+        ElasticConfig,
+        HealthGates,
+        PassSupervisor,
+        RetryPolicy,
+    )
+
+    table = HostSparseTable(
+        ValueLayout(embedx_dim=2), SparseOptimizerConfig(embedx_threshold=0.0),
+        n_shards=2, seed=0,
+    )
+
+    class _DS:
+        """Dataset double over a REAL table + DistributedWorkingSet (the
+        same harness tests/test_elastic.py pins in tier-1)."""
+
+        def __init__(self):
+            self.transport = tps[rank]
+            self.table = table
+            self.n_mesh_shards = _ELASTIC_MESH
+            self.ownership = None
+            self.pass_epoch = 0
+            self._in_pass = False
+            self.pass_idx = -1
+            self.ws = None
+            self.dev = None
+            self.my_records = []
+
+        def set_date(self, date):
+            pass
+
+        def set_filelist(self, files):
+            self._files = list(files)
+
+        def load_into_memory(self):
+            self.pass_idx = int(self._files[0].rsplit("-", 1)[1])
+
+        def _omap(self):
+            return self.ownership or OwnershipMap.even(
+                self.n_mesh_shards, self.transport.n_ranks
+            )
+
+        def begin_pass(self, round_to=8, enable_revert=True, trainer=None):
+            live = list(self._omap().live_ranks)
+            recs = _elastic_records(seed, self.pass_idx, n_records)
+            me = self.transport.rank
+            self.my_records = [
+                rec for i, rec in enumerate(recs)
+                if live[i % len(live)] == me
+            ]
+            ws = DistributedWorkingSet(
+                self.transport, self.n_mesh_shards, pass_id=self.pass_idx,
+                epoch=self.pass_epoch, ownership=self._omap(),
+            )
+            for keys, _ in self.my_records:
+                ws.add_keys(keys)
+            self.dev = ws.finalize(self.table, round_to=8)
+            self.ws = ws
+            self._in_pass = True
+
+        def end_pass(self, table_, shrink=True):
+            self.ws.writeback(self.dev)
+            self._in_pass = False
+
+        def revert_pass(self):
+            # rows were only CREATED in finalize (deterministic init),
+            # never trained: dropping the device slice reverts the pass
+            self.ws = None
+            self.dev = None
+            self._in_pass = False
+            self.pass_epoch += 1
+
+    ds = _DS()
+
+    def train_pass(_ds, n_batches=None):
+        if kill_at is not None and ds.pass_idx == kill_at:
+            ds.transport.close()
+            raise _ProbeRankKilled()
+        ds.dev = ds.dev * np.float32(1.01) + np.float32(0.25)
+        preds, labels = [], []
+        for keys, label in ds.my_records:
+            rows = ds.ws.lookup(keys).astype(np.int64)
+            preds.append(((int(rows.sum()) + ds.pass_idx) % 97) / 97.0)
+            labels.append(label)
+        recorder[(rank, ds.pass_idx)] = (
+            np.array(preds, np.float32), np.array(labels, np.float32),
+        )
+        return {"batches": 1.0, "nan_batches": 0.0, "auc": 0.5}
+
+    tr = SimpleNamespace(
+        params=None,
+        prepare_pass=lambda _ds, n: None,
+        train_pass=train_pass,
+        trained_table=lambda: None,
+        init_params=lambda *a, **k: None,
+        load_dense=lambda path: None,
+        save_dense=lambda path: np.savez(path, z=np.zeros(1, np.float32)),
+        _state=None,
+        _state_ws=None,
+    )
+    sup = PassSupervisor(
+        ds, tr,
+        checkpoint=CheckpointManager(rank_root(root, rank)),
+        gates=HealthGates(auc_min_history=99),
+        retry=RetryPolicy(max_retries=2, backoff_s=0.0, sleep=lambda s: None),
+        round_to=8,
+        transport=tps[rank],
+        elastic=ElasticConfig(shared_root=root, member_timeout=5.0),
+    )
+    return sup, ds
+
+
+def _probe_run_threads(fn, n, join_s=300.0):
+    """Run fn(rank) on n threads; each rank's state (supervisor, table,
+    transport) is thread-confined — fn(r) only ever touches rank r's
+    objects. Returns (results, errors)."""
+    import threading
+
+    results, errors = [None] * n, []
+
+    def _wrap(r):
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001 - re-raised by caller
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=_wrap, args=(r,)) for r in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(join_s)
+    return results, errors
+
+
+def _elastic_run_day(n, root, seed, n_records, passes, recorder,
+                     kill_rank=None, kill_at=None):
+    from paddlebox_tpu.parallel.transport import TcpTransport
+
+    eps = [f"127.0.0.1:{p}" for p in _dist_free_ports(n)]
+    tps = [TcpTransport(r, eps, timeout=60.0) for r in range(n)]
+    sups = [
+        _elastic_mk_sup(
+            r, tps, root, seed, n_records, recorder,
+            kill_at=(kill_at if r == kill_rank else None),
+        )[0]
+        for r in range(n)
+    ]
+    files = [[f"pass-{p}"] for p in range(passes)]
+
+    def day(r):
+        try:
+            return sups[r].run_day("20260101", files)
+        except _ProbeRankKilled:
+            return "killed"
+
+    t0 = time.perf_counter()
+    try:
+        results, errors = _probe_run_threads(day, n)
+    finally:
+        for t in tps:
+            t.close()
+    if errors:
+        raise errors[0][1]
+    return sups, results, time.perf_counter() - t0
+
+
+def _elastic_merged_digest(sups, ranks):
+    """Ownership-filtered global digest: every key exactly once, under its
+    CURRENT owner."""
+    from paddlebox_tpu.table.sparse_table import key_to_shard
+
+    keys_parts, row_parts = [], []
+    for r in ranks:
+        sup = sups[r]
+        lo, hi = sup.ds._omap().range_of(sup.coord.transport.rank)
+        k = np.sort(sup.table.keys())
+        sh = key_to_shard(k, _ELASTIC_MESH)
+        k = k[(sh >= lo) & (sh < hi)]
+        keys_parts.append(k)
+        row_parts.append(sup.table.pull_or_create(k))
+    keys = np.concatenate(keys_parts)
+    rows = np.concatenate(row_parts)
+    order = np.argsort(keys, kind="stable")
+    assert len(keys) == len(np.unique(keys)), "ownership ranges overlap"
+    return keys[order], rows[order]
+
+
+def _elastic_pass_auc(recorder, p):
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.metrics.auc import auc_compute, auc_init, auc_update
+
+    entries = [v for (r, pp), v in sorted(recorder.items()) if pp == p]
+    preds = np.concatenate([e[0] for e in entries])
+    labels = np.concatenate([e[1] for e in entries])
+    state = auc_update(auc_init(1000), jnp.asarray(preds), jnp.asarray(labels))
+    return np.asarray(auc_compute(state))
+
+
+def run_kill_rank(args):
+    """Elastic-membership soak (``--kill-rank=R``): an N-rank supervised
+    day loses rank R mid-pass; survivors agree on the shrunk membership,
+    adopt the dead rank's shard ranges from its checkpoint, revert the
+    in-flight pass and finish the day — and the final ownership-filtered
+    sparse digest AND per-pass global AUC must be bitwise-equal to a
+    FRESH (N-1)-rank run of the same day. Exit 0 iff every gate holds.
+
+      JAX_PLATFORMS=cpu python tools/chaos_probe.py --kill-rank 1 [--json]
+    """
+    import glob as globmod
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.train.checkpoint import (
+        rank_root,
+        read_watermark,
+        validate_watermark,
+    )
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    n, kill_rank, passes, kill_at = args.ranks, args.kill_rank, args.passes, 1
+    if not (0 <= kill_rank < n):
+        print(f"--kill-rank must be in [0, {n})", file=sys.stderr)
+        return 2
+    if passes < 2:
+        print("--passes must be >= 2 (the kill lands mid-day)",
+              file=sys.stderr)
+        return 2
+    n_records = args.rows
+    saved = {
+        name: config.get_flag(name)
+        for name in (
+            "transport_heartbeat_s", "transport_backoff_s",
+            "transport_send_retries", "transport_peer_dead_s",
+        )
+    }
+    config.set_flag("transport_heartbeat_s", 0.05)
+    config.set_flag("transport_backoff_s", 0.005)
+    config.set_flag("transport_send_retries", 6)
+    adopts_before = STAT_GET("membership.adopts")
+    try:
+        with tempfile.TemporaryDirectory() as tmpdir:
+            # the elastic day: N ranks, one dies at the top of pass 1
+            config.set_flag("transport_peer_dead_s", 0.6)
+            rec_e = {}
+            root_e = os.path.join(tmpdir, "elastic")
+            sups_e, res_e, wall_e = _elastic_run_day(
+                n, root_e, args.seed, n_records, passes, rec_e,
+                kill_rank=kill_rank, kill_at=kill_at,
+            )
+            config.set_flag("transport_peer_dead_s", 60.0)
+            survivors = [r for r in range(n) if r != kill_rank]
+            killed_ok = res_e[kill_rank] == "killed"
+            finished_ok = all(
+                isinstance(res_e[r], list) and len(res_e[r]) == passes
+                for r in survivors
+            )
+            epochs = [
+                sups_e[r].ds.ownership.epoch
+                if sups_e[r].ds.ownership is not None else 0
+                for r in survivors
+            ]
+            kinds = sorted({
+                i.kind for r in survivors for i in sups_e[r].incidents
+            })
+            bundles = sum(
+                len(globmod.glob(os.path.join(
+                    rank_root(root_e, r), "obs", "incidents",
+                    "incident-*.json",
+                )))
+                for r in survivors
+            )
+            wm = read_watermark(rank_root(root_e, survivors[0]))
+            validate_watermark(wm)
+            wm_epoch = int(wm["ownership_epoch"])
+
+            # the reference: a FRESH (N-1)-rank run of the same day
+            rec_f = {}
+            sups_f, res_f, wall_f = _elastic_run_day(
+                n - 1, os.path.join(tmpdir, "fresh"), args.seed,
+                n_records, passes, rec_f,
+            )
+            fresh_ok = all(
+                isinstance(r, list) and len(r) == passes for r in res_f
+            )
+            ek, ev = _elastic_merged_digest(sups_e, survivors)
+            fk, fv = _elastic_merged_digest(sups_f, list(range(n - 1)))
+            digest_equal = bool(
+                np.array_equal(ek, fk) and np.array_equal(ev, fv)
+            )
+            auc_equal = all(
+                np.array_equal(
+                    _elastic_pass_auc(rec_e, p), _elastic_pass_auc(rec_f, p)
+                )
+                for p in range(passes)
+            )
+    finally:
+        for name, v in saved.items():
+            config.set_flag(name, v)
+
+    adopts = int(STAT_GET("membership.adopts") - adopts_before)
+    ok = (
+        killed_ok and finished_ok and fresh_ok
+        and all(e == 1 for e in epochs) and wm_epoch == 1
+        and "rank_death" in kinds and bundles >= len(survivors)
+        and adopts >= 1 and digest_equal and auc_equal
+    )
+    report = {
+        "mode": "kill-rank",
+        "ranks": n,
+        "killed_rank": kill_rank,
+        "kill_at_pass": kill_at,
+        "passes": passes,
+        "records_per_pass": n_records,
+        "survivors": survivors,
+        "survivors_finished": bool(finished_ok),
+        "ownership_epoch_after": epochs[0] if epochs else None,
+        "watermark_ownership_epoch": wm_epoch,
+        "membership_adopts": adopts,
+        "incident_kinds": kinds,
+        "incident_bundles": bundles,
+        "digest_keys": int(len(ek)),
+        "bitwise_equal_to_fresh_shrunk_run": digest_equal,
+        "auc_equal_per_pass": bool(auc_equal),
+        "wall_elastic_s": round(wall_e, 2),
+        "wall_fresh_s": round(wall_f, 2),
+        "ok": bool(ok),
+    }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--days", type=int, default=2)
@@ -835,6 +1207,13 @@ def main(argv=None):
     ap.add_argument("--send-flake-prob", type=float, default=0.15,
                     help="iid flake probability at transport.send "
                          "(--distributed mode)")
+    ap.add_argument("--kill-rank", type=int, default=None, metavar="R",
+                    help="elastic-membership soak: an N-rank supervised "
+                         "day loses rank R mid-pass; survivors must adopt "
+                         "its shard ranges and finish bitwise-equal to a "
+                         "fresh (N-1)-rank run of the same day")
+    ap.add_argument("--ranks", type=int, default=4,
+                    help="cluster size for the --kill-rank soak")
     ap.add_argument("--corrupt-rate", type=float, default=0.0, metavar="P",
                     help="iid per-line data corruption probability; "
                          "switches to the quarantine/degrade soak "
@@ -866,6 +1245,8 @@ def main(argv=None):
         return run_serve(args)
     if args.wedge_backend:
         return run_wedge_backend(args)
+    if args.kill_rank is not None:
+        return run_kill_rank(args)
     if args.distributed:
         return run_distributed(args)
     if args.corrupt_rate > 0:

@@ -235,4 +235,27 @@ san = subprocess.run(
 assert san.returncode == 0, f"sanitize replay red:\n{san.stdout}{san.stderr}"
 san_line = san.stdout.strip().splitlines()[-1] if san.stdout.strip() else ""
 print(f"[9] static gates ok: lint clean (empty baseline); {san_line}")
+
+# --- 10. elastic membership: kill-rank soak + committed artifact --------
+# The --kill-rank soak runs a 4-rank supervised day, kills rank 1 mid-
+# pass, and requires the survivors' final digest + per-pass AUC to be
+# bitwise-equal to a fresh 3-rank run; SOAK_ELASTIC.json is the committed
+# record of that gate and must agree with a live re-run.
+_soak_path = os.path.join(os.path.dirname(_here), "SOAK_ELASTIC.json")
+assert os.path.exists(_soak_path), "SOAK_ELASTIC.json missing from the repo"
+with open(_soak_path) as _f:
+    _soak = _json.load(_f)
+assert _soak["ok"] and _soak["bitwise_equal_to_fresh_shrunk_run"], _soak
+assert _soak["auc_equal_per_pass"] and _soak["ownership_epoch_after"] == 1, _soak
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "chaos_probe.py"),
+     "--kill-rank", "1", "--json"],
+    capture_output=True, text=True, timeout=600)
+assert r.returncode == 0, f"kill-rank soak red:\n{r.stdout}{r.stderr}"
+_live = _json.loads(r.stdout.strip().splitlines()[-1])
+assert _live["ok"] and _live["bitwise_equal_to_fresh_shrunk_run"], _live
+print(f"[10] elastic membership ok: rank {_live['killed_rank']} killed "
+      f"mid-pass, {len(_live['survivors'])} survivors adopted "
+      f"{_live['membership_adopts']} range(s), epoch -> "
+      f"{_live['ownership_epoch_after']}, digest+AUC bitwise vs fresh run")
 print("VERIFY DRIVE PASS")
