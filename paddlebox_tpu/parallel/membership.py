@@ -14,17 +14,30 @@ versioned value:
   placement change. Maps are value objects: ``shrink`` (drop dead ranks)
   and ``rebalance`` (same ranks, new boundaries) return new maps at
   epoch+1; every rank derives the identical successor map from the same
-  inputs, so no map ever needs to ride the wire.
+  inputs, so during steady state no map needs to ride the wire.
 - :func:`agree_membership` — the survivor verdict round. The proposed dead
   set is encoded in the collective TAG itself: completing an allgather on
   ``ctl:member:<seq>:<dead>`` proves every live rank proposed exactly that
   set (ranks with divergent views fail into PeerDeadError, union the new
   evidence, and re-enter with the bigger set — convergence is bounded by
   the rank count).
+- :func:`sync_map` — the map-base agreement that follows: survivors
+  allgather their CURRENT map and every rank adopts the highest-epoch one.
+  A rank whose membership round was interrupted mid-install (a second
+  death) re-enters one map behind its peers; without this round each side
+  would derive a successor from a different base — same epoch number,
+  different boundaries — and the epoch checks could never tell. Two maps
+  at the same epoch with different content are split-brain and raise.
 - :func:`adopt_dead_shards` — a survivor pulls the shard ranges it gained
   from the dead rank's last manifest-verified checkpoint (the PR 1/PR 7
   CRC-verified resume path) into its own live table. Pure upsert: a retry
-  after a mid-adopt crash lands bitwise-identical rows.
+  after a mid-adopt crash lands bitwise-identical rows. When the dead
+  chain's recorded ownership epoch predates the current map — the rank
+  died before its post-flip re-anchor save landed — the ranges it gained
+  in that flip are filled from the PREVIOUS owners' chains (``prev_map``):
+  a flip is base-saved before any training resumes, so a stale chain
+  means no pass confirmed since the flip and the previous owner's durable
+  copy is bitwise the boundary state.
 - :func:`plan_rebalance` / :func:`plan_moves` / shard-row wire codec — the
   planned-migration half: boundaries recut at cumulative-load quantiles,
   moving ranges streamed owner->owner over PBTX v3 (codec-framed, CRC'd,
@@ -163,6 +176,15 @@ class OwnershipMap:
 
     # ---- value semantics / wire form ------------------------------------
 
+    def fingerprint(self) -> str:
+        """Short content hash over boundaries + live set + epoch. Rides in
+        verdict tags so two ranks holding divergent maps (same epoch,
+        different boundaries) stall loudly instead of committing a
+        split-brain flip."""
+        import zlib as _zlib
+
+        return f"{_zlib.crc32(self.to_json().encode()):08x}"
+
     def to_json(self) -> str:
         return json.dumps(
             {
@@ -234,6 +256,45 @@ def agree_membership(
     )
 
 
+def sync_map(
+    transport,
+    seq,
+    dead: Sequence[int],
+    my_map: OwnershipMap,
+    timeout: Optional[float] = None,
+) -> OwnershipMap:
+    """Converge every survivor on one base map before deriving a successor.
+
+    Survivors allgather their CURRENT map (the one wire-crossing a map
+    ever does) and adopt the highest-epoch one: a rank whose previous
+    membership round was cut short by a second death re-enters one map
+    behind its peers, and shrinking divergent bases would yield maps with
+    the SAME epoch but DIFFERENT boundaries — undetectable by the epoch
+    checks. The tag embeds the agreed dead set, so this round only runs
+    between ranks that already converged in :func:`agree_membership`.
+    Raises on two same-epoch maps with different content (split-brain —
+    the migrate commit verdict is built to make this impossible).
+    """
+    name = ",".join(str(d) for d in sorted(dead)) if dead else "-"
+    views = transport.allgather(
+        my_map.to_json().encode(), f"ctl:mapsync:{seq}:{name}", timeout=timeout
+    )
+    best = my_map
+    for v in views:
+        if not v:
+            continue  # membership-dead slots contribute b"" placeholders
+        m = OwnershipMap.from_json(v.decode())
+        if m.epoch > best.epoch:
+            best = m
+        elif m.epoch == best.epoch and m != best:
+            raise RuntimeError(
+                f"rank {transport.rank}: ownership split-brain — two maps "
+                f"at epoch {m.epoch} with different boundaries: {best!r} "
+                f"vs {m!r}"
+            )
+    return best
+
+
 # ---- adoption (failure path) --------------------------------------------
 
 
@@ -244,6 +305,7 @@ def adopt_dead_shards(
     old_map: OwnershipMap,
     new_map: OwnershipMap,
     my_rank: int,
+    prev_map: Optional[OwnershipMap] = None,
 ) -> int:
     """Pull the shard range this rank gained from ``dead_rank``'s last
     manifest-verified checkpoint into ``table``; returns keys adopted.
@@ -257,6 +319,17 @@ def adopt_dead_shards(
     checkpointed (death before the first base save) adopts zero keys: the
     retried pass recreates them from the seeded deterministic init, which
     is exactly what a fresh shrunk-membership run does.
+
+    ``prev_map`` (the map the LAST flip replaced, recorded by the
+    supervisor at install time) closes the residual durability window:
+    when the dead chain's recorded ownership epoch predates ``old_map``'s
+    — the rank died during its own post-flip re-anchor save — the ranges
+    it gained in that flip are absent from (or stale leftovers in) its
+    chain. Because every flip base-saves before training resumes, a stale
+    chain implies no pass confirmed since the flip, so the PREVIOUS
+    owners' durable chains hold the exact boundary state; those pieces
+    are filled from them, overwriting any frozen leftover copies the dead
+    chain contributed.
     """
     from paddlebox_tpu.table.sparse_table import HostSparseTable, key_to_shard
     from paddlebox_tpu.train.checkpoint import CheckpointManager, rank_root
@@ -268,22 +341,47 @@ def adopt_dead_shards(
         return 0
     scratch = HostSparseTable(table.layout, table.opt, n_shards=table.n_shards, seed=0)
     ck = CheckpointManager(rank_root(shared_root, dead_rank))
-    if ck.resume(scratch) is None:
-        # cold death: the rank died before its first base save; nothing
-        # durable to adopt, the retried pass recreates its keys from init
-        fire("membership.adopt_shard")
-        STAT_ADD("membership.adopts")
-        return 0
-    keys = scratch.keys()
-    shards = key_to_shard(keys, new_map.n_mesh_shards)
-    keys = keys[(shards >= lo) & (shards < hi)]
-    keys = np.sort(keys)
+    state = ck.resume(scratch)
+    # -1 marks a cold chain: strictly older than any real epoch, so the
+    # fallback below also covers a rank that died before its FIRST save
+    # but after gaining ranges in a flip
+    chain_epoch = -1 if state is None else int(state.get("ownership_epoch", 0))
+    keys = np.zeros(0, dtype=np.uint64)
+    if state is not None:
+        keys = scratch.keys()
+        shards = key_to_shard(keys, new_map.n_mesh_shards)
+        keys = np.sort(keys[(shards >= lo) & (shards < hi)])
     fire("membership.adopt_shard")
     if len(keys):
         table.push(keys, scratch.pull_or_create(keys))
+    n = int(len(keys))
+    if prev_map is not None and chain_epoch < old_map.epoch:
+        for prev_owner in prev_map.live_ranks:
+            plo, phi = prev_map.range_of(prev_owner)
+            plo, phi = max(plo, lo), min(phi, hi)
+            if plo >= phi or int(prev_owner) == int(dead_rank):
+                # the piece the dead rank ALREADY owned at its chain epoch
+                # is authoritatively covered by its own chain above
+                continue
+            fb = HostSparseTable(
+                table.layout, table.opt, n_shards=table.n_shards, seed=0
+            )
+            src = CheckpointManager(rank_root(shared_root, prev_owner))
+            if src.resume(fb) is None:
+                continue
+            fkeys = fb.keys()
+            fsh = key_to_shard(fkeys, new_map.n_mesh_shards)
+            fkeys = np.sort(fkeys[(fsh >= plo) & (fsh < phi)])
+            fire("membership.adopt_shard")
+            if len(fkeys):
+                # overwrite: within this piece the previous owner's chain
+                # is fresher than anything the stale dead chain held
+                table.push(fkeys, fb.pull_or_create(fkeys))
+            n += int((~np.isin(fkeys, keys)).sum())
+            STAT_ADD("membership.adopt_fallbacks")
     STAT_ADD("membership.adopts")
-    STAT_ADD("membership.adopted_keys", int(len(keys)))
-    return int(len(keys))
+    STAT_ADD("membership.adopted_keys", n)
+    return n
 
 
 # ---- planned migration (boundary path) ----------------------------------

@@ -466,7 +466,15 @@ class CheckpointManager:
                 if os.path.exists(os.path.join(day, name)):
                     dense = name
                     break
-        return {"date": cur["date"], "delta_idx": m, "dense": dense}
+        return {
+            "date": cur["date"],
+            "delta_idx": m,
+            "dense": dense,
+            # the epoch this chain was published under: shard adoption
+            # compares it against the live map to detect a chain that
+            # predates the last ownership flip (membership.py)
+            "ownership_epoch": int(cur.get("ownership_epoch", 0)),
+        }
 
     def resume(self, table: HostSparseTable, trainer=None) -> Optional[Dict[str, Any]]:
         """Rebuild the newest durable state into ``table`` (+ trainer dense).

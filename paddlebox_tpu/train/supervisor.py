@@ -156,8 +156,18 @@ class EpochCoordinator:
         # non-elastic runs keep the historical fail-as-abort behavior.
         self.raise_peer_dead = False
 
-    def exchange_verdict(self, key: str, ok: bool, detail: str = ""):
-        """Returns (global_ok, detail) after every rank has voted."""
+    def exchange_verdict(
+        self, key: str, ok: bool, detail: str = "", fatal: bool = False
+    ):
+        """Returns (global_ok, detail) after every rank has voted.
+
+        ``fatal=True`` re-raises a LOCAL transport failure/timeout instead
+        of folding it into a NO vote. A commit-point exchange (the migrate
+        epoch flip) must use it: a rank that times out cannot tell whether
+        its peers committed, and quietly voting NO while they did leaves
+        this rank serving the old map against their new one — split-brain
+        the epoch integer can't detect. Better to die loudly and be shrunk
+        out by the survivors."""
         payload = b"\x01" if ok else b"\x00" + detail.encode()[:512]
         tag = f"ctl:verdict:{key}@e{self.epoch}"
         try:
@@ -169,6 +179,8 @@ class EpochCoordinator:
             return False, f"verdict exchange failed: {e!r}"
         except (OSError, TimeoutError) as e:
             STAT_ADD("supervisor_verdict_exchange_errors")
+            if fatal:
+                raise
             return False, f"verdict exchange failed: {e!r}"
         # membership-confirmed dead ranks contribute b"" placeholder slots,
         # not NO votes
@@ -322,6 +334,10 @@ class PassSupervisor:
         # set when ownership flipped mid-chain: the next checkpoint save
         # re-anchors with a base (a delta must not straddle an epoch flip)
         self._force_base = False
+        # the map the LAST ownership flip replaced: adoption falls back to
+        # it when a dead rank's chain predates the flip (it died before
+        # its own re-anchor save committed)
+        self._prev_ownership = None
         self.round_to = round_to
         self.shrink = shrink
         self.on_give_up = on_give_up
@@ -698,27 +714,74 @@ class PassSupervisor:
             )
         return omap
 
-    def _install_ownership(self, new_map) -> None:
+    def _install_ownership(self, new_map, prev_map=None) -> None:
         """Atomically adopt a successor OwnershipMap: dataset routing,
-        checkpoint epoch, and the forced chain re-anchor flip together."""
+        checkpoint epoch, and the chain re-anchor flip together.
+
+        The re-anchor base save happens HERE, before any training resumes
+        under the new map — not at the next pass boundary. Deferring it
+        opens a window where a rank that dies mid-pass leaves a chain
+        predating the flip: shard ranges it gained in the flip would be
+        absent from (or stale in) that chain, and adoption would silently
+        restore them from the seeded init. A rank whose re-anchor save
+        itself fails raises (PassFailure after retries) and is shrunk out
+        by the survivors, whose adoption then uses the previous owners'
+        chains for its un-anchored gained ranges (``_prev_ownership``).
+
+        ``prev_map`` overrides what is recorded as the map this flip
+        replaced — the membership round passes its SYNCED base so every
+        survivor records the same predecessor, even one that re-entered
+        the round a map behind its peers."""
+        self._prev_ownership = (
+            prev_map if prev_map is not None else self._ownership_map()
+        )
         self.ds.ownership = new_map
         if self.checkpoint is not None:
             self.checkpoint.ownership_epoch = new_map.epoch
         self._force_base = True
         STAT_SET("membership.epoch", new_map.epoch)
+        if self.checkpoint is not None and self._date is not None:
+            self._save_checkpoint("base")
 
     def _handle_rank_death(self, e: PeerDeadError) -> None:
-        """Survivor-side membership change: verdict round -> shrunk map ->
-        shard adoption from the dead ranks' durable checkpoint shards.
+        """Survivor-side membership change: verdict round -> map sync ->
+        shrunk map -> shard adoption from the dead ranks' durable
+        checkpoint shards.
 
-        On return the retried pass runs on N-1 ranks over exactly the
+        Re-entrant under further deaths: a peer dying WHILE the round runs
+        surfaces as a nested PeerDeadError from any of its collectives;
+        rather than killing the day, the new evidence is unioned into the
+        dead set and the whole round re-runs from the refreshed set —
+        bounded by the rank count, since each re-entry strictly grows it.
+
+        On return the retried pass runs on the survivors over exactly the
         table state a fresh shrunk-membership run would hold (adoption is
         an idempotent upsert from the last pass boundary, and keys never
         checkpointed are recreated from the seeded init — both bitwise-
         equal to the fresh run, pinned by tests/test_elastic.py)."""
         assert self.elastic is not None and self.coord is not None
         tp = self.coord.transport
-        tp.mark_dead(e.dead)
+        last = e
+        for round_no in range(tp.n_ranks + 1):
+            tp.mark_dead(last.dead)
+            try:
+                self._membership_round(last)
+                return
+            except PeerDeadError as nested:
+                last = nested
+                self._record(
+                    "rank_death", "retry", round_no,
+                    f"peer died mid-membership-round: {nested!r}",
+                )
+        raise PassFailure(
+            f"membership change did not converge within {tp.n_ranks + 1} "
+            f"rounds; last evidence: {last!r}"
+        ) from last
+
+    def _membership_round(self, e: PeerDeadError) -> None:
+        """One attempt of the membership change; raises PeerDeadError when
+        yet another peer dies mid-round (caller unions and re-enters)."""
+        tp = self.coord.transport
         # revert anything the dying attempt armed before touching the table
         if getattr(self.ds, "_in_pass", False):
             try:
@@ -737,9 +800,20 @@ class PassSupervisor:
         agreed = _membership.agree_membership(
             tp, self._pass_seq, timeout=self.elastic.member_timeout
         )
+        # map sync: a survivor whose PREVIOUS round was cut short by this
+        # death re-enters one map behind its peers; all derive the
+        # successor from the highest-epoch base so epochs and boundaries
+        # agree everywhere (divergent same-epoch maps raise — split-brain)
         old_map = self._ownership_map()
+        base_map = _membership.sync_map(
+            tp, self._pass_seq, agreed, old_map,
+            timeout=self.elastic.member_timeout,
+        )
+        # adoption sources are judged against MY installed map: a rank
+        # that missed an intermediate flip never adopted its pieces, so
+        # for it each dead rank's range is the wider pre-flip one
         newly_dead = [d for d in agreed if old_map.is_live(d)]
-        new_map = old_map.shrink(agreed)
+        new_map = base_map.shrink(agreed)
         my_rank = tp.rank
         adopted_ranges = []
         for d in newly_dead:
@@ -759,6 +833,7 @@ class PassSupervisor:
                     _membership.adopt_dead_shards(
                         self.table, self.elastic.shared_root, d,
                         old_map, new_map, my_rank,
+                        prev_map=self._prev_ownership,
                     )
                     for d in newly_dead
                 )
@@ -771,9 +846,12 @@ class PassSupervisor:
                     self.retry.sleep(self.retry.backoff(a + 1))
         # every survivor must finish adopting before anyone re-enters the
         # pass — and one survivor failing adoption aborts all (the dead
-        # ranges would be served by nobody)
+        # ranges would be served by nobody). The tag carries the successor
+        # map's epoch AND content fingerprint: post-sync these are
+        # identical everywhere, so a mismatch can only mean a protocol
+        # bug — it stalls loudly instead of committing divergent maps.
         ok, detail = self.coord.exchange_verdict(
-            f"member:{self._pass_seq}:{new_map.epoch}",
+            f"member:{self._pass_seq}:{new_map.epoch}:{new_map.fingerprint()}",
             adopt_err is None,
             repr(adopt_err) if adopt_err else "",
         )
@@ -786,7 +864,7 @@ class PassSupervisor:
         if not ok:
             self._record("rank_death", "raise", 0, detail)
             raise PassFailure(f"peer shard adoption failed: {detail}")
-        self._install_ownership(new_map)
+        self._install_ownership(new_map, prev_map=base_map)
         self._record(
             "rank_death", "revert_retry", 0,
             f"dead={list(agreed)} survivors={list(new_map.live_ranks)} "
@@ -838,8 +916,16 @@ class PassSupervisor:
         for r in omap.live_ranks:
             rlo, rhi = omap.range_of(r)
             v = views[r]
-            if len(v) == (rhi - rlo) * 8:
-                loads[rlo:rhi] = np.frombuffer(v, dtype="<i8")
+            if len(v) != (rhi - rlo) * 8:
+                # never recut from a silently zero-filled view: the plan
+                # would be deterministic (all ranks see the same garbage)
+                # yet systematically wrong
+                STAT_ADD("membership.load_view_errors")
+                raise RuntimeError(
+                    f"load view from rank {r} has {len(v)} bytes, expected "
+                    f"{(rhi - rlo) * 8} for shard range [{rlo},{rhi})"
+                )
+            loads[rlo:rhi] = np.frombuffer(v, dtype="<i8")
         new_map = _membership.plan_rebalance(
             omap, loads, self.elastic.migrate_skew
         )
@@ -857,10 +943,30 @@ class PassSupervisor:
             )
         except Exception as me:
             xfer_err = me
-        ok, detail = self.coord.exchange_verdict(
-            f"migrate:{seq}", xfer_err is None,
-            repr(xfer_err) if xfer_err else "",
-        )
+        # the commit verdict must be ATOMIC: a rank whose verdict round
+        # merely times out cannot tell whether peers committed, so folding
+        # the timeout into a local "no" would leave it on the old map while
+        # peers flip — colliding epoch numbers over divergent boundaries.
+        # fatal=True makes local transport failure here raise instead; this
+        # rank dies with PassFailure and the survivors shrink it out. The
+        # tag carries the successor map's content fingerprint so bases that
+        # diverged for any other reason stall loudly rather than commit.
+        try:
+            ok, detail = self.coord.exchange_verdict(
+                f"migrate:{seq}:{new_map.fingerprint()}",
+                xfer_err is None,
+                repr(xfer_err) if xfer_err else "",
+                fatal=True,
+            )
+        except PeerDeadError:
+            raise  # a DEAD peer is decidable — membership handling owns it
+        except (OSError, TimeoutError) as ve:
+            STAT_ADD("membership.migrations_aborted")
+            self._record("migrate_abort", "raise", 0, repr(ve))
+            raise PassFailure(
+                f"migrate commit verdict uncertain (transport failure "
+                f"mid-round): {ve!r}"
+            ) from ve
         if not ok or xfer_err is not None:
             # old epoch still serves; staged pieces are discarded and the
             # plan is re-derived at the next boundary (FLT008 contract)

@@ -193,7 +193,8 @@ def test_dense_retire_spares_cursor_referenced_file(tmp_path):
         table.push(keys, table.pull_or_create(keys) + 1.0)
         cm.save_delta("20260101", table)
     cur = cm.cursor()
-    assert cur == {"date": "20260101", "delta_idx": 3, "dense": "dense-0000.npz"}
+    assert cur == {"date": "20260101", "delta_idx": 3,
+                   "ownership_epoch": 0, "dense": "dense-0000.npz"}
     assert os.path.exists(os.path.join(str(tmp_path), "20260101", "dense-0000.npz"))
     tr2 = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
     tr2.init_params()

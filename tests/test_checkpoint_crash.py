@@ -132,7 +132,8 @@ def test_base_crash_windows_keep_previous_state(tmp_path, hit):
     # the retried save commits, and a restart then sees the live state
     cm.save_base(DATE2, t, d)
     st, t2, d2 = resume_fresh(root)
-    assert st == {"date": DATE2, "delta_idx": 0, "dense": "dense-0000.npz"}
+    assert st == {"date": DATE2, "delta_idx": 0,
+                  "ownership_epoch": 0, "dense": "dense-0000.npz"}
     k, v = state_of(t2)
     lk, lv = state_of(t)
     np.testing.assert_array_equal(k, lk)
@@ -165,7 +166,8 @@ def test_delta_crash_windows_keep_previous_pair(tmp_path, hit):
     cm.save_delta(DATE, t, d)
     assert not os.path.isdir(os.path.join(root, DATE, "delta-0002.tmp"))
     st, t2, d2 = resume_fresh(root)
-    assert st == {"date": DATE, "delta_idx": 2, "dense": "dense-0002.npz"}
+    assert st == {"date": DATE, "delta_idx": 2,
+                  "ownership_epoch": 0, "dense": "dense-0002.npz"}
     k, v = state_of(t2)
     lk, lv = state_of(t)
     np.testing.assert_array_equal(k, lk)
@@ -261,7 +263,8 @@ def test_save_without_dense_carries_dense_name_forward(tmp_path):
     mutate(t, 8)
     cm.save_delta(DATE, t)  # no trainer
     st, t2, d2 = resume_fresh(root)
-    assert st == {"date": DATE, "delta_idx": 2, "dense": "dense-0001.npz"}
+    assert st == {"date": DATE, "delta_idx": 2,
+                  "ownership_epoch": 0, "dense": "dense-0001.npz"}
     np.testing.assert_array_equal(d2.params, d.params)
     k, v = state_of(t2)
     lk, lv = state_of(t)

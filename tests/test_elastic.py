@@ -553,12 +553,21 @@ class _ElasticDS:
 def _elastic_trainer(ds, recorder, kill_at=None):
     """Trainer double: one deterministic transform per pass + per-record
     preds from the GLOBAL row assignment (membership-invariant). A doomed
-    rank closes its transport and dies at the top of its kill pass."""
+    rank closes its transport and dies at the top of its kill pass.
+    ``kill_at`` is a pass index, or ``(pass, visit)`` to die on the n-th
+    attempt of that pass (visit 2 = the retry after a membership round)."""
+    visits = {}
 
     def train_pass(_ds, n_batches=None):
-        if kill_at is not None and ds.pass_idx == kill_at:
-            ds.transport.close()
-            raise _RankKilled()
+        if kill_at is not None:
+            k_pass, k_visit = (
+                kill_at if isinstance(kill_at, tuple) else (kill_at, 1)
+            )
+            if ds.pass_idx == k_pass:
+                visits[k_pass] = visits.get(k_pass, 0) + 1
+                if visits[k_pass] >= k_visit:
+                    ds.transport.close()
+                    raise _RankKilled()
         ds.dev = ds.dev * np.float32(1.01) + np.float32(0.25)
         preds, labels = [], []
         for keys, label in ds.my_records:
@@ -640,11 +649,14 @@ def _pass_auc(recorder, p):
 
 
 def _run_day(n, root, seed, recorder, kill_rank=None, kill_at=None,
-             skewed=False, migrate_skew=0.0, passes=3):
+             skewed=False, migrate_skew=0.0, passes=3, kills=None):
+    kills = dict(kills or {})
+    if kill_rank is not None:
+        kills[kill_rank] = kill_at
     tps = _cluster(n)
     sups = [
         _mk_sup(r, tps, root, seed, recorder,
-                kill_at=(kill_at if r == kill_rank else None),
+                kill_at=kills.get(r),
                 skewed=skewed, migrate_skew=migrate_skew)
         for r in range(n)
     ]
@@ -794,3 +806,215 @@ def test_migrate_fault_aborts_then_next_boundary_commits(tmp_path):
     zk, zv = _merged_digest(sups_0, [0, 1, 2])
     np.testing.assert_array_equal(fk, zk)
     np.testing.assert_array_equal(fv, zv)
+
+
+# ---------------------------------------------------------------------------
+# durability of the epoch flip: death in every post-flip window
+# ---------------------------------------------------------------------------
+
+
+def test_death_after_migration_commit_bitwise_equals_fresh_run(tmp_path):
+    """The migrate epoch flip is durable BEFORE training resumes. Rank 1
+    gains the hot shards in the boundary migration after pass 0 and dies
+    mid-pass-1: adoption must restore its migrated-in trained rows from
+    the re-anchored (post-flip) chain. Deferring the re-anchor save to
+    the next boundary loses them — they exist durably nowhere, and the
+    survivors would silently recreate them from the seeded init."""
+    seed, passes = 13, 3
+    config.set_flag("transport_peer_dead_s", 0.6)
+    try:
+        rec_e = {}
+        sups, res = _run_day(
+            3, str(tmp_path / "mig_kill"), seed, rec_e, skewed=True,
+            migrate_skew=1.15, kill_rank=1, kill_at=1, passes=passes,
+        )
+    finally:
+        config.set_flag("transport_peer_dead_s", 60.0)
+    assert res[1] == "killed"
+    survivors = [0, 2]
+    for r in survivors:
+        assert len(res[r]) == passes and all(o is not None for o in res[r])
+        kinds = [i.kind for i in sups[r].incidents]
+        assert "migrate" in kinds and "rank_death" in kinds
+        omap = sups[r].ds.ownership
+        # at least the migrate flip + the death flip (the survivors may
+        # legitimately recut again at a later boundary)
+        assert omap is not None and omap.epoch >= 2
+        assert list(omap.live_ranks) == survivors
+    rec_f = {}
+    sups_f, res_f = _run_day(2, str(tmp_path / "fresh"), seed, rec_f,
+                             skewed=True, passes=passes)
+    assert all(len(r) == passes for r in res_f)
+    ek, ev = _merged_digest(sups, survivors)
+    fk, fv = _merged_digest(sups_f, [0, 1])
+    np.testing.assert_array_equal(ek, fk)
+    np.testing.assert_array_equal(ev, fv)
+    for p in range(passes):
+        np.testing.assert_array_equal(_pass_auc(rec_e, p), _pass_auc(rec_f, p))
+
+
+def test_two_ranks_die_same_pass_bitwise_equals_fresh_run(tmp_path):
+    """Two simultaneous deaths: the second dead rank surfaces either in
+    the agreed set at once or as a nested PeerDeadError mid-round — the
+    re-entrant membership handling must converge instead of killing the
+    day, and the result is still bitwise a fresh 2-rank run."""
+    seed, passes = 17, 3
+    config.set_flag("transport_peer_dead_s", 0.6)
+    try:
+        rec_e = {}
+        sups, res = _run_day(
+            4, str(tmp_path / "double"), seed, rec_e,
+            kills={1: 1, 2: 1}, passes=passes,
+        )
+    finally:
+        config.set_flag("transport_peer_dead_s", 60.0)
+    assert res[1] == "killed" and res[2] == "killed"
+    survivors = [0, 3]
+    for r in survivors:
+        assert len(res[r]) == passes and all(o is not None for o in res[r])
+        omap = sups[r].ds.ownership
+        assert omap is not None and list(omap.live_ranks) == survivors
+        assert "rank_death" in [i.kind for i in sups[r].incidents]
+    rec_f = {}
+    sups_f, res_f = _run_day(2, str(tmp_path / "fresh"), seed, rec_f,
+                             passes=passes)
+    assert all(len(r) == passes for r in res_f)
+    ek, ev = _merged_digest(sups, survivors)
+    fk, fv = _merged_digest(sups_f, [0, 1])
+    np.testing.assert_array_equal(ek, fk)
+    np.testing.assert_array_equal(ev, fv)
+    for p in range(passes):
+        np.testing.assert_array_equal(_pass_auc(rec_e, p), _pass_auc(rec_f, p))
+
+
+def test_death_during_retried_pass_adopts_reanchored_chain(tmp_path):
+    """The death-adoption flip has the same durability window as the
+    migrate flip: rank 1 dies at pass 1; rank 2 survives that membership
+    round — adopting part of rank 1's range and re-anchoring at the new
+    epoch — then dies during the RETRIED pass 1, before any boundary
+    save. The shard range it gained from rank 1 is durable ONLY in the
+    immediate re-anchor base; adoption from it must land pass-0 training
+    for those shards bitwise."""
+    seed, passes = 19, 3
+    config.set_flag("transport_peer_dead_s", 0.6)
+    try:
+        rec_e = {}
+        sups, res = _run_day(
+            4, str(tmp_path / "stagger"), seed, rec_e,
+            kills={1: 1, 2: (1, 2)}, passes=passes,
+        )
+    finally:
+        config.set_flag("transport_peer_dead_s", 60.0)
+    assert res[1] == "killed" and res[2] == "killed"
+    survivors = [0, 3]
+    for r in survivors:
+        assert len(res[r]) == passes and all(o is not None for o in res[r])
+        omap = sups[r].ds.ownership
+        # two sequential shrinks, two flips
+        assert omap is not None and omap.epoch == 2
+        assert list(omap.live_ranks) == survivors
+    # rank 2 recorded preds on its FIRST (reverted) attempt of pass 1
+    # before dying on the retry; drop that stale entry so the per-pass
+    # AUC below sees the survivors' record multiset exactly once
+    rec_e.pop((2, 1))
+    rec_f = {}
+    sups_f, res_f = _run_day(2, str(tmp_path / "fresh"), seed, rec_f,
+                             passes=passes)
+    assert all(len(r) == passes for r in res_f)
+    ek, ev = _merged_digest(sups, survivors)
+    fk, fv = _merged_digest(sups_f, [0, 1])
+    np.testing.assert_array_equal(ek, fk)
+    np.testing.assert_array_equal(ev, fv)
+    for p in range(passes):
+        np.testing.assert_array_equal(_pass_auc(rec_e, p), _pass_auc(rec_f, p))
+
+
+def test_adopt_fallback_uses_previous_owners_chain(tmp_path):
+    """Unit contract for the residual window the end-to-end tests close:
+    a dead chain whose recorded epoch predates the installed map (the
+    rank died during its own re-anchor save) cannot cover the ranges it
+    gained in that flip — adoption falls back to the PREVIOUS owners'
+    chains for exactly those pieces, bitwise."""
+    root = str(tmp_path)
+    m0 = OwnershipMap.even(N_MESH, 4)  # r1 owns [2,4)
+    m1 = m0.shrink([1])                # r2 gained shard 3; epoch 1
+    m2 = m1.shrink([2])                # r0 gains [3,4), r3 gains [4,6)
+    # rank 1's durable chain (epoch 0) holds trained rows for every shard
+    # it hosted — including shard 3, which rank 2 gained at the m1 flip
+    src = _seed_dead_checkpoint(root, 1)
+    # rank 2 died before its re-anchor save: chain stuck at epoch 0,
+    # covering only its ORIGINAL range [4,6)
+    t2 = _mk_table()
+    keys = np.arange(1, 90, dtype=np.uint64)
+    sh = key_to_shard(keys, N_MESH)
+    mine2 = keys[(sh >= 4) & (sh < 6)]
+    t2.push(mine2, t2.pull_or_create(mine2) * np.float32(1.02))
+    CheckpointManager(rank_root(root, 2)).save_base(DATE, t2)
+
+    # without prev_map the gained piece [3,4) is silently absent
+    bare = _mk_table()
+    assert adopt_dead_shards(bare, root, 2, m1, m2, 0) == 0
+    assert len(bare.keys()) == 0
+
+    # with prev_map the piece comes bitwise from rank 1's chain
+    fb_before = STAT_GET("membership.adopt_fallbacks")
+    t = _mk_table()
+    want = np.sort(keys[sh == 3])
+    assert len(want) > 0
+    assert adopt_dead_shards(t, root, 2, m1, m2, 0, prev_map=m0) == len(want)
+    assert STAT_GET("membership.adopt_fallbacks") == fb_before + 1
+    np.testing.assert_array_equal(np.sort(t.keys()), want)
+    np.testing.assert_array_equal(
+        t.pull_or_create(want), src.pull_or_create(want)
+    )
+
+    # rank 3's piece [4,6) is covered by the dead chain itself: the
+    # fallback skips prev_owner == dead_rank, no double restore
+    t3 = _mk_table()
+    n3 = adopt_dead_shards(t3, root, 2, m1, m2, 3, prev_map=m0)
+    assert n3 == len(mine2)
+    np.testing.assert_array_equal(np.sort(t3.keys()), np.sort(mine2))
+    np.testing.assert_array_equal(
+        t3.pull_or_create(mine2), t2.pull_or_create(mine2)
+    )
+
+
+def test_exchange_verdict_fatal_raises_on_local_timeout():
+    """A commit-point verdict must not fold a local transport timeout
+    into a quiet NO vote (the rank cannot know whether peers committed):
+    fatal=True re-raises, the default keeps the historical abort vote."""
+    from paddlebox_tpu.train.supervisor import EpochCoordinator
+
+    class _TimeoutTransport:
+        rank = 0
+        n_ranks = 2
+
+        def allgather(self, payload, tag, timeout=None):
+            raise TimeoutError("verdict round timed out")
+
+    coord = EpochCoordinator(_TimeoutTransport())
+    ok, detail = coord.exchange_verdict("migrate:x", True)
+    assert not ok and "timed out" in detail
+    with pytest.raises(TimeoutError):
+        coord.exchange_verdict("migrate:x", True, fatal=True)
+
+
+def test_migrate_load_view_size_mismatch_raises(tmp_path):
+    """A mis-sized per-rank load view aborts the recut loudly (counter +
+    raise) instead of silently zero-filling it — every rank would derive
+    the same deterministic-but-wrong plan from the dropped view."""
+    rec = {}
+    tps = _cluster(2)
+    try:
+        sup = _mk_sup(0, tps, str(tmp_path), 3, rec, migrate_skew=1.1)
+        good = np.ones(4, "<i8").tobytes()
+        sup.coord.transport.allgather = (
+            lambda payload, tag, timeout=None: [good, good[:-8]]
+        )
+        before = STAT_GET("membership.load_view_errors")
+        with pytest.raises(RuntimeError, match="load view"):
+            sup._maybe_migrate()
+        assert STAT_GET("membership.load_view_errors") == before + 1
+    finally:
+        for t in tps:
+            t.close()
