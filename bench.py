@@ -503,7 +503,20 @@ def main():
             k: round(float(STAT_GET(f"boundary.{k}")), 4)
             for k in (
                 "premerge_s", "prefetch_pull_s", "dedup_s", "pull_s",
-                "splice_s", "writeback_s", "overlap_hidden_s",
+                "splice_s", "writeback_s", "writeback_hidden_s",
+                "overlap_hidden_s",
+            )
+        },
+        # writer-pool writeback internals (table.writeback.* gauges from
+        # PassWorkingSet.writeback + the native io counters published at
+        # end_pass): pool size, chunk pipeline wait vs hidden seconds,
+        # and the spill stage writers' gather/fwrite split
+        "writeback_stages": {
+            k: round(float(STAT_GET(f"table.writeback.{k}")), 4)
+            for k in (
+                "threads", "chunks", "push_s", "wait_s", "hidden_s",
+                "spill_gather_s", "spill_fwrite_s", "prepass_read_s",
+                "stage_flushes", "stage_bytes",
             )
         },
         # distribution view of the same stages (obs histograms): the

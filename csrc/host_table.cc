@@ -23,6 +23,9 @@
 // thread-safe via per-shard mutexes.
 
 #include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -95,6 +98,17 @@ struct Shard {
   }
 };
 
+// Cumulative IO-overlap telemetry (pbx_table_io_stats). Atomics because
+// shard workers update them concurrently; pure observation — none of these
+// feed back into table state, so they cannot perturb bitwise results.
+struct IoStats {
+  std::atomic<int64_t> spill_gather_ns{0};   // row serialize into staging
+  std::atomic<int64_t> spill_fwrite_ns{0};   // staged fwrite (flusher side)
+  std::atomic<int64_t> prepass_read_ns{0};   // push pre-pass header freads
+  std::atomic<int64_t> stage_flushes{0};     // staged buffers handed off
+  std::atomic<int64_t> stage_bytes{0};       // bytes through the stage path
+};
+
 struct Table {
   int n_shards;
   int width;
@@ -107,10 +121,17 @@ struct Table {
   int64_t epoch = 0;      // incremented by decay_shrink (pass boundary)
   float last_decay = 1.0f;
   float last_threshold = 0.0f;
+  IoStats io;
   std::vector<Shard> shards;
 
   Table(int ns) : shards(ns) {}
 };
+
+inline int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 inline int shard_of(const Table* t, uint64_t key) {
   return (int)(mix_shard(key) % (uint64_t)t->n_shards);
@@ -262,9 +283,23 @@ int64_t promote(Table* t, Shard* s, uint64_t j, bool seek_end = true) {
 }
 
 // Partition keys by shard once, then run fn(shard_id, key_positions) over
-// shards on a small thread pool (ctypes released the GIL for us).
+// shards on a thread pool (ctypes released the GIL for us). Each worker
+// owns the strided shard set {w, w+nt, ...} — disjoint ownership, so any
+// per-shard side output (shard_ns below) is written race-free without a
+// merge lock; per-shard mutexes still guard against concurrent API calls.
+//
+// `threads` <= 0 picks the legacy auto heuristic (hardware concurrency
+// capped at 16, serial below 64k keys); `threads` == 1 forces the serial
+// path; larger values request an explicit pool (capped at n_shards). The
+// shard visit ORDER inside a worker and the per-shard work are identical
+// at every thread count — only interleaving differs, which per-shard locks
+// make unobservable — so results are bitwise-equal across `threads`.
+//
+// `shard_ns`, when non-null, receives per-shard wall nanoseconds spent in
+// fn (length n_shards; written by the owning worker only).
 template <typename Fn>
-int for_shards(const Table* t, const uint64_t* keys, int64_t n, Fn fn) {
+int for_shards_ex(const Table* t, const uint64_t* keys, int64_t n,
+                  int threads, int64_t* shard_ns, Fn fn) {
   int ns = t->n_shards;
   std::vector<int64_t> count(ns, 0);
   std::vector<int> sh((size_t)n);
@@ -278,15 +313,25 @@ int for_shards(const Table* t, const uint64_t* keys, int64_t n, Fn fn) {
   std::vector<int64_t> pos(start.begin(), start.end() - 1);
   std::vector<int64_t> order((size_t)n);
   for (int64_t i = 0; i < n; ++i) order[pos[sh[i]]++] = i;
+  if (shard_ns)
+    for (int s = 0; s < ns; ++s) shard_ns[s] = 0;
 
-  int nt = (int)std::thread::hardware_concurrency();
+  int nt;
+  if (threads > 0) {
+    nt = threads;
+  } else {
+    nt = (int)std::thread::hardware_concurrency();
+    if (nt > 16) nt = 16;
+    if (n < 65536) nt = 1;
+  }
   if (nt > ns) nt = ns;
-  if (nt > 16) nt = 16;
-  if (n < 65536 || nt <= 1) nt = 1;
-  std::vector<int> rc(nt > 0 ? nt : 1, 0);
+  if (nt < 1) nt = 1;
+  std::vector<int> rc(nt, 0);
   auto work = [&](int w) {
     for (int s = w; s < ns; s += nt) {
+      int64_t t0 = shard_ns ? now_ns() : 0;
       int r = fn(s, order.data() + start[s], count[s]);
+      if (shard_ns) shard_ns[s] = now_ns() - t0;
       if (r != 0) rc[w] = r;
     }
   };
@@ -300,6 +345,11 @@ int for_shards(const Table* t, const uint64_t* keys, int64_t n, Fn fn) {
   for (int w = 0; w < (int)rc.size(); ++w)
     if (rc[w] != 0) return rc[w];
   return 0;
+}
+
+template <typename Fn>
+int for_shards(const Table* t, const uint64_t* keys, int64_t n, Fn fn) {
+  return for_shards_ex(t, keys, n, /*threads=*/0, /*shard_ns=*/nullptr, fn);
 }
 
 // Rewrite one shard's spill file with only the LIVE records (hash entries
@@ -352,27 +402,125 @@ int64_t compact_spill(Table* t, Shard* s) {
 
 enum : int { kSpillFifo = 0, kSpillFreq = 1 };
 
+// Serialize victims[lo..hi) of one shard into `out` as the exact byte
+// stream the legacy per-record fwrite loop produced: SpillRec header
+// followed by width floats, in victim order.
+void gather_spill_chunk(const Table* t, const Shard* s,
+                        const std::vector<int64_t>& victims, int64_t lo,
+                        int64_t hi, size_t recsz, std::vector<char>* out) {
+  out->resize((size_t)(hi - lo) * recsz);
+  char* p = out->data();
+  for (int64_t i = lo; i < hi; ++i) {
+    int64_t r = victims[i];
+    SpillRec rec{s->row_key[r], t->epoch, s->row_touched[r] ? 1ull : 0ull};
+    std::memcpy(p, &rec, sizeof(rec));
+    std::memcpy(p + sizeof(rec), &s->values[r * (int64_t)t->width],
+                sizeof(float) * t->width);
+    p += recsz;
+  }
+}
+
 // Write the given mem rows (any order) of one shard to its spill file,
 // convert their hash entries to kDisk, and compact the surviving mem rows
 // in place. Caller holds the shard lock and has opened the spill file.
 // Returns rows spilled, or -2 on IO error.
+//
+// The write is double-buffered: records are append-only with a fixed size,
+// so every victim's disk offset is analytic (base + i*recsz) and the next
+// chunk's row gather can run while a flusher thread has the previous
+// chunk's fwrite in flight. The byte stream is identical to the legacy
+// per-record loop; on an IO error the hash/counter state is untouched
+// (strictly cleaner than the legacy mid-loop bail, which had already
+// bumped n_disk_touched for the records it got through).
 int64_t shard_spill_rows(Table* t, Shard* s,
                          const std::vector<int64_t>& victims) {
   if (victims.empty()) return 0;
   fseeko(s->spill, 0, SEEK_END);
+  const int64_t base = ftello(s->spill);
+  const size_t recsz = sizeof(SpillRec) + sizeof(float) * (size_t)t->width;
+  const int64_t nv = (int64_t)victims.size();
   std::vector<uint8_t> is_victim(s->n_rows, 0);
   std::vector<int64_t> disk_off(s->n_rows, 0);
-  for (int64_t r : victims) {
-    int64_t off = ftello(s->spill);
-    SpillRec rec{s->row_key[r], t->epoch, s->row_touched[r] ? 1ull : 0ull};
-    if (fwrite(&rec, sizeof(rec), 1, s->spill) != 1 ||
-        fwrite(&s->values[r * t->width], sizeof(float), t->width, s->spill) !=
-            (size_t)t->width)
-      return -2;
+  int64_t touched_delta = 0;
+  for (int64_t i = 0; i < nv; ++i) {
+    int64_t r = victims[i];
     is_victim[r] = 1;
-    disk_off[r] = off;
-    if (s->row_touched[r]) s->n_disk_touched++;
+    disk_off[r] = base + i * (int64_t)recsz;
+    if (s->row_touched[r]) touched_delta++;
   }
+  // ~1 MiB staging chunks: big enough that fwrite syscall/lock overhead
+  // amortizes, small enough that two buffers stay cache-friendly
+  int64_t chunk = (int64_t)((1u << 20) / recsz);
+  if (chunk < 64) chunk = 64;
+  int64_t gather_ns = 0, fwrite_ns = 0, flushes = 0;
+  bool werr = false;
+  if (nv <= chunk) {
+    // small spill: one gather, one fwrite — no thread, same bytes
+    std::vector<char> buf;
+    int64_t t0 = now_ns();
+    gather_spill_chunk(t, s, victims, 0, nv, recsz, &buf);
+    gather_ns = now_ns() - t0;
+    t0 = now_ns();
+    if (fwrite(buf.data(), 1, buf.size(), s->spill) != buf.size()) werr = true;
+    fwrite_ns = now_ns() - t0;
+    flushes = 1;
+  } else {
+    // two staging buffers in ping-pong: the main thread gathers chunk k+1
+    // while the flusher writes chunk k. Only the flusher touches s->spill
+    // between here and the join.
+    std::vector<char> bufs[2];
+    std::mutex m;
+    std::condition_variable cv;
+    int pending = -1;  // buffer index handed to the flusher, -1 = none
+    bool done = false;
+    std::thread flusher([&] {
+      std::unique_lock<std::mutex> lk(m);
+      while (true) {
+        cv.wait(lk, [&] { return pending >= 0 || done; });
+        if (pending < 0) return;
+        int b = pending;
+        lk.unlock();
+        int64_t t0 = now_ns();
+        size_t wr = fwrite(bufs[b].data(), 1, bufs[b].size(), s->spill);
+        int64_t dt = now_ns() - t0;
+        lk.lock();
+        fwrite_ns += dt;
+        pending = -1;
+        if (wr != bufs[b].size()) {
+          werr = true;
+          done = true;
+        }
+        cv.notify_all();
+      }
+    });
+    int cur = 0;
+    for (int64_t lo = 0; lo < nv; lo += chunk) {
+      int64_t hi = std::min(nv, lo + chunk);
+      int64_t t0 = now_ns();
+      gather_spill_chunk(t, s, victims, lo, hi, recsz, &bufs[cur]);
+      gather_ns += now_ns() - t0;
+      std::unique_lock<std::mutex> lk(m);
+      cv.wait(lk, [&] { return pending < 0; });
+      if (werr) break;
+      pending = cur;
+      flushes++;
+      cv.notify_all();
+      cur ^= 1;
+    }
+    {
+      std::unique_lock<std::mutex> lk(m);
+      cv.wait(lk, [&] { return pending < 0; });  // drain the last chunk
+      done = true;
+      cv.notify_all();
+    }
+    flusher.join();
+  }
+  t->io.spill_gather_ns += gather_ns;
+  t->io.spill_fwrite_ns += fwrite_ns;
+  t->io.stage_flushes += flushes;
+  t->io.stage_bytes += nv * (int64_t)recsz;
+  if (werr) return -2;
+  s->n_disk_touched += touched_delta;
   fflush(s->spill);
   // compact survivors
   std::vector<int64_t> remap(s->n_rows, -1);
@@ -659,80 +807,161 @@ int pbx_table_pull_or_create(void* h, const uint64_t* keys, int64_t n,
   });
 }
 
+namespace {
+
+// One shard's slice of a push batch. Caller dispatch holds nothing; the
+// shard lock is taken here. Shared by pbx_table_push (auto thread
+// heuristic) and pbx_table_push_mt (explicit writer pool).
+int push_shard_batch(Table* t, int si, const uint64_t* keys,
+                     const float* rows, const int64_t* idx, int64_t m) {
+  Shard* s = &t->shards[si];
+  std::lock_guard<std::mutex> g(s->mtx);
+  while ((s->mask + 1) * 7 < (uint64_t)(s->n_used + m + 1) * 10)
+    shard_grow_hash(s);
+  // disk-resident keys in this batch are fully overwritten below — only
+  // the header's touched bit matters. Read those headers in file-offset
+  // order (one sequential sweep, same trick as the batched promote in
+  // pull) instead of an fseeko pair per superseded record. The reads are
+  // double-buffered: a reader thread freads chunk k+1's headers while
+  // this thread applies chunk k's hash/counter updates (the apply side
+  // never touches the FILE*, so the handoff is the only sync point).
+  if (s->n_disk >= 64) {
+    std::vector<std::pair<int64_t, uint64_t>> hits;  // (offset, key)
+    for (int64_t q = 0; q < m; ++q) {
+      bool found;
+      uint64_t j = shard_find(s, keys[idx[q]], &found);
+      if (found && s->hstate[j] == kDisk)
+        hits.emplace_back(s->hval[j], s->hkeys[j]);
+    }
+    std::sort(hits.begin(), hits.end());
+    const int64_t nh = (int64_t)hits.size();
+    const int64_t chunk = 512;
+    auto read_chunk = [&](int64_t lo, int64_t hi,
+                          std::vector<SpillRec>* out) -> int {
+      out->resize((size_t)(hi - lo));
+      int64_t t0 = now_ns();
+      for (int64_t i = lo; i < hi; ++i) {
+        fseeko(s->spill, hits[i].first, SEEK_SET);
+        if (fread(&(*out)[i - lo], sizeof(SpillRec), 1, s->spill) != 1) {
+          t->io.prepass_read_ns += now_ns() - t0;
+          return -2;
+        }
+      }
+      t->io.prepass_read_ns += now_ns() - t0;
+      return 0;
+    };
+    auto apply_chunk = [&](int64_t lo, int64_t hi,
+                           const std::vector<SpillRec>& recs) {
+      for (int64_t i = lo; i < hi; ++i) {
+        bool found;
+        uint64_t j = shard_find(s, hits[i].second, &found);
+        if (!found || s->hstate[j] != kDisk) continue;  // dup in batch
+        if (recs[i - lo].touched) s->n_disk_touched--;
+        s->n_disk--;
+        s->dead_disk++;  // the superseded on-disk record is garbage now
+        // row contents stay undefined until the main loop's memcpy — every
+        // pre-pass key is in this batch, so each gets overwritten below
+        int64_t row = shard_new_row(t, s, hits[i].second);
+        s->hval[j] = row;
+        s->hstate[j] = kMem;
+      }
+    };
+    if (nh <= 2 * chunk) {
+      std::vector<SpillRec> recs;
+      if (nh > 0) {
+        if (read_chunk(0, nh, &recs) != 0) return -2;
+        apply_chunk(0, nh, recs);
+      }
+    } else {
+      std::vector<SpillRec> bufs[2];
+      int rerr = read_chunk(0, chunk, &bufs[0]);
+      int cur = 0;
+      for (int64_t lo = 0; lo < nh; lo += chunk) {
+        if (rerr != 0) return -2;
+        int64_t hi = std::min(nh, lo + chunk);
+        int64_t nlo = hi, nhi = std::min(nh, hi + chunk);
+        std::thread reader;
+        if (nlo < nhi)
+          reader = std::thread(
+              [&, nlo, nhi, cur] { rerr = read_chunk(nlo, nhi, &bufs[cur ^ 1]); });
+        apply_chunk(lo, hi, bufs[cur]);
+        if (reader.joinable()) reader.join();
+        cur ^= 1;
+      }
+    }
+    if (nh > 0) fseeko(s->spill, 0, SEEK_END);
+  }
+  for (int64_t q = 0; q < m; ++q) {
+    int64_t i = idx[q];
+    uint64_t key = keys[i];
+    bool found;
+    uint64_t j = shard_find(s, key, &found);
+    int64_t row;
+    if (!found) {
+      row = shard_new_row(t, s, key);
+      s->hkeys[j] = key;
+      s->hval[j] = row;
+      s->hstate[j] = kMem;
+      s->n_used++;
+    } else if (s->hstate[j] == kDisk) {
+      // full-row overwrite: only the header's touched bit matters
+      SpillRec rec;
+      fseeko(s->spill, s->hval[j], SEEK_SET);
+      if (fread(&rec, sizeof(rec), 1, s->spill) != 1) return -2;
+      fseeko(s->spill, 0, SEEK_END);
+      if (rec.touched) s->n_disk_touched--;
+      s->n_disk--;
+      s->dead_disk++;  // the superseded on-disk record is garbage now
+      row = shard_new_row(t, s, key);
+      s->hval[j] = row;
+      s->hstate[j] = kMem;
+    } else {
+      row = s->hval[j];
+    }
+    std::memcpy(&s->values[row * t->width], rows + i * t->width,
+                sizeof(float) * t->width);
+    s->row_touched[row] = 1;
+    s->row_epoch[row] = t->epoch;  // a push is a touch
+  }
+  return 0;
+}
+
+}  // namespace
+
 // Batch push (upsert full rows) + mark touched. Returns 0 or negative.
 int pbx_table_push(void* h, const uint64_t* keys, const float* rows,
                    int64_t n) {
   Table* t = (Table*)h;
   return for_shards(t, keys, n, [&](int si, const int64_t* idx, int64_t m) {
-    Shard* s = &t->shards[si];
-    std::lock_guard<std::mutex> g(s->mtx);
-    while ((s->mask + 1) * 7 < (uint64_t)(s->n_used + m + 1) * 10)
-      shard_grow_hash(s);
-    // disk-resident keys in this batch are fully overwritten below — only
-    // the header's touched bit matters. Read those headers in file-offset
-    // order (one sequential sweep, same trick as the batched promote in
-    // pull) instead of an fseeko pair per superseded record.
-    if (s->n_disk >= 64) {
-      std::vector<std::pair<int64_t, uint64_t>> hits;  // (offset, key)
-      for (int64_t q = 0; q < m; ++q) {
-        bool found;
-        uint64_t j = shard_find(s, keys[idx[q]], &found);
-        if (found && s->hstate[j] == kDisk)
-          hits.emplace_back(s->hval[j], s->hkeys[j]);
-      }
-      std::sort(hits.begin(), hits.end());
-      SpillRec rec;
-      for (auto& hit : hits) {
-        bool found;
-        uint64_t j = shard_find(s, hit.second, &found);
-        if (!found || s->hstate[j] != kDisk) continue;  // dup in batch
-        fseeko(s->spill, hit.first, SEEK_SET);
-        if (fread(&rec, sizeof(rec), 1, s->spill) != 1) return -2;
-        if (rec.touched) s->n_disk_touched--;
-        s->n_disk--;
-        s->dead_disk++;  // the superseded on-disk record is garbage now
-        // row contents stay undefined until the main loop's memcpy — every
-        // pre-pass key is in this batch, so each gets overwritten below
-        int64_t row = shard_new_row(t, s, hit.second);
-        s->hval[j] = row;
-        s->hstate[j] = kMem;
-      }
-      if (!hits.empty()) fseeko(s->spill, 0, SEEK_END);
-    }
-    for (int64_t q = 0; q < m; ++q) {
-      int64_t i = idx[q];
-      uint64_t key = keys[i];
-      bool found;
-      uint64_t j = shard_find(s, key, &found);
-      int64_t row;
-      if (!found) {
-        row = shard_new_row(t, s, key);
-        s->hkeys[j] = key;
-        s->hval[j] = row;
-        s->hstate[j] = kMem;
-        s->n_used++;
-      } else if (s->hstate[j] == kDisk) {
-        // full-row overwrite: only the header's touched bit matters
-        SpillRec rec;
-        fseeko(s->spill, s->hval[j], SEEK_SET);
-        if (fread(&rec, sizeof(rec), 1, s->spill) != 1) return -2;
-        fseeko(s->spill, 0, SEEK_END);
-        if (rec.touched) s->n_disk_touched--;
-        s->n_disk--;
-        s->dead_disk++;  // the superseded on-disk record is garbage now
-        row = shard_new_row(t, s, key);
-        s->hval[j] = row;
-        s->hstate[j] = kMem;
-      } else {
-        row = s->hval[j];
-      }
-      std::memcpy(&s->values[row * t->width], rows + i * t->width,
-                  sizeof(float) * t->width);
-      s->row_touched[row] = 1;
-      s->row_epoch[row] = t->epoch;  // a push is a touch
-    }
-    return 0;
+    return push_shard_batch(t, si, keys, rows, idx, m);
   });
+}
+
+// Batch push with an explicit writer pool: `threads` <= 0 = auto heuristic
+// (identical to pbx_table_push), 1 = forced serial, else a fixed pool of
+// min(threads, n_shards) workers each owning a disjoint strided shard set.
+// Bitwise-equal to pbx_table_push at every thread count (see for_shards_ex).
+// `shard_ns`, when non-null, receives per-shard wall nanoseconds (length
+// n_shards) — the per-shard histogram feed. Returns 0 or negative.
+int pbx_table_push_mt(void* h, const uint64_t* keys, const float* rows,
+                      int64_t n, int threads, int64_t* shard_ns) {
+  Table* t = (Table*)h;
+  return for_shards_ex(t, keys, n, threads, shard_ns,
+                       [&](int si, const int64_t* idx, int64_t m) {
+                         return push_shard_batch(t, si, keys, rows, idx, m);
+                       });
+}
+
+// Cumulative IO-overlap telemetry, 5 int64 slots:
+//   [spill_gather_ns, spill_fwrite_ns, prepass_read_ns, stage_flushes,
+//    stage_bytes]
+void pbx_table_io_stats(void* h, int64_t* out) {
+  Table* t = (Table*)h;
+  out[0] = t->io.spill_gather_ns.load();
+  out[1] = t->io.spill_fwrite_ns.load();
+  out[2] = t->io.prepass_read_ns.load();
+  out[3] = t->io.stage_flushes.load();
+  out[4] = t->io.stage_bytes.load();
 }
 
 // Pass-boundary decay + shrink over the MEM tier (disk rows catch up

@@ -80,6 +80,18 @@ config.define_flag(
     "already done; 0 = classic serial boundary",
 )
 config.define_flag(
+    "overlap_writeback",
+    1,
+    "kick the end-of-pass host writeback the moment the trained table "
+    "lands (kick_writeback, called by the supervisor right after "
+    "train_pass): the boundary worker joins the kick instead of writing "
+    "back inline, so boundary.writeback_s records only the residual "
+    "blocking tail and the hidden seconds flow into overlap_hidden_s. "
+    "Safe under an armed guard (rollback covers partial writeback; "
+    "revert_pass cancels the kick at a chunk boundary); 0 = classic "
+    "writeback inside the boundary worker",
+)
+config.define_flag(
     "boundary_prefetch_pull",
     1,
     "with boundary_pipeline: the feed stage pull_or_creates host rows for "
@@ -176,6 +188,47 @@ class LocalShuffleRouter:
                 self._collected = 0
                 self._cond.notify_all()  # wake exchangers blocked on the barrier
         return out
+
+
+def _trained_to_host(arr, layout) -> np.ndarray:
+    """Device trained table -> host ndarray, honoring the boundary wire
+    format. Shared by the boundary worker's classic writeback and the
+    overlapped kick_writeback thread, so both paths produce identical
+    bytes."""
+    if not isinstance(arr, np.ndarray) and not getattr(
+        arr, "is_fully_addressable", True
+    ):
+        # multi-host global array: writeback wants this host's local
+        # shard block only
+        shards = sorted(
+            arr.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        arr = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    if not isinstance(arr, np.ndarray):
+        from paddlebox_tpu.ops.wire_quant import fetch_rows
+
+        shape = arr.shape
+        arr = fetch_rows(
+            arr.reshape(-1, shape[-1]), layout,
+            str(config.get_flag("wire_dtype")),
+        ).reshape(shape)
+    return np.asarray(arr)
+
+
+class _WritebackKick:
+    """An in-flight overlapped writeback started by kick_writeback.
+
+    The future resolves to the kick thread's total wall seconds (for the
+    hidden-overlap accounting) or to the failure; ``cancel`` is checked by
+    the chunked writeback at chunk boundaries (revert path)."""
+
+    def __init__(self, ws):
+        from concurrent.futures import Future
+
+        self.ws = ws
+        self.cancel = threading.Event()
+        self.fut: "Future[float]" = Future()
+        self.thread: Optional[threading.Thread] = None
 
 
 @dataclass
@@ -1134,12 +1187,75 @@ class BoxPSDataset:
             self._guard.begin(self.ws.sorted_keys)
         return self.device_table
 
+    def kick_writeback(self, trained_table) -> None:
+        """Start the end-of-pass host writeback NOW, overlapped with
+        whatever runs between training and ``end_pass`` (gate evaluation,
+        verdict exchange, the next pass's staging): the boundary worker
+        then JOINS this kick instead of writing back inline, so
+        ``boundary.writeback_s`` records only the residual blocking tail
+        and the hidden seconds flow into ``boundary.overlap_hidden_s``.
+
+        Safe under an armed guard: rollback's PassGuard contract covers
+        zero/partial/full writeback, so kicking before the verdict costs
+        nothing — a rejected pass cancels the kick at a chunk boundary in
+        ``revert_pass`` and the revert restores pre-pass rows either way.
+        No-op when no pass is open, a kick is already pending, or the
+        ``overlap_writeback`` flag is off."""
+        if (
+            trained_table is None
+            or not self._in_pass
+            or self.ws is None
+            or not bool(config.get_flag("overlap_writeback"))
+            or getattr(self, "_wb_kick", None) is not None
+        ):
+            return
+        ws, table = self.ws, self.table
+        kick = _WritebackKick(ws)
+
+        def run_kick():
+            t0 = time.perf_counter()
+            try:
+                with record_event("boundary.writeback_kick", "boundary"):
+                    arr = _trained_to_host(trained_table, table.layout)
+                    ws.writeback(arr, cancel=kick.cancel)
+                kick.fut.set_result(time.perf_counter() - t0)
+            except BaseException as e:
+                kick.fut.set_exception(e)
+
+        # non-daemon for the same reason as the end_pass worker: interpreter
+        # exit joins an in-flight writeback instead of truncating it
+        kick.thread = threading.Thread(target=run_kick, daemon=False)
+        self._wb_kick = kick
+        kick.thread.start()
+
+    def _cancel_writeback_kick(self) -> None:
+        """Stop a pending overlapped writeback at its next chunk boundary
+        and join it — whatever landed is exactly what guard.revert()
+        undoes. Swallows the cancellation (it is the requested outcome);
+        real failures are counted, not raised: the revert that follows
+        undoes their partial effects too."""
+        kick = getattr(self, "_wb_kick", None)
+        if kick is None:
+            return
+        from paddlebox_tpu.table.sparse_table import WritebackCancelled
+
+        kick.cancel.set()
+        try:
+            kick.fut.result()
+        except WritebackCancelled:
+            STAT_ADD("data.revert_writeback_cancelled")
+        except BaseException:
+            STAT_ADD("data.revert_end_pass_errors")
+        kick.thread.join()
+        self._wb_kick = None
+
     def revert_pass(self) -> None:
         """Reject the current pass (Revert parity, fleet_wrapper.h:319-321,
         pslib __init__.py:673-690): every pass key's host row returns to its
         pre-pass value (undoing any partial/complete writeback), the dense
         side restores, and the in-memory data re-arms so ``begin_pass`` can
         retrain it from scratch."""
+        self._cancel_writeback_kick()
         if self._end_pass_fut is not None:
             try:
                 self.wait_end_pass()
@@ -1229,12 +1345,22 @@ class BoxPSDataset:
         if need_save_delta and delta_dir is None:
             raise ValueError("need_save_delta requires delta_dir")
         ws, guard, table = self.ws, getattr(self, "_guard", None), self.table
+        # consume a pending overlapped writeback for THIS working set: the
+        # worker joins it instead of writing back inline. A kick for a
+        # different ws (shouldn't happen — revert/begin clear it) is left
+        # alone and the classic path runs.
+        kick = getattr(self, "_wb_kick", None)
+        if kick is not None and kick.ws is ws:
+            self._wb_kick = None
+        else:
+            kick = None
         # device-carried boundary: retain the trained DEVICE table instead
         # of fetching it; the next finalize splices surviving rows
         # device-to-device and fetches only the departing slice (EndPass
         # HBM-cache-warm parity, box_wrapper.cc:627-651). Gated to the
         # single-device single-process path; a save/guard/delta in the way
-        # flushes via table.drain_pending.
+        # flushes via table.drain_pending. An in-flight kick is already
+        # writing the full table back, so carrying is off for this boundary.
         carrier = None
         carry_ok = (
             trained_table is not None
@@ -1242,6 +1368,7 @@ class BoxPSDataset:
             and getattr(trained_table, "ndim", 0) in (2, 3)
             and bool(config.get_flag("enable_carried_table"))
             and guard is None
+            and kick is None
         )
         from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
         from paddlebox_tpu.table.sparse_table import PassWorkingSet
@@ -1326,34 +1453,25 @@ class BoxPSDataset:
                     # overwrite decayed rows with un-decayed values)
                     prev_carrier.join_push()
                 t_wb = time.perf_counter()
-                if trained_table is not None and carrier is None:
-                    arr = trained_table
-                    if (
-                        not isinstance(arr, np.ndarray)
-                        and not getattr(arr, "is_fully_addressable", True)
-                    ):
-                        # multi-host global array on the classic path
-                        # (carry gated off): writeback wants this host's
-                        # local shard block only
-                        shards = sorted(
-                            arr.addressable_shards,
-                            key=lambda s: s.index[0].start or 0,
-                        )
-                        arr = np.concatenate(
-                            [np.asarray(s.data) for s in shards], axis=0
-                        )
-                    if not isinstance(arr, np.ndarray):
-                        # device array taking the classic path (mesh, or
-                        # carry gated off): honor the boundary wire format
-                        from paddlebox_tpu.ops.wire_quant import fetch_rows
-
-                        shape = arr.shape
-                        arr = fetch_rows(
-                            arr.reshape(-1, shape[-1]),
-                            table.layout,
-                            str(config.get_flag("wire_dtype")),
-                        ).reshape(shape)
-                    ws.writeback(np.asarray(arr))
+                if kick is not None:
+                    # overlapped writeback: the kick thread has been pushing
+                    # since the trained table landed — only the residual
+                    # tail blocks this boundary, and the seconds the kick
+                    # ran before this join were hidden behind the gate/
+                    # verdict window (absorbed into overlap_hidden_s)
+                    kick_secs = kick.fut.result()
+                    kick.thread.join()
+                    wb_s = time.perf_counter() - t_wb
+                    hidden = max(0.0, kick_secs - wb_s)
+                    with self._stage_lock:
+                        self._stage_hidden_s += hidden
+                    STAT_SET("boundary.writeback_hidden_s", hidden)
+                    STAT_OBSERVE("boundary.writeback_hidden_s", hidden)
+                    if prev_carrier is not None and not prev_carrier.flushed:
+                        prev_carrier.supersede()
+                elif trained_table is not None and carrier is None:
+                    arr = _trained_to_host(trained_table, table.layout)
+                    ws.writeback(arr)
                     if prev_carrier is not None and not prev_carrier.flushed:
                         # the full classic writeback covers everything a
                         # still-pending carrier owed (carried keys are this

@@ -359,15 +359,28 @@ class DistributedWorkingSet:
     def _finalized_ok(self) -> bool:
         return self._finalized
 
-    def writeback(self, local_slice: np.ndarray) -> None:
+    def writeback(
+        self,
+        local_slice: np.ndarray,
+        cancel: Optional[threading.Event] = None,
+    ) -> None:
         """Flush THIS host's trained shard slice into its own host table —
         ownership == device placement, so nothing crosses hosts (EndPass
-        parity, box_wrapper.cc:627)."""
+        parity, box_wrapper.cc:627). ``cancel`` (the overlapped-kick revert
+        path) is checked between shard pushes: shards already pushed are
+        covered by rollback's partial-writeback contract."""
         if self.owned_shard_keys is None or self.shards_per_host == 0:
             # a zero-width ownership range (uneven map, more ranks than
             # shards) trains nothing and owes the host table nothing
             return
         flat = np.asarray(local_slice).reshape(self.shards_per_host, self.capacity, -1)
         for s, keys in enumerate(self.owned_shard_keys):
+            if cancel is not None and cancel.is_set():
+                from paddlebox_tpu.table.sparse_table import WritebackCancelled
+
+                raise WritebackCancelled(
+                    sum(len(k) for k in self.owned_shard_keys[:s]),
+                    sum(len(k) for k in self.owned_shard_keys),
+                )
             if len(keys):
                 self._table.push(keys, flat[s, : len(keys)])

@@ -71,6 +71,20 @@ config.define_flag(
     "(0 disables pinning)",
 )
 config.define_flag(
+    "writeback_threads", 4,
+    "writer-pool size for the end-of-pass host-table writeback "
+    "(PassWorkingSet.writeback -> pbx_table_push_mt): each worker owns a "
+    "disjoint set of shards, bitwise-equal to the serial path at every "
+    "value; <=1 is the legacy serial ablation (plain table.push)",
+)
+config.define_flag(
+    "writeback_chunk_keys", 2_000_000,
+    "keys per writeback chunk: the trained rows are gathered and pushed "
+    "chunk by chunk so the next chunk's gather overlaps the in-flight "
+    "push, and a revert can cancel between chunks (rollback's "
+    "partial-writeback contract covers whatever landed)",
+)
+config.define_flag(
     "spill_admit_show", 0.0,
     "freq policy admission threshold: at sweep time every row whose "
     "decayed show is under this is written disk-first instead of holding "
@@ -185,6 +199,23 @@ class SpillIOError(IOError):
         super().__init__(msg)
         self.op = op
         self.rc = rc
+
+
+class WritebackCancelled(RuntimeError):
+    """A chunked writeback was cancelled at a chunk boundary (revert path).
+
+    Not an error: the chunks already pushed are exactly the partial
+    writeback rollback's PassGuard contract covers ("safe after zero,
+    partial, or full writeback"), so the canceller reverts and retries.
+    Carries how far the writeback got for the revert log."""
+
+    def __init__(self, done_keys: int, total_keys: int):
+        super().__init__(
+            f"writeback cancelled at chunk boundary "
+            f"({done_keys}/{total_keys} keys pushed)"
+        )
+        self.done_keys = done_keys
+        self.total_keys = total_keys
 
 
 # flag value -> native policy code (csrc/host_table.cc kSpillFifo/kSpillFreq)
@@ -359,6 +390,15 @@ class HostSparseTable:
         except InjectedFault as e:
             STAT_ADD("table.spill_errors", 1)
             raise SpillIOError("spill_cold", -2, str(e)) from e
+        # separate site for the double-buffered stage writer: an injected
+        # failure here models the staged fwrite handoff dying mid-sweep
+        # (native rc -2 from the flusher thread) without shifting spill.io
+        # hit counts for plans armed against the sweep entry itself
+        try:
+            _fault_fire("spill.stage_flush")
+        except InjectedFault as e:
+            STAT_ADD("table.spill_errors", 1)
+            raise SpillIOError("stage_flush", -2, str(e)) from e
         n = self._native.spill_cold(
             max_mem_rows,
             policy=code,
@@ -445,6 +485,19 @@ class HostSparseTable:
         STAT_SET("table.tier.spill_bytes", st["spill_bytes"])
         STAT_SET("table.tier.mem_rows_max_shard", st["mem_rows_max_shard"])
         STAT_SET("table.tier.disk_rows_max_shard", st["disk_rows_max_shard"])
+        if self._native is not None:
+            # where the writeback/spill IO time went: the gather-vs-fwrite
+            # split of the double-buffered stage writers plus the push
+            # pre-pass header reads (cumulative, from the native tier)
+            io = self._native.io_stats()
+            STAT_SET("table.writeback.spill_gather_s",
+                     io["spill_gather_ns"] / 1e9)
+            STAT_SET("table.writeback.spill_fwrite_s",
+                     io["spill_fwrite_ns"] / 1e9)
+            STAT_SET("table.writeback.prepass_read_s",
+                     io["prepass_read_ns"] / 1e9)
+            STAT_SET("table.writeback.stage_flushes", io["stage_flushes"])
+            STAT_SET("table.writeback.stage_bytes", io["stage_bytes"])
         return st
 
     def __len__(self) -> int:
@@ -572,6 +625,35 @@ class HostSparseTable:
         if created:
             with self._size_lock:
                 self._size += created
+
+    def push_writeback(self, keys: np.ndarray, rows: np.ndarray,
+                       threads: int) -> None:
+        """One writer-pool chunk of the end-of-pass writeback.
+
+        Routes through ``pbx_table_push_mt`` (bitwise-equal to ``push`` at
+        every thread count) and feeds the per-shard wall seconds into the
+        ``table.writeback.shard_s`` histogram. Fires the
+        ``table.writeback_worker`` fault site; any failure — injected or a
+        real worker rc — surfaces as the typed :class:`SpillIOError`,
+        counted under ``table.spill_errors``.
+        """
+        try:
+            _fault_fire("table.writeback_worker")
+        except InjectedFault as e:
+            STAT_ADD("table.spill_errors", 1)
+            raise SpillIOError("writeback_worker", -2, str(e)) from e
+        if self._native is None:
+            self.push(keys, rows)
+            return
+        try:
+            shard_s = self._native.push_mt(keys, rows, threads)
+        except SpillIOError:
+            raise
+        except IOError as e:
+            STAT_ADD("table.spill_errors", 1)
+            raise SpillIOError("writeback_push", -2, str(e)) from e
+        for v in shard_s:
+            STAT_OBSERVE("table.writeback.shard_s", float(v))
 
     def decay_and_shrink(self) -> int:
         """Pass-boundary maintenance: decay show/clk, drop cold keys.
@@ -1119,9 +1201,77 @@ class PassWorkingSet:
         """Global row id safe for batch padding (shard 0's reserved row)."""
         return self.capacity - 1
 
-    def writeback(self, device_array: np.ndarray) -> None:
-        """Flush trained rows back to the host store (EndPass parity)."""
+    def writeback(
+        self,
+        device_array: np.ndarray,
+        cancel: Optional[threading.Event] = None,
+    ) -> None:
+        """Flush trained rows back to the host store (EndPass parity).
+
+        With ``writeback_threads`` > 1 and the native store available, the
+        push is chunked (``writeback_chunk_keys``) through the explicit
+        writer pool: chunk k+1's row gather runs while chunk k's push is
+        in flight on a single-slot pipeline, and ``cancel`` (checked at
+        chunk boundaries) lets a revert stop mid-writeback — whatever
+        landed is exactly the partial writeback rollback's PassGuard
+        contract covers. ``writeback_threads <= 1`` is the legacy serial
+        path, bit for bit. Either way the host table ends bitwise-equal:
+        chunks split a sorted unique key batch, so per-shard batch order
+        and every row write are identical to the one-shot push.
+
+        Emits the ``table.writeback.*`` stat family: total push seconds,
+        per-chunk gather/wait seconds, pool size, chunk count, and the
+        seconds the pipeline hid (push busy time that overlapped gathers).
+        """
         if self.n_keys == 0:
             return
         flat = np.asarray(device_array).reshape(-1, device_array.shape[-1])
-        self._table.push(self.sorted_keys, flat[self.row_of_sorted])
+        threads = int(config.get_flag("writeback_threads"))
+        if threads <= 1 or not getattr(self._table, "native", False):
+            self._table.push(self.sorted_keys, flat[self.row_of_sorted])
+            return
+        chunk = max(1, int(config.get_flag("writeback_chunk_keys")))
+        n = len(self.sorted_keys)
+        t_all = time.perf_counter()
+        wait_s = 0.0
+        busy_s = 0.0
+        n_chunks = 0
+        pending = None
+
+        def _push_chunk(ck: np.ndarray, cr: np.ndarray) -> float:
+            t0 = time.perf_counter()
+            self._table.push_writeback(ck, cr, threads)
+            return time.perf_counter() - t0
+
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            for lo in range(0, n, chunk):
+                if cancel is not None and cancel.is_set():
+                    # the in-flight chunk (if any) completes on executor
+                    # shutdown; nothing past it starts
+                    raise WritebackCancelled(lo, n)
+                hi = min(n, lo + chunk)
+                t0 = time.perf_counter()
+                cr = np.ascontiguousarray(flat[self.row_of_sorted[lo:hi]])
+                gather_s = time.perf_counter() - t0
+                STAT_OBSERVE("table.writeback.gather_s", gather_s)
+                if pending is not None:
+                    t0 = time.perf_counter()
+                    busy_s += pending.result()
+                    w = time.perf_counter() - t0
+                    wait_s += w
+                    STAT_OBSERVE("table.writeback.chunk_wait_s", w)
+                pending = ex.submit(_push_chunk, self.sorted_keys[lo:hi], cr)
+                n_chunks += 1
+            t0 = time.perf_counter()
+            busy_s += pending.result()
+            w = time.perf_counter() - t0
+            wait_s += w
+            STAT_OBSERVE("table.writeback.chunk_wait_s", w)
+        total_s = time.perf_counter() - t_all
+        STAT_SET("table.writeback.threads", threads)
+        STAT_SET("table.writeback.chunks", n_chunks)
+        STAT_SET("table.writeback.wait_s", wait_s)
+        STAT_SET("table.writeback.push_s", total_s)
+        STAT_OBSERVE("table.writeback.push_s", total_s)
+        # push busy time the single-slot pipeline hid behind row gathers
+        STAT_SET("table.writeback.hidden_s", max(0.0, busy_s - wait_s))

@@ -616,6 +616,12 @@ class PassSupervisor:
                 # pass's load/premerge/prefetch behind it
                 self._kick_prefetch(prefetch[0], prefetch[1])
             out = self.tr.train_pass(self.ds, n_batches=n_batches)
+            # the trained table just landed: kick the host writeback now so
+            # it overlaps the gate/verdict window instead of blocking the
+            # boundary. Safe pre-verdict — the armed guard's revert covers
+            # partial writeback, and revert_pass cancels the kick.
+            if hasattr(self.ds, "kick_writeback"):
+                self.ds.kick_writeback(self.tr.trained_table())
             self._gate(out)
         except Exception as e:
             if self.coord is None:

@@ -77,6 +77,21 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             SpillIOError and counted under
                             table.spill_errors (the end_pass worker's
                             failure path then reopens the pass for retry)
+    spill.stage_flush       table/sparse_table.py  spill_cold, after spill.io
+                            — models the double-buffered stage writer's
+                            fwrite handoff dying mid-sweep (native rc -2
+                            from the flusher thread) as its own site, so
+                            arming it never shifts spill.io hit counts;
+                            surfaced as SpillIOError, counted under
+                            table.spill_errors
+    table.writeback_worker  table/sparse_table.py  push_writeback, before
+                            each writer-pool chunk of the end-of-pass
+                            writeback — an injected failure is a worker rc
+                            error: surfaced as SpillIOError through the
+                            chunked writeback, the boundary worker's
+                            failure path reopens the pass, and the
+                            supervisor's revert restores pre-pass rows
+                            bitwise before the retry
     membership.adopt_shard  parallel/membership.py  adopt_dead_shards,
                             after the dead rank's checkpoint shard is
                             resumed but before its keys are pushed into
@@ -140,6 +155,8 @@ KNOWN_SITES = (
     "backend.init",
     "serve.apply_delta",
     "spill.io",
+    "spill.stage_flush",
+    "table.writeback_worker",
     "membership.adopt_shard",
     "migrate.transfer",
 )

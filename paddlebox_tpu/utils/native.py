@@ -53,6 +53,14 @@ TIER_STAT_FIELDS = (
     "admitted_disk_first", "lazy_shrunk", "dead_records", "spill_bytes",
 )
 
+# layout of pbx_table_io_stats (5 cumulative int64 slots): where the
+# writeback/spill IO time actually went — the gather-vs-fwrite split of the
+# double-buffered spill writers plus the push pre-pass header reads
+IO_STAT_FIELDS = (
+    "spill_gather_ns", "spill_fwrite_ns", "prepass_read_ns",
+    "stage_flushes", "stage_bytes",
+)
+
 
 def _build() -> bool:
     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
@@ -185,6 +193,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.pbx_table_push.argtypes = [
             ctypes.c_void_p, _u64p, _f32p, ctypes.c_int64,
         ]
+        lib.pbx_table_push_mt.restype = ctypes.c_int
+        lib.pbx_table_push_mt.argtypes = [
+            ctypes.c_void_p, _u64p, _f32p, ctypes.c_int64,
+            ctypes.c_int, _i64p,
+        ]
+        lib.pbx_table_io_stats.restype = None
+        lib.pbx_table_io_stats.argtypes = [ctypes.c_void_p, _i64p]
         lib.pbx_table_decay_shrink.restype = ctypes.c_int64
         lib.pbx_table_decay_shrink.argtypes = [
             ctypes.c_void_p, ctypes.c_float, ctypes.c_float,
@@ -416,6 +431,34 @@ class NativeHostStore:
         )
         if rc != 0:
             raise IOError(f"native table push failed rc={rc} (spill IO error?)")
+
+    def push_mt(self, keys: np.ndarray, rows: np.ndarray,
+                threads: int) -> np.ndarray:
+        """Batch push through the explicit writer pool (bitwise-equal to
+        ``push`` at every thread count; ``threads <= 0`` = auto heuristic,
+        ``1`` = forced serial). Returns per-shard wall seconds (float64
+        [n_shards]) — the ``table.writeback.shard_s`` histogram feed.
+        Raises the raw IOError on a negative rc; the table layer maps it
+        to the typed SpillIOError."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        shard_ns = np.zeros(self.n_shards, np.int64)
+        rc = self._lib.pbx_table_push_mt(
+            self._h, _as_ptr(keys, ctypes.c_uint64),
+            _as_ptr(rows, ctypes.c_float), len(keys), int(threads),
+            _as_ptr(shard_ns, ctypes.c_int64),
+        )
+        if rc != 0:
+            raise IOError(f"native table push failed rc={rc} (spill IO error?)")
+        return shard_ns.astype(np.float64) / 1e9
+
+    def io_stats(self) -> dict:
+        """Cumulative writeback/spill IO telemetry, keyed by
+        IO_STAT_FIELDS — the gather-vs-fwrite split of the double-buffered
+        spill writers plus push pre-pass header read time."""
+        out = np.zeros(len(IO_STAT_FIELDS), np.int64)
+        self._lib.pbx_table_io_stats(self._h, _as_ptr(out, ctypes.c_int64))
+        return {k: int(v) for k, v in zip(IO_STAT_FIELDS, out)}
 
     def decay_and_shrink(self, decay: float, threshold: float) -> int:
         return int(self._lib.pbx_table_decay_shrink(self._h, decay, threshold))

@@ -25,3 +25,15 @@ def test_native_sanitize_quick_replay_clean():
         capture_output=True, text=True, timeout=900, cwd=_REPO,
     )
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_native_sanitize_tsan_replay_clean():
+    # the writer pool + double-buffered spill stage must be race-free,
+    # not merely deadlock-free: replay the writeback suites under TSan
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "native_sanitize.py"),
+         "--tsan"],
+        capture_output=True, text=True, timeout=900, cwd=_REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr

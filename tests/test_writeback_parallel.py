@@ -1,0 +1,376 @@
+"""Parallel end-of-pass writeback: bitwise identity, exact stats, recovery.
+
+PR claim under test: the writer-pool push (``pbx_table_push_mt``), the
+chunked ``PassWorkingSet.writeback`` pipeline, and the overlapped
+boundary kick are *pure mechanism* — every value the host table holds
+afterwards is bit-for-bit what the legacy serial path
+(``writeback_threads=1`` -> plain ``table.push``) produces, at every
+thread count and chunk size, with and without the disk spill tier in
+play. The fault half pins the recovery contracts for the two new sites:
+an injected ``table.writeback_worker`` failure mid-day surfaces as the
+typed SpillIOError, the supervisor's revert restores pre-pass rows
+bitwise, and the retry lands a final state identical to a never-faulted
+run; an injected ``spill.stage_flush`` failure dies loudly without
+corrupting the resident tier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import tempfile
+import threading
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    SpillIOError,
+    ValueLayout,
+)
+from paddlebox_tpu.table.sparse_table import WritebackCancelled
+from paddlebox_tpu.train import (
+    CTRTrainer,
+    PassSupervisor,
+    RetryPolicy,
+    TrainStepConfig,
+)
+from paddlebox_tpu.utils.faultinject import fail_nth, fail_once, inject
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+WB_FLAGS = (
+    "writeback_threads", "writeback_chunk_keys", "overlap_writeback",
+    "spill_pin_show", "spill_admit_show",
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_wb_flags():
+    saved = {n: config.get_flag(n) for n in WB_FLAGS}
+    yield
+    for n, v in saved.items():
+        config.set_flag(n, v)
+
+
+def _native_or_skip():
+    from paddlebox_tpu.utils import native
+
+    if not native.available():
+        pytest.skip("native table store unavailable")
+
+
+def _digest(table) -> str:
+    """sha256 over the key-sorted full snapshot: bitwise table identity."""
+    k = np.sort(table.keys())
+    v = table.pull_or_create(k)
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(k).tobytes())
+    h.update(np.ascontiguousarray(v).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------- native tier
+
+LAY = ValueLayout(embedx_dim=2)
+TOPT = SparseOptimizerConfig(show_clk_decay=0.9, shrink_threshold=0.0)
+
+
+def _grow(spill_dir, threads, passes=3) -> HostSparseTable:
+    """Deterministic multi-pass grow/update/spill schedule; ``threads<=1``
+    routes every update through the serial push, otherwise through the
+    writer-pool push — the ONLY difference between two calls."""
+    table = HostSparseTable(
+        LAY, TOPT, n_shards=8, seed=0, spill_dir=spill_dir,
+    )
+    rng = np.random.default_rng(7)
+    for p in range(passes):
+        keys = np.unique(rng.integers(1, 4000, 1500).astype(np.uint64))
+        rows = table.pull_or_create(keys)
+        rows = rows + np.sin(
+            keys[:, None].astype(np.float64) * (p + 1)
+        ).astype(np.float32)
+        if threads <= 1:
+            table.push(keys, rows)
+        else:
+            table.push_writeback(keys, rows, threads)
+        table.decay_and_shrink()
+        if spill_dir is not None:
+            table.spill_cold(800)  # force disk-tier victims + promotes
+    return table
+
+
+@pytest.mark.parametrize("threads", [2, 3, 7])
+def test_push_mt_bitwise_equals_serial_with_spill(tmp_path, threads):
+    """Writer-pool push over sharded+spilled tables == serial push, bit
+    for bit, at several pool sizes (including one above n_shards/2 so
+    strided shard ownership wraps)."""
+    _native_or_skip()
+    config.set_flag("spill_pin_show", 3.0)   # exercise pin/admission
+    config.set_flag("spill_admit_show", 0.5)
+    with tempfile.TemporaryDirectory() as d_ref:
+        ref = _digest(_grow(d_ref, threads=1))
+    with tempfile.TemporaryDirectory() as d:
+        got = _digest(_grow(d, threads=threads))
+    assert got == ref
+
+
+def test_push_mt_stats_exact_vs_serial(tmp_path):
+    """Per-shard occupancy and every cumulative flow counter after the
+    parallel push equal the serial run exactly — the per-shard stats
+    merge cannot drop or double-count under the pool."""
+    _native_or_skip()
+    with tempfile.TemporaryDirectory() as d_ref:
+        t_ref = _grow(d_ref, threads=1)
+        st_ref = t_ref.tier_stats()
+        n_ref = len(t_ref)
+    with tempfile.TemporaryDirectory() as d:
+        t_par = _grow(d, threads=4)
+        st_par = t_par.tier_stats()
+        assert len(t_par) == n_ref
+        assert st_par == st_ref
+        io = t_par._native.io_stats()
+        # the double-buffered stage writers actually ran on this schedule
+        assert io["stage_bytes"] > 0 and io["stage_flushes"] > 0
+
+
+def test_push_disk_hit_prepass_bitwise_and_counted(tmp_path):
+    """Pushing straight onto spilled rows (no pull first — the upsert
+    shape checkpoint resume and shard adoption use) routes through the
+    sorted-offset header pre-pass; with thousands of hits per shard the
+    double-buffered reader thread engages, and the result must be
+    bitwise- and counter-identical to the serial pre-pass."""
+    _native_or_skip()
+
+    def run(threads):
+        with tempfile.TemporaryDirectory() as d:
+            table = HostSparseTable(LAY, TOPT, n_shards=2, seed=0,
+                                    spill_dir=d)
+            keys = np.arange(1, 6001, dtype=np.uint64)
+            rows = table.pull_or_create(keys) + 1.0
+            table.push(keys, rows)
+            table.spill_cold(64)  # ~3k disk rows per shard
+            if threads <= 1:
+                table.push(keys, rows * 2.0)
+            else:
+                table.push_writeback(keys, rows * 2.0, threads)
+            pre_ns = table._native.io_stats()["prepass_read_ns"]
+            return _digest(table), pre_ns, table.tier_stats()
+
+    d1, pre1, st1 = run(1)
+    d4, pre4, st4 = run(4)
+    assert d1 == d4
+    assert pre1 > 0 and pre4 > 0  # the pre-pass actually read headers
+    assert st1 == st4
+
+
+# ----------------------------------------------------- working-set writeback
+
+NS, B = 4, 16
+OPT = SparseOptimizerConfig(embedx_threshold=0.0, initial_range=0.01)
+TRAIN_LAY = ValueLayout(embedx_dim=4)
+
+
+def _write(tmp_path, name="d.txt", seed=5, n=96):
+    rng = np.random.default_rng(seed)
+    path = tmp_path / name
+    with open(path, "w") as f:
+        for _ in range(n):
+            keys = rng.integers(1, 400, NS)
+            f.write(
+                f"1 {int(keys[0]) % 2}.0 "
+                + " ".join(f"1 {k}" for k in keys) + "\n"
+            )
+    return str(path)
+
+
+def _build(path):
+    table = HostSparseTable(TRAIN_LAY, OPT, n_shards=4, seed=0)
+    schema = SlotSchema(
+        [SlotInfo("label", type="float", dense=True, dim=1)]
+        + [SlotInfo(f"s{i}") for i in range(NS)],
+        label_slot="label",
+    )
+    ds = BoxPSDataset(schema, table, batch_size=B, seed=0)
+    ds.set_filelist([path])
+    model = DeepFM(num_slots=NS, feat_width=TRAIN_LAY.pull_width,
+                   embedx_dim=4, hidden=(8,))
+    cfg = TrainStepConfig(
+        num_slots=NS, batch_size=B, layout=TRAIN_LAY, sparse_opt=OPT,
+        auc_buckets=500,
+    )
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    return table, ds, tr
+
+
+def _one_pass_state(path, threads, chunk):
+    config.set_flag("writeback_threads", threads)
+    config.set_flag("writeback_chunk_keys", chunk)
+    table, ds, tr = _build(path)
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64)
+    tr.train_pass(ds)
+    ds.end_pass(tr.trained_table(), shrink=False)
+    k = np.sort(table.keys())
+    return k, table.pull_or_create(k)
+
+
+@pytest.mark.parametrize("threads,chunk", [(4, 37), (4, 10_000), (7, 64)])
+def test_ws_writeback_chunked_bitwise_equals_serial(tmp_path, threads, chunk):
+    """The chunked single-slot writeback pipeline (gather overlapping the
+    in-flight push) lands the identical host table as the legacy serial
+    one-shot push, across chunk sizes that split the key batch many ways
+    and one that doesn't split it at all."""
+    _native_or_skip()
+    path = _write(tmp_path)
+    k_ref, v_ref = _one_pass_state(path, threads=1, chunk=1_000_000)
+    k, v = _one_pass_state(path, threads=threads, chunk=chunk)
+    np.testing.assert_array_equal(k, k_ref)
+    np.testing.assert_array_equal(v, v_ref)
+    if chunk == 37:
+        # the pipeline really chunked (not one degenerate mega-chunk)
+        assert STAT_GET("table.writeback.chunks") == -(-len(k_ref) // 37)
+        assert STAT_GET("table.writeback.threads") == 4
+
+
+def test_ws_writeback_cancel_then_revert_restores_bitwise(tmp_path):
+    """Cancelling mid-writeback stops at a chunk boundary (typed
+    WritebackCancelled, a strict prefix of the key batch landed) and the
+    armed guard's revert then restores the pre-pass rows bitwise — the
+    revert-cancels-kick path in miniature, made deterministic by setting
+    the cancel event from the first chunk's push."""
+    _native_or_skip()
+    config.set_flag("writeback_threads", 4)
+    config.set_flag("writeback_chunk_keys", 29)
+    path = _write(tmp_path)
+    table, ds, tr = _build(path)
+    ds.load_into_memory()
+    ds.begin_pass(round_to=64, enable_revert=True, trainer=tr)
+    pre_keys = ds.ws.sorted_keys.copy()
+    pre_vals = table.pull_or_create(pre_keys).copy()
+    tr.train_pass(ds, n_batches=3)
+
+    cancel = threading.Event()
+    orig = table.push_writeback
+
+    def arm_then_push(keys, rows, threads):
+        cancel.set()  # next chunk boundary must observe the cancellation
+        orig(keys, rows, threads)
+
+    table.push_writeback = arm_then_push
+    try:
+        with pytest.raises(WritebackCancelled) as ei:
+            ds.ws.writeback(tr.trained_table(), cancel=cancel)
+    finally:
+        table.push_writeback = orig
+    assert 0 < ei.value.done_keys < ei.value.total_keys
+    assert ei.value.done_keys % 29 == 0  # cut exactly at a chunk boundary
+
+    ds.revert_pass()
+    np.testing.assert_array_equal(table.pull_or_create(pre_keys), pre_vals)
+
+
+# -------------------------------------------------------------- fault sites
+
+S = 3
+DATE = "20260807"
+
+
+def _day_files(tmp_path, tag):
+    return [
+        _write(tmp_path, f"{tag}-{p}.txt", seed=11 + p, n=48)
+        for p in range(3)
+    ]
+
+
+def _day_sup(tmp_path, path_list):
+    table, ds, tr = _build(path_list[0])
+    sup = PassSupervisor(
+        ds, tr, retry=RetryPolicy(backoff_s=0.0, sleep=lambda s: None),
+        round_to=64, on_give_up="raise",
+    )
+    return table, ds, tr, sup
+
+
+def test_writeback_worker_fault_midday_revert_retry_bitwise(tmp_path):
+    """Inject a worker failure into pass 2's overlapped writeback kick of
+    a supervised 3-pass day: the SpillIOError propagates through the
+    boundary worker, the supervisor reverts (restoring pre-pass rows) and
+    retries, and the day's final table is bitwise-identical to a
+    never-faulted run."""
+    _native_or_skip()
+    config.set_flag("writeback_threads", 4)
+    config.set_flag("writeback_chunk_keys", 1_000_000)
+    files = _day_files(tmp_path, "wb")
+
+    table_c, _, tr_c, sup_c = _day_sup(tmp_path, files)
+    with inject() as probe:
+        outs_c = sup_c.run_day(DATE, [[f] for f in files])
+    assert sup_c.incidents == []
+    assert all(o is not None for o in outs_c)
+    hits_per_pass = probe.hits("table.writeback_worker") // 3
+    assert hits_per_pass >= 1  # the kick actually routed through the pool
+
+    table_i, _, tr_i, sup_i = _day_sup(tmp_path, files)
+    with inject(
+        fail_nth("table.writeback_worker", hits_per_pass + 1)
+    ) as plan:
+        outs_i = sup_i.run_day(DATE, [[f] for f in files])
+    assert plan.failures("table.writeback_worker") == 1
+    assert all(o is not None for o in outs_i)
+    assert [i.kind for i in sup_i.incidents] == ["train_error"]
+
+    k_c = np.sort(table_c.keys())
+    k_i = np.sort(table_i.keys())
+    np.testing.assert_array_equal(k_i, k_c)
+    np.testing.assert_array_equal(
+        table_i.pull_or_create(k_i), table_c.pull_or_create(k_c)
+    )
+    for a, b in zip(jax.tree.leaves(tr_i.params), jax.tree.leaves(tr_c.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_writeback_worker_fault_surfaces_typed_error():
+    """Outside any supervisor, the armed site turns a push_writeback call
+    into the typed SpillIOError and counts it — the contract the boundary
+    worker's failure path keys off."""
+    _native_or_skip()
+    table = HostSparseTable(LAY, TOPT, n_shards=2, seed=0)
+    keys = np.arange(1, 64, dtype=np.uint64)
+    rows = table.pull_or_create(keys)
+    before = STAT_GET("table.spill_errors")
+    with inject(fail_once("table.writeback_worker")):
+        with pytest.raises(SpillIOError):
+            table.push_writeback(keys, rows, 2)
+        # heals: the retry lands and the table is intact
+        table.push_writeback(keys, rows + 1.0, 2)
+    assert STAT_GET("table.spill_errors") == before + 1
+    np.testing.assert_array_equal(table.pull_or_create(keys), rows + 1.0)
+
+
+def test_stage_flush_fault_dies_loudly_keeps_resident_tier():
+    """An injected spill.stage_flush failure (the double-buffered stage
+    writer's fwrite handoff dying mid-sweep) surfaces as SpillIOError,
+    and the rows the sweep was about to spill are still served bitwise
+    from the resident tier; the healed retry then spills clean."""
+    _native_or_skip()
+    with tempfile.TemporaryDirectory() as d:
+        table = HostSparseTable(
+            LAY, TOPT, n_shards=2, seed=0, spill_dir=d,
+        )
+        keys = np.arange(1, 901, dtype=np.uint64)
+        rows = table.pull_or_create(keys).copy()
+        before = STAT_GET("table.spill_errors")
+        with inject(fail_once("spill.stage_flush")):
+            with pytest.raises(SpillIOError):
+                table.spill_cold(100)
+            np.testing.assert_array_equal(table.pull_or_create(keys), rows)
+            assert table.spill_cold(100) == 800  # healed retry spills clean
+        assert STAT_GET("table.spill_errors") == before + 1
+        np.testing.assert_array_equal(table.pull_or_create(keys), rows)

@@ -1229,6 +1229,11 @@ def main(argv=None):
                          "tier under ASan+UBSan and replay the native test "
                          "files against the instrumented library "
                          "(tools/native_sanitize.py, full set)")
+    ap.add_argument("--tsan", action="store_true",
+                    help="with --native-sanitize: ThreadSanitizer mode — "
+                         "rebuild with -fsanitize=thread and replay the "
+                         "parallel-writeback suites (writer-pool race "
+                         "coverage)")
     ap.add_argument("--serve", action="store_true",
                     help="serving-chain corruption smoke: a follower must "
                          "skip a corrupted published delta with an alarm, "
@@ -1240,7 +1245,7 @@ def main(argv=None):
     if args.native_sanitize:
         import native_sanitize
 
-        return native_sanitize.main([])
+        return native_sanitize.main(["--tsan"] if args.tsan else [])
     if args.serve:
         return run_serve(args)
     if args.wedge_backend:

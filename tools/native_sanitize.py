@@ -10,12 +10,16 @@ instrumented library via the ``PBOX_NATIVE_LIB`` override
 it. Any sanitizer report is a hard failure.
 
 Usage:
-  python tools/native_sanitize.py [--quick] [--json PATH] [--keep]
+  python tools/native_sanitize.py [--quick] [--tsan] [--json PATH] [--keep]
 
 ``--quick`` replays only the parser+table suites (the two that drive the
 bulk of the native surface); the default replays all native-importing
-test files. ``--json`` writes a machine-readable report (atomic).
-``--keep`` leaves the instrumented .so in csrc/build/ for reuse.
+test files. ``--tsan`` switches to ThreadSanitizer: the sources rebuild
+with ``-fsanitize=thread`` and the replay set narrows to the writeback/
+table suites that drive the parallel writer pool and the double-buffered
+spill stage — the races ASan structurally cannot see. ``--json`` writes
+a machine-readable report (atomic). ``--keep`` leaves the instrumented
+.so in csrc/build/ for reuse.
 
 Exit codes: 0 clean (or environment cannot build — skipped with a
 message, so CI lanes without g++ stay green), 1 sanitizer report or test
@@ -53,6 +57,7 @@ _SRCS = [
     os.path.join(_REPO, "csrc", "host_table.cc"),
 ]
 SAN_LIB = os.path.join(_REPO, "csrc", "build", "libpbx_parser_san.so")
+TSAN_LIB = os.path.join(_REPO, "csrc", "build", "libpbx_parser_tsan.so")
 
 # every test file that imports the native binding (the replay set); the
 # quick set is the pair that drives most of the native surface area.
@@ -75,6 +80,15 @@ ALL_TESTS = (
 )
 QUICK_TESTS = ALL_TESTS[:2]
 
+# the --tsan replay set: the suites that drive the parallel writeback
+# writer pool, the double-buffered spill stage writers, and the pre-pass
+# reader handoff — the thread-interleaving surface ASan cannot see
+WRITEBACK_TESTS = (
+    "tests/test_writeback_parallel.py",
+    "tests/test_native_table.py",
+    "tests/test_tiered_store.py",
+)
+
 # sanitizer report markers in pytest/stderr output; any hit fails the run
 _SAN_MARKERS = (
     "ERROR: AddressSanitizer",
@@ -83,12 +97,18 @@ _SAN_MARKERS = (
     "runtime error:",  # UBSan
     "SUMMARY: UndefinedBehaviorSanitizer",
 )
+_TSAN_MARKERS = (
+    "WARNING: ThreadSanitizer",
+    "SUMMARY: ThreadSanitizer",
+    "ThreadSanitizer:DEADLYSIGNAL",
+)
 
 
-def _runtime_libs() -> list:
-    """ASan/UBSan runtime paths for LD_PRELOAD (empty when unresolvable)."""
+def _runtime_libs(tsan: bool = False) -> list:
+    """Sanitizer runtime paths for LD_PRELOAD (empty when unresolvable)."""
     libs = []
-    for name in ("libasan.so", "libubsan.so"):
+    names = ("libtsan.so",) if tsan else ("libasan.so", "libubsan.so")
+    for name in names:
         try:
             p = subprocess.check_output(
                 ["gcc", "-print-file-name=" + name], text=True, timeout=30
@@ -103,18 +123,21 @@ def _runtime_libs() -> list:
     return libs
 
 
-def build_instrumented() -> bool:
-    """Compile the native sources with ASan+UBSan into SAN_LIB."""
-    os.makedirs(os.path.dirname(SAN_LIB), exist_ok=True)
-    tmp = f"{SAN_LIB}.{os.getpid()}.tmp"
+def build_instrumented(tsan: bool = False) -> bool:
+    """Compile the native sources with ASan+UBSan (or TSan) into
+    SAN_LIB (TSAN_LIB)."""
+    lib = TSAN_LIB if tsan else SAN_LIB
+    san = "thread" if tsan else "address,undefined"
+    os.makedirs(os.path.dirname(lib), exist_ok=True)
+    tmp = f"{lib}.{os.getpid()}.tmp"
     try:
         subprocess.run(
             ["g++", "-O1", "-g", "-fno-omit-frame-pointer", "-shared",
-             "-fPIC", "-std=c++17", "-fsanitize=address,undefined",
+             "-fPIC", "-std=c++17", f"-fsanitize={san}",
              "-o", tmp] + _SRCS,
             check=True, capture_output=True, timeout=300,
         )
-        os.replace(tmp, SAN_LIB)
+        os.replace(tmp, lib)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         out = getattr(e, "stderr", b"") or b""
@@ -129,16 +152,36 @@ def build_instrumented() -> bool:
         return False
 
 
-def replay(tests, timeout_s: int) -> dict:
+def replay(tests, timeout_s: int, tsan: bool = False) -> dict:
     """Run ``tests`` against the instrumented lib; return the verdict."""
     env = dict(os.environ)
-    env.update(
-        JAX_PLATFORMS="cpu",
-        PBOX_NATIVE_LIB=SAN_LIB,
-        LD_PRELOAD=" ".join(_runtime_libs()),
-        ASAN_OPTIONS="detect_leaks=0:halt_on_error=1:abort_on_error=1",
-        UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1",
-    )
+    if tsan:
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PBOX_NATIVE_LIB=TSAN_LIB,
+            LD_PRELOAD=" ".join(_runtime_libs(tsan=True)),
+            # second_deadlock_stack aids triage; halt_on_error turns the
+            # first genuine race into a loud pytest failure.
+            # ignore_noninstrumented_modules scopes checking to the one
+            # TSan-built module (races in our writer pool / spill stage
+            # still fire — verified with a deliberate-race probe); without
+            # it, jax's uninstrumented XLA runtime drowns the run in
+            # module-internal false positives. The suppressions file backs
+            # that up for reports interceptors still attribute to XLA.
+            TSAN_OPTIONS=(
+                "halt_on_error=1:second_deadlock_stack=1"
+                ":ignore_noninstrumented_modules=1:suppressions="
+                + os.path.join(_REPO, "tools", "tsan.supp")
+            ),
+        )
+    else:
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PBOX_NATIVE_LIB=SAN_LIB,
+            LD_PRELOAD=" ".join(_runtime_libs()),
+            ASAN_OPTIONS="detect_leaks=0:halt_on_error=1:abort_on_error=1",
+            UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1",
+        )
     cmd = [
         sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
         "-m", "not slow", "-k", "not throughput and not perf",
@@ -149,7 +192,8 @@ def replay(tests, timeout_s: int) -> dict:
         timeout=timeout_s,
     )
     out = proc.stdout + proc.stderr
-    reports = sorted({m for m in _SAN_MARKERS if m in out})
+    markers = _TSAN_MARKERS if tsan else _SAN_MARKERS
+    reports = sorted({m for m in markers if m in out})
     return {
         "returncode": proc.returncode,
         "sanitizer_reports": reports,
@@ -161,6 +205,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="replay only the parser+table suites")
+    ap.add_argument("--tsan", action="store_true",
+                    help="ThreadSanitizer mode: rebuild with "
+                         "-fsanitize=thread and replay the writeback/"
+                         "table suites (writer-pool race coverage)")
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="write a machine-readable report here (atomic)")
     ap.add_argument("--keep", action="store_true",
@@ -169,22 +217,29 @@ def main(argv=None) -> int:
                     help="replay wall-clock budget in seconds")
     args = ap.parse_args(argv)
 
-    report = {"tool": "native_sanitize", "ok": False, "skipped": False}
-    if shutil.which("g++") is None or not _runtime_libs():
+    mode = "TSan" if args.tsan else "ASan+UBSan"
+    report = {
+        "tool": "native_sanitize", "ok": False, "skipped": False,
+        "mode": mode,
+    }
+    if shutil.which("g++") is None or not _runtime_libs(tsan=args.tsan):
         # no compiler / no sanitizer runtime in this image: nothing to
         # verify here, and failing would just turn every such lane red
         report.update(ok=True, skipped=True,
-                      reason="g++ or libasan/libubsan unavailable")
+                      reason="g++ or sanitizer runtimes unavailable")
         print("[native-sanitize] SKIP: g++ or sanitizer runtimes unavailable")
     elif not all(os.path.exists(s) for s in _SRCS):
         report.update(ok=True, skipped=True, reason="native sources absent")
         print("[native-sanitize] SKIP: native sources absent")
-    elif not build_instrumented():
+    elif not build_instrumented(tsan=args.tsan):
         report.update(reason="instrumented build failed")
         print("[native-sanitize] FAIL: instrumented build failed")
     else:
-        tests = QUICK_TESTS if args.quick else ALL_TESTS
-        verdict = replay(tests, args.timeout)
+        if args.tsan:
+            tests = WRITEBACK_TESTS
+        else:
+            tests = QUICK_TESTS if args.quick else ALL_TESTS
+        verdict = replay(tests, args.timeout, tsan=args.tsan)
         report.update(
             tests=list(tests),
             returncode=verdict["returncode"],
@@ -196,7 +251,7 @@ def main(argv=None) -> int:
         report["ok"] = clean
         if clean:
             print(f"[native-sanitize] PASS: {len(tests)} file(s) replayed "
-                  "under ASan+UBSan, zero reports")
+                  f"under {mode}, zero reports")
         else:
             print("[native-sanitize] FAIL: "
                   f"pytest rc={verdict['returncode']}, sanitizer markers="
@@ -204,7 +259,7 @@ def main(argv=None) -> int:
             print(verdict["tail"])
         if not args.keep:
             try:
-                os.unlink(SAN_LIB)
+                os.unlink(TSAN_LIB if args.tsan else SAN_LIB)
             # pbox-lint: disable=EXC007 — absence is the goal state
             except OSError:
                 pass

@@ -30,6 +30,20 @@ must be bitwise-identical across policies (catch-up decay is exact).
   python tools/scale_soak.py --zipf --keys 1e9 [--passes 8] [--draws 4e6]
       [--mem-cap ROWS] [--zipf-a 1.2] [--pin-show X] [--admit-rate R]
       [--no-digest] [--out SOAK_TIER.json]
+
+--writeback switches to the parallel-writeback A/B soak (PR 13): the same
+seeded multi-pass working-set schedule runs TWICE over fresh spill-enabled
+tables — once with the legacy serial writeback (--writeback-threads 1
+ablation path) and once through the chunked writer-pool pipeline with the
+boundary-overlap kick — recording per-pass BLOCKED writeback seconds (the
+handoff stall the tentpole kills), the seconds the overlap window hid, the
+per-chunk queue-wait distribution, the spill stage writers' gather/fwrite
+split from the native io counters, and a full-table sha256 digest that
+must be bitwise-identical across arms.
+
+  python tools/scale_soak.py --writeback [--keys 2e7] [--draws 2e6]
+      [--passes 4] [--writeback-threads 4] [--chunk-keys 2e5]
+      [--mem-cap ROWS] [--out SOAK_WRITEBACK.json]
 """
 
 from __future__ import annotations
@@ -428,9 +442,269 @@ def zipf_main(argv) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --writeback: parallel-writeback A/B soak (serial ablation vs writer pool)
+# ---------------------------------------------------------------------------
+
+
+def _wb_pass_keys(seed: int, p: int, key_space: int, draws: int):
+    """Pass p's referenced keys: seeded uniform draws over the key space,
+    mixed by an odd-constant multiply so the stream shards uniformly."""
+    import numpy as np
+
+    rng = np.random.default_rng((seed, p))
+    raw = rng.integers(1, key_space, draws).astype(np.uint64)
+    with np.errstate(over="ignore"):
+        keys = raw * np.uint64(0x9E3779B97F4A7C15) + np.uint64(1)
+    return np.unique(keys)
+
+
+def _wb_stage_next(conf: dict, p: int):
+    """The boundary-overlap window's work: derive the NEXT pass's key
+    stream and premerge it into a fresh working set — exactly the staging
+    the pipelined boundary overlaps with the writeback. Touches no table
+    state, so running it beside the in-flight writeback cannot perturb
+    the bitwise A/B."""
+    from paddlebox_tpu.table.sparse_table import PassWorkingSet
+
+    keys = _wb_pass_keys(conf["seed"], p + 1, conf["keys"], conf["draws"])
+    ws = PassWorkingSet(n_mesh_shards=1)
+    ws.add_keys(keys)
+    return ws
+
+
+def run_writeback_arm(threads: int, conf: dict) -> dict:
+    """One A/B arm: the full multi-pass finalize/perturb/writeback/spill
+    cycle over a fresh table, with ``threads`` selecting the serial
+    ablation (<=1) or the chunked writer-pool pipeline. In the pool arm
+    the writeback is kicked on a thread and the staging window runs
+    beside it (the PR 4 boundary shape); ``blocked_s`` is what the
+    handoff actually waited at the join."""
+    import threading as _threading
+
+    import numpy as np
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.utils.monitor import STAT_GET, all_histograms
+
+    layout = ValueLayout(embedx_dim=conf["embedx_dim"])
+    opt = SparseOptimizerConfig(
+        embedx_threshold=0.0, show_clk_decay=0.98, shrink_threshold=0.0
+    )
+    spill_dir = os.path.join(conf["workdir"], f"spill-wb-{threads}")
+    os.makedirs(spill_dir, exist_ok=True)
+    saved = {
+        n: config.get_flag(n)
+        for n in ("writeback_threads", "writeback_chunk_keys")
+    }
+    out = {"threads": threads, "passes": []}
+    try:
+        config.set_flag("writeback_threads", threads)
+        config.set_flag("writeback_chunk_keys", conf["chunk_keys"])
+        table = HostSparseTable(
+            layout, opt, n_shards=conf["n_shards"], seed=0,
+            mem_cap_rows=conf["mem_cap_rows"], spill_dir=spill_dir,
+        )
+        io_prev = table._native.io_stats() if table.native else None
+        from paddlebox_tpu.table.sparse_table import PassWorkingSet
+
+        ws = PassWorkingSet(n_mesh_shards=1)
+        ws.add_keys(_wb_pass_keys(conf["seed"], 0, conf["keys"],
+                                  conf["draws"]))
+        t_all = time.perf_counter()
+        for p in range(conf["passes"]):
+            dev = ws.finalize(table, round_to=4096)
+            dev[:, :, layout.SHOW] += 1.0
+            rec = {"pass": p, "uniq_keys": int(ws.n_keys)}
+            if threads <= 1:
+                # serial ablation: the handoff stalls for the whole push,
+                # THEN the staging window runs (same total work)
+                t0 = time.perf_counter()
+                ws.writeback(dev)
+                rec["blocked_s"] = time.perf_counter() - t0
+                rec["push_s"] = rec["blocked_s"]
+                t0 = time.perf_counter()
+                ws_next = _wb_stage_next(conf, p)
+                rec["window_s"] = time.perf_counter() - t0
+            else:
+                # boundary-overlap shape: kick the writeback, stage the
+                # next pass beside it, measure what the join still waits
+                err = []
+
+                def _run(ws=ws, dev=dev):
+                    try:
+                        ws.writeback(dev)
+                    except BaseException as e:  # propagated after join
+                        err.append(e)
+
+                th = _threading.Thread(target=_run)
+                t_kick = time.perf_counter()
+                th.start()
+                ws_next = _wb_stage_next(conf, p)
+                rec["window_s"] = time.perf_counter() - t_kick
+                t0 = time.perf_counter()
+                th.join()
+                rec["blocked_s"] = time.perf_counter() - t0
+                if err:
+                    raise err[0]
+                rec["push_s"] = float(STAT_GET("table.writeback.push_s"))
+                rec["chunks"] = int(STAT_GET("table.writeback.chunks"))
+                rec["pipeline_hidden_s"] = float(
+                    STAT_GET("table.writeback.hidden_s")
+                )
+            rec["overlap_hidden_s"] = max(
+                0.0, rec["push_s"] - rec["blocked_s"]
+            )
+            t0 = time.perf_counter()
+            table.decay_and_shrink()
+            table.maybe_spill()
+            rec["boundary_rest_s"] = time.perf_counter() - t0
+            ws = ws_next
+            out["passes"].append({
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in rec.items()
+            })
+        out["wall_s"] = round(time.perf_counter() - t_all, 3)
+        for field in ("blocked_s", "push_s", "overlap_hidden_s",
+                      "window_s"):
+            out[field + "_total"] = round(
+                sum(r[field] for r in out["passes"]), 4
+            )
+        if io_prev is not None:
+            io = table._native.io_stats()
+            out["io"] = {
+                "spill_gather_s": round(
+                    (io["spill_gather_ns"] - io_prev["spill_gather_ns"])
+                    / 1e9, 4),
+                "spill_fwrite_s": round(
+                    (io["spill_fwrite_ns"] - io_prev["spill_fwrite_ns"])
+                    / 1e9, 4),
+                "prepass_read_s": round(
+                    (io["prepass_read_ns"] - io_prev["prepass_read_ns"])
+                    / 1e9, 4),
+                "stage_flushes": int(io["stage_flushes"]),
+                "stage_bytes": int(io["stage_bytes"]),
+            }
+        if threads > 1:
+            # per-chunk queue wait + per-shard push walls (pool arm only:
+            # the serial path bypasses both histograms by design)
+            out["distributions"] = {
+                name: h.summary((0.5, 0.99))
+                for name, h in sorted(all_histograms().items())
+                if name.startswith("table.writeback.")
+            }
+        st = table.tier_stats()
+        st.pop("per_shard")
+        out["tier_stats"] = {k: int(v) for k, v in st.items()}
+        t0 = time.perf_counter()
+        out["digest"] = _table_digest(table)
+        out["digest_s"] = round(time.perf_counter() - t0, 3)
+        del table
+    finally:
+        for n, v in saved.items():
+            config.set_flag(n, v)
+    return out
+
+
+def wb_main(argv) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="scale_soak.py --writeback")
+    ap.add_argument("--writeback", action="store_true")
+    ap.add_argument("--keys", default="2e7", help="key SPACE of the stream")
+    ap.add_argument("--draws", default="2e6", help="stream draws per pass")
+    ap.add_argument("--passes", type=int, default=4)
+    ap.add_argument("--writeback-threads", type=int, default=4,
+                    help="writer-pool size of the parallel arm (1 turns "
+                         "the A/B into serial-vs-serial — the ablation "
+                         "sanity run)")
+    ap.add_argument("--chunk-keys", default="2e5",
+                    help="writeback_chunk_keys for the pool arm")
+    ap.add_argument("--mem-cap", default=None,
+                    help="mem_cap_rows (default draws//2: cap always hit, "
+                         "spill stage writers + push pre-pass engaged)")
+    ap.add_argument("--n-shards", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(REPO, "SOAK_WRITEBACK.json"))
+    args = ap.parse_args(argv)
+
+    from paddlebox_tpu.utils import native
+
+    if not native.available():
+        print("writeback soak needs the native table", file=sys.stderr)
+        return 1
+    draws = int(float(args.draws))
+    with tempfile.TemporaryDirectory() as workdir:
+        conf = {
+            "keys": int(float(args.keys)),
+            "draws": draws,
+            "passes": args.passes,
+            "chunk_keys": int(float(args.chunk_keys)),
+            "mem_cap_rows": (
+                int(float(args.mem_cap)) if args.mem_cap is not None
+                else max(1, draws // 2)
+            ),
+            "n_shards": args.n_shards,
+            "seed": args.seed,
+            "embedx_dim": 8,
+            "workdir": workdir,
+        }
+        arms = {}
+        for name, th in (("serial", 1), ("parallel", args.writeback_threads)):
+            arms[name] = run_writeback_arm(th, conf)
+            print(
+                f"{name}(threads={th}): "
+                f"blocked={arms[name]['blocked_s_total']}s "
+                f"push={arms[name]['push_s_total']}s "
+                f"hidden={arms[name]['overlap_hidden_s_total']}s "
+                f"wall={arms[name]['wall_s']}s",
+                flush=True,
+            )
+    sa, pa = arms["serial"], arms["parallel"]
+    ab = {
+        "writer_pool": args.writeback_threads,
+        "chunk_keys": conf["chunk_keys"],
+        # the headline: seconds the pass handoff STALLS on writeback —
+        # the serial arm stalls for the whole push, the pool arm only
+        # for what the overlap window didn't absorb
+        "blocked_writeback_s_serial": sa["blocked_s_total"],
+        "blocked_writeback_s_parallel": pa["blocked_s_total"],
+        "blocked_cut_x": round(
+            sa["blocked_s_total"] / max(1e-9, pa["blocked_s_total"]), 2
+        ),
+        "overlap_hidden_s": pa["overlap_hidden_s_total"],
+        # total wall stays honest: on few-core hosts the overlap moves
+        # the push INTO the window rather than shrinking the sum
+        "wall_s_serial": sa["wall_s"],
+        "wall_s_parallel": pa["wall_s"],
+        "bitwise_equal": sa["digest"] == pa["digest"],
+    }
+    conf.pop("workdir")
+    result = {
+        "metric": "parallel_writeback_ab_soak",
+        "conf": conf,
+        "arms": arms,
+        "ab": ab,
+        "machine": {"cpus": os.cpu_count()},
+    }
+    from paddlebox_tpu.utils.fs import atomic_write
+
+    with atomic_write(args.out) as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"ab": ab}))
+    return 0
+
+
 def main() -> int:
     if "--zipf" in sys.argv:
         return zipf_main(sys.argv[1:])
+    if "--writeback" in sys.argv:
+        return wb_main(sys.argv[1:])
     keys = int(float(next(
         (sys.argv[i + 1] for i, a in enumerate(sys.argv) if a == "--keys"),
         "1e8",
