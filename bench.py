@@ -546,9 +546,16 @@ def main():
             "send_rows": int(STAT_GET("wire.send_rows_total")),
             "send_bytes": int(STAT_GET("wire.send_bytes_total")),
             "send_fp32_bytes": int(STAT_GET("wire.send_fp32_bytes_total")),
+            "ici_wire_dtype": str(_config.get_flag("ici_wire_dtype")),
             "a2a_payload_bytes": int(STAT_GET("wire.a2a_payload_bytes")),
             "a2a_fp32_bytes": int(STAT_GET("wire.a2a_fp32_bytes")),
             "a2a_dtype_bits": int(STAT_GET("wire.a2a_dtype_bits")),
+            # adaptive ICI wire (hot rows bf16, cold tail int8): per-bucket
+            # hot-slot bound the compiled collective used, plus the pass's
+            # hotness census and how many hot keys overflowed into int8
+            "a2a_hot_slots": int(STAT_GET("wire.a2a_hot_slots")),
+            "ici_hot_keys": int(STAT_GET("wire.ici_hot_keys")),
+            "ici_hot_overflow_keys": int(STAT_GET("wire.ici_hot_overflow_keys")),
             # host plane (PBTX v3 frame choke point + working-set
             # exchange rounds, ops/host_codec.py): actual bytes shipped
             # vs what the raw v2 framing would have shipped

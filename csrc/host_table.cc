@@ -1180,6 +1180,33 @@ int64_t pbx_table_shard_shows(void* h, int shard, float* out, int64_t cap) {
   return n;
 }
 
+// Read-only show peek for a key batch: out[i] = the decayed show of keys[i]
+// if it is resident on the MEM tier, else 0 (disk rows and absent keys both
+// read cold). No creation, no promotion, no touch, no decay catch-up — this
+// feeds the adaptive-ICI-wire hotness bit, which must never perturb tier
+// state (spill policy only evicts cold rows, so a hot key reading 0 from
+// disk just rides the int8 region until its next pull — the same graceful
+// degrade as hot-fraction overflow).
+int pbx_table_shows_peek(void* h, const uint64_t* keys, int64_t n, float* out) {
+  Table* t = (Table*)h;
+  return for_shards(t, keys, n, [&](int si, const int64_t* idx, int64_t m) {
+    Shard* s = &t->shards[si];
+    std::lock_guard<std::mutex> g(s->mtx);
+    for (int64_t q = 0; q < m; ++q) {
+      int64_t i = idx[q];
+      float show = 0.0f;
+      if (s->mask) {  // shard_find on an empty hash would scan forever
+        bool found;
+        uint64_t j = shard_find(s, keys[i], &found);
+        if (found && s->hstate[j] == kMem)
+          show = s->values[s->hval[j] * t->width + t->show_col];
+      }
+      out[i] = show;
+    }
+    return 0;
+  });
+}
+
 // Export one shard's keys (mem + disk — all live in the hash, no file
 // reads). At most `cap` keys written; returns the count.
 int64_t pbx_table_shard_keys(void* h, int shard, uint64_t* out, int64_t cap) {

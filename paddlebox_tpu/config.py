@@ -10,10 +10,10 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 _lock = threading.Lock()
-_defs: Dict[str, tuple] = {}  # guarded-by: _lock  (name -> (type_fn, default, help))
+_defs: Dict[str, tuple] = {}  # guarded-by: _lock  (name -> (type_fn, default, help, validator))
 _values: Dict[str, Any] = {}  # guarded-by: _lock
 
 
@@ -23,7 +23,16 @@ def _parse_bool(v) -> bool:
     return str(v).strip().lower() in ("1", "true", "yes", "on")
 
 
-def define_flag(name: str, default: Any, help: str = "") -> None:
+def define_flag(
+    name: str,
+    default: Any,
+    help: str = "",
+    validator: Optional[Callable[[Any], Any]] = None,
+) -> None:
+    """Declare a flag. ``validator`` (if given) runs on every set_flag and
+    on the first env-sourced read, and must raise on an invalid value — a
+    typo'd enum flag fails at the set site, not as a silent fallthrough
+    wherever the value is eventually consumed."""
     type_fn: Callable
     if isinstance(default, bool):
         type_fn = _parse_bool
@@ -34,7 +43,7 @@ def define_flag(name: str, default: Any, help: str = "") -> None:
     else:
         type_fn = str
     with _lock:
-        _defs[name] = (type_fn, default, help)
+        _defs[name] = (type_fn, default, help, validator)
 
 
 def get_flag(name: str) -> Any:
@@ -43,19 +52,28 @@ def get_flag(name: str) -> Any:
             return _values[name]
         if name not in _defs:
             raise KeyError(f"undefined flag: {name}")
-        type_fn, default, _ = _defs[name]
+        type_fn, default, _, validator = _defs[name]
         env = os.environ.get("PBOX_" + name.upper())
-        val = type_fn(env) if env is not None else default
-        _values[name] = val
-        return val
+    # parse + validate OUTSIDE the lock: validators may import their
+    # consumer module (e.g. ops/wire_quant), whose import-time flag reads
+    # would deadlock on the non-reentrant registry lock
+    val = type_fn(env) if env is not None else default
+    if validator is not None and env is not None:
+        validator(val)
+    with _lock:
+        return _values.setdefault(name, val)
 
 
 def set_flag(name: str, value: Any) -> None:
     with _lock:
         if name not in _defs:
             raise KeyError(f"undefined flag: {name}")
-        type_fn, _, _ = _defs[name]
-        _values[name] = type_fn(value)
+        type_fn, _, _, validator = _defs[name]
+    val = type_fn(value)
+    if validator is not None:
+        validator(val)
+    with _lock:
+        _values[name] = val
 
 
 def all_flags() -> Dict[str, Any]:
@@ -72,19 +90,64 @@ define_flag("sample_rate", 1.0, "line sampling rate on read (BufferedLineFileRea
 
 # --- wire formats (ops/wire_quant.py; defined here so consumers can read
 # them without importing that module first) ---
+def _validate_wire_dtype(mode: str) -> None:
+    # lazy: wire_quant imports config at module load (flag reads), so a
+    # top-level import here would be circular
+    from paddlebox_tpu.ops import wire_quant
+
+    wire_quant._check(mode)
+
+
+def _validate_ici_wire_dtype(mode: str) -> None:
+    from paddlebox_tpu.ops import wire_quant
+
+    wire_quant.check_ici(mode)
+
+
 define_flag(
     "wire_dtype",
     "fp32",
     "value format on the host<->device boundary wire (carrier splice "
     "uploads, departing-slice fetch, flush, classic device writeback): "
     "fp32 | bf16 | int8 (int8 = per-row-scaled embed block + bf16 rest)",
+    validator=_validate_wire_dtype,
 )
 define_flag(
     "ici_wire_dtype",
     "fp32",
     "value format of the sharded pull/push all_to_all payloads over ICI: "
-    "fp32 | bf16 | int8 (bf16/int8 keep the show/clk counter columns fp32; "
-    "int8 carries one per-record max-abs scale)",
+    "fp32 | bf16 | int8 | adaptive (bf16/int8 keep the show/clk counter "
+    "columns fp32; int8 carries one per-record max-abs scale; adaptive "
+    "rides hot rows bf16 and the cold tail int8 — see ici_hot_frac / "
+    "ici_hot_show / ici_wire_adaptive)",
+    validator=_validate_ici_wire_dtype,
+)
+define_flag(
+    "ici_wire_adaptive",
+    True,
+    "master ablation gate for ici_wire_dtype=adaptive: when False the "
+    "adaptive mode degrades to fp32 and no hotness plumbing runs, so the "
+    "wire (and every downstream bit) is identical to the pre-adaptive "
+    "default — the bitwise off-ablation the convergence gates compare "
+    "against",
+)
+define_flag(
+    "ici_hot_frac",
+    0.125,
+    "static per-bucket hot-slot bound for the adaptive ICI wire: the "
+    "first round(frac*K) slots of each per-shard request bucket ride "
+    "bf16, the rest int8. Static so the all_to_all keeps one compiled "
+    "shape; hot keys beyond the bound ride the int8 region (counted "
+    "under wire.ici_hot_overflow_keys). 0 degrades to uniform int8, "
+    "1 to uniform bf16 — both bitwise",
+)
+define_flag(
+    "ici_hot_show",
+    1.0,
+    "decayed-show threshold above which a key counts as hot for the "
+    "adaptive ICI wire (same scale as spill_pin_show: the tier's "
+    "per-row decayed show column). Keys on the disk tier or not yet "
+    "created read 0 = cold",
 )
 define_flag(
     "host_wire_codec",

@@ -23,7 +23,11 @@ import numpy as np
 from paddlebox_tpu import config
 from paddlebox_tpu.data.slot_record import SlotBatch
 from paddlebox_tpu.data.slot_schema import SlotSchema
+from paddlebox_tpu.ops import wire_quant
 from paddlebox_tpu.table.sparse_table import PassWorkingSet
+from paddlebox_tpu.utils.faultinject import InjectedFault
+from paddlebox_tpu.utils.faultinject import fire as _fault_fire
+from paddlebox_tpu.utils.monitor import STAT_ADD
 
 
 def _round_bucket(n: int, quantum: int) -> int:
@@ -143,6 +147,22 @@ def _route_sharded(
     slot = segments // B
     dev = ins // b
 
+    # hot-first bucket ordering for the adaptive ICI wire: the working set
+    # publishes a per-row hotness bit (tier decayed-show >= ici_hot_show)
+    # only when the adaptive wire is engaged, and the device side assigns
+    # precision purely by slot index — so ordering each per-shard bucket
+    # hot-first here IS the whole hot/cold partition. None (the default and
+    # the ablation) keeps the historical stable-by-shard order bitwise.
+    hot_rows = getattr(ws, "hot_rows", None)
+    if hot_rows is not None:
+        try:
+            _fault_fire("wire.ici_pack")
+        except InjectedFault:
+            # recovery: this batch degrades to the uniform slot order — hot
+            # keys ride the int8 region (correct, just un-prioritized)
+            STAT_ADD("wire.ici_pack_errors", 1)
+            hot_rows = None
+
     per_dev = []  # (uniq_rows, inverse, local_segments) per device
     max_L = 1
     max_bucket = 1
@@ -171,10 +191,20 @@ def _route_sharded(
     inverse = np.full((n_devices, L_pad), K - 1, dtype=np.int32)
     seg_out = np.full((n_devices, L_pad), S * b, dtype=np.int32)
 
+    hot_overflow = 0
+    H = wire_quant.ici_hot_slots(K) if hot_rows is not None else 0
     for d, (uniq, inv, local_seg) in enumerate(per_dev):
         shard_of = (uniq // cap).astype(np.int64)
         rank_of = (uniq % cap).astype(np.int64)
-        order = np.argsort(shard_of, kind="stable")
+        if hot_rows is not None and len(uniq):
+            # lexsort is stable with the LAST key primary: group by owner
+            # shard, hot rows (cold=False) first within each bucket
+            cold = ~hot_rows[uniq]
+            order = np.lexsort((cold, shard_of))
+            per_shard_hot = np.bincount(shard_of[~cold], minlength=ns)
+            hot_overflow += int(np.maximum(per_shard_hot - H, 0).sum())
+        else:
+            order = np.argsort(shard_of, kind="stable")
         counts = np.bincount(shard_of, minlength=ns)
         # bucket position of each unique row: owner_shard*K + slot-in-bucket
         pos_in_bucket = np.empty(len(uniq), dtype=np.int64)
@@ -186,6 +216,12 @@ def _route_sharded(
             start += c
         inverse[d, : len(inv)] = pos_in_bucket[inv]
         seg_out[d, : len(local_seg)] = local_seg
+
+    if hot_rows is not None and hot_overflow:
+        # hot keys past the static bf16 bound ride int8 this batch —
+        # harmless (graceful degrade), but a persistently nonzero counter
+        # says ici_hot_frac is too small for the traffic's hot set
+        STAT_ADD("wire.ici_hot_overflow_keys", hot_overflow)
 
     labels = labels.reshape(n_devices, b)
     if dense is not None:

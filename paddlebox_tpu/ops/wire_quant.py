@@ -49,11 +49,52 @@ BF16 = ml_dtypes.bfloat16
 
 _MODES = ("fp32", "bf16", "int8")
 
+# the ICI wire additionally understands the frequency-adaptive mixed mode
+# (hot rows bf16, cold tail int8 — see ici_effective_mode below); the
+# boundary row wire does not, because boundary rows already ride the
+# layout-aware per-block int8 format and cross once per pass, not per batch
+_ICI_MODES = _MODES + ("adaptive",)
+
 
 def _check(mode: str) -> str:
     if mode not in _MODES:
         raise ValueError(f"wire dtype {mode!r} not in {_MODES}")
     return mode
+
+
+def check_ici(mode: str) -> str:
+    if mode not in _ICI_MODES:
+        raise ValueError(f"ici wire dtype {mode!r} not in {_ICI_MODES}")
+    return mode
+
+
+def ici_effective_mode() -> str:
+    """Resolve the ICI wire mode the collective should actually run.
+
+    ``ici_wire_adaptive=False`` is the ablation master switch: it degrades
+    ``adaptive`` all the way to fp32 (not to a uniform quant mode) so the
+    off-leg is bitwise-identical to the pre-adaptive default wire."""
+    mode = check_ici(str(config.get_flag("ici_wire_dtype")))
+    if mode != "adaptive":
+        return mode
+    if not config.get_flag("ici_wire_adaptive"):
+        return "fp32"
+    return "adaptive"
+
+
+def ici_adaptive_engaged() -> bool:
+    """True iff the adaptive hot/cold wire is actually live (mode resolves
+    to adaptive after the ablation gate) — the single predicate every
+    hotness-plumbing site gates on, so turning the gate off also turns off
+    the hot-first packer reorder and the working-set hotness round."""
+    return ici_effective_mode() == "adaptive"
+
+
+def ici_hot_slots(K: int) -> int:
+    """Static per-bucket hot-slot count for bucket capacity K (the first H
+    slots of each per-shard request bucket ride bf16)."""
+    frac = float(config.get_flag("ici_hot_frac"))
+    return int(min(K, max(0, round(frac * K))))
 
 
 def _embed_span(layout) -> Tuple[int, int]:
@@ -182,6 +223,7 @@ def send_rows(arr: np.ndarray, layout, mode: str):
 
 def row_wire_nbytes(n: int, layout, mode: str) -> int:
     """Bytes crossing the wire for n table rows under a mode."""
+    mode = _check(mode)
     w = layout.width
     if mode == "fp32":
         return n * w * 4
@@ -191,3 +233,32 @@ def row_wire_nbytes(n: int, layout, mode: str) -> int:
     n_blocks = len(_embed_blocks(layout))
     # int8 region + bf16 rest + one fp32 scale per block
     return n * ((b - a) + (w - (b - a)) * 2 + 4 * n_blocks)
+
+
+def ici_wire_nbytes(
+    n: int, K: int, W: int, head: int, n_sections: int, mode: str, hot_slots: int = 0
+) -> int:
+    """Bytes crossing ICI for an [n, K, W] all_to_all record block.
+
+    ``head`` columns are always exact fp32 (counts for pull, show/clk for
+    push); the remaining W-head value columns ride the mode's format.
+    int8 records carry one fp32 max-abs scale per (record, section).
+    ``adaptive`` splits each K-bucket at ``hot_slots``: the first H slots
+    bf16, the rest int8 — degenerating to the uniform modes at H=0 / H=K
+    exactly as the collective itself does."""
+    mode = check_ici(mode)
+    q_cols = W - head
+    if mode == "fp32":
+        return n * K * W * 4
+    if mode == "bf16":
+        return n * K * (head * 4 + q_cols * 2)
+    if mode == "int8":
+        return n * K * (head * 4 + q_cols + 4 * n_sections)
+    H = int(hot_slots)
+    if H <= 0:
+        return ici_wire_nbytes(n, K, W, head, n_sections, "int8")
+    if H >= K:
+        return ici_wire_nbytes(n, K, W, head, n_sections, "bf16")
+    return n * (
+        K * head * 4 + H * q_cols * 2 + (K - H) * (q_cols + 4 * n_sections)
+    )

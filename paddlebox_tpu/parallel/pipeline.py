@@ -42,7 +42,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from paddlebox_tpu.parallel.mesh import MeshPlan
+from paddlebox_tpu.parallel.mesh import axis_size, MeshPlan, shard_map
 
 
 @dataclass(frozen=True)
@@ -71,7 +71,7 @@ def pipeline_forward(
     apply = jax.checkpoint(stage_apply) if spec.remat else stage_apply
 
     def fn(stage_params: Any, x_micro: jnp.ndarray) -> jnp.ndarray:
-        n = lax.axis_size(spec.axis_name)
+        n = axis_size(spec.axis_name)
         idx = lax.axis_index(spec.axis_name)
         M = spec.n_micro
         T = M + n - 1
@@ -164,7 +164,7 @@ def make_pipeline_train_step(
         def batch_loss(p):
             y = fwd(p, x_micro)  # [M, mb, H], zeros off the last stage
             per_mb = jax.vmap(loss_fn)(y, targets)  # [M]
-            n = lax.axis_size(ax)
+            n = axis_size(ax)
             idx = lax.axis_index(ax)
             # LOCAL masked loss: only the last stage's output seeds a
             # cotangent; earlier stages still receive their grads through
@@ -212,7 +212,7 @@ def make_pipeline_train_step(
             jax.tree.map(lambda _: pp, params),
             jax.tree.map(lambda _: opt_spec, opt_state),
         )
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local_step,
             mesh=plan.mesh,
             in_specs=(specs_state, data, data),

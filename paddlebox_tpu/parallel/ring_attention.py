@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from paddlebox_tpu.parallel.mesh import axis_size
+
 _NEG_INF = -1e30  # finite "-inf": keeps exp()=0 without NaN max/subtraction
 
 
@@ -72,7 +74,7 @@ def ring_attention(
     S_global * D) without; remat removes the quadratic term (the
     blockwise-parallel paper's recompute trade), not the kv carries.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, S, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
@@ -133,7 +135,7 @@ def ulysses_attention(
 ) -> jnp.ndarray:
     """DeepSpeed-Ulysses style: all_to_all to [full seq, H/n heads], exact
     attention, all_to_all back. Requires H % axis_size == 0."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     B, S, H, D = q.shape
     if H % n != 0:
         raise ValueError(f"n_heads {H} not divisible by axis size {n}")

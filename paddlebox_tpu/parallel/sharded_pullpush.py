@@ -37,6 +37,45 @@ from paddlebox_tpu.table.optimizers import SparseOptimizerConfig
 from paddlebox_tpu.table.value_layout import ValueLayout
 
 
+def _a2a(x, axis_name):
+    return lax.all_to_all(x, axis_name, 0, 0, tiled=True)
+
+
+def _bf16_vals_a2a(vals, axis_name):
+    """Value columns over ICI at half width: bf16 on the wire, fp32 out."""
+    return _a2a(vals.astype(jnp.bfloat16), axis_name).astype(jnp.float32)
+
+
+def _int8_vals_a2a(recs, axis_name, sections):
+    """Value sections of [n, k, W] records over ICI as per-record-scaled
+    int8; returns the dequantized fp32 value columns [n, k, sum(widths)].
+
+    Two collectives regardless of section count: one concatenated int8
+    payload, one stacked scale matrix (same batching as the row wire's
+    fetch_rows_start) — every extra all_to_all would add fixed launch/sync
+    latency per batch."""
+    qs, scales = [], []
+    for a, b in sections:
+        v = recs[:, :, a:b]
+        s = jnp.maximum(jnp.abs(v).max(axis=2), 1e-12) / 127.0
+        qs.append(
+            jnp.clip(jnp.rint(v / s[..., None]), -127, 127).astype(jnp.int8)
+        )
+        scales.append(s)
+    qr = _a2a(jnp.concatenate(qs, axis=2), axis_name)
+    sr = _a2a(jnp.stack(scales, axis=2), axis_name)  # [n, k, n_sections]
+    outs = []
+    off = 0
+    for si, (a, b) in enumerate(sections):
+        wsec = b - a
+        outs.append(
+            qr[:, :, off : off + wsec].astype(jnp.float32)
+            * sr[:, :, si : si + 1]
+        )
+        off += wsec
+    return jnp.concatenate(outs, axis=2)
+
+
 def _compressed_a2a(recs, axis_name, head: int, sections):
     """all_to_all [n, K, W] records under the ici_wire_dtype flag.
 
@@ -45,64 +84,63 @@ def _compressed_a2a(recs, axis_name, head: int, sections):
     per-record max-abs scale under int8 — embedx and expand train on
     different gradients and can sit orders of magnitude apart, so one
     shared scale would quantize the smaller family to noise (the same
-    per-block rule as the row wire, ops/wire_quant.py)."""
-    from paddlebox_tpu import config as _config
+    per-block rule as the row wire, ops/wire_quant.py).
+
+    ``adaptive`` splits each K-slot bucket at the static hot bound H =
+    ici_hot_slots(K): the host packer ordered every bucket hot-first, so
+    slots [0, H) carry the frequent keys and ride bf16 while slots [H, K)
+    carry the cold tail and ride int8. Precision is decided purely by slot
+    index — no per-row flag crosses the wire, the collective keeps one
+    compiled shape per K, and hot keys past the bound simply ride the int8
+    region (graceful, counted host-side under wire.ici_hot_overflow_keys).
+    H=0 / H=K execute exactly the uniform int8 / bf16 paths, bitwise."""
+    from paddlebox_tpu.ops import wire_quant as wq
     from paddlebox_tpu.utils.monitor import STAT_SET
 
-    wd = str(_config.get_flag("ici_wire_dtype"))
+    mode = wq.ici_effective_mode()
     # bytes-on-wire accounting for the compiled collective. Shapes are
     # static, so this is exact per-call payload — recorded at TRACE time
     # (STAT_SET, not ADD: a retrace must not double-count) alongside the
     # fp32 baseline it displaces, so bench/capture artifacts can report
     # the measured ICI compression ratio instead of asserting it.
     n, K, W = int(recs.shape[0]), int(recs.shape[1]), int(recs.shape[2])
-    if wd == "bf16":
-        payload = n * K * (head * 4 + (W - head) * 2)
-    elif wd == "int8":
-        q_cols = sum(b - a for a, b in sections)
-        payload = n * K * (head * 4 + q_cols + len(sections) * 4)
-    else:
-        payload = n * K * W * 4
+    hot = wq.ici_hot_slots(K) if mode == "adaptive" else 0
+    payload = wq.ici_wire_nbytes(n, K, W, head, len(sections), mode, hot)
     STAT_SET("wire.a2a_payload_bytes", payload)
     STAT_SET("wire.a2a_fp32_bytes", n * K * W * 4)
-    STAT_SET("wire.a2a_dtype_bits", {"bf16": 16, "int8": 8}.get(wd, 32))
-    if wd == "bf16":
-        counts = lax.all_to_all(recs[:, :, :head], axis_name, 0, 0, tiled=True)
-        vals = lax.all_to_all(
-            recs[:, :, head:].astype(jnp.bfloat16), axis_name, 0, 0, tiled=True
-        ).astype(jnp.float32)
+    STAT_SET("wire.a2a_hot_slots", hot)
+    if mode == "adaptive":
+        # blended effective bits across the mixed payload, so dashboards
+        # reading one number still see where between 8 and 16 the wire sat
+        bits = int(round(payload * 8 / (n * K * W)))
+    else:
+        bits = {"fp32": 32, "bf16": 16, "int8": 8}[mode]
+    STAT_SET("wire.a2a_dtype_bits", bits)
+    if mode == "adaptive":
+        if hot <= 0:
+            mode = "int8"  # whole bucket is tail: exactly the uniform wire
+        elif hot >= K:
+            mode = "bf16"  # whole bucket is hot: exactly the uniform wire
+    if mode == "bf16":
+        counts = _a2a(recs[:, :, :head], axis_name)
+        vals = _bf16_vals_a2a(recs[:, :, head:], axis_name)
         return jnp.concatenate([counts, vals], axis=2)
-    if wd == "int8":
-        # three collectives total regardless of section count: fp32 head,
-        # one concatenated int8 payload, one stacked scale matrix (same
-        # batching as the row wire's fetch_rows_start) — every extra
-        # all_to_all would add fixed launch/sync latency per batch
-        qs, scales = [], []
-        for a, b in sections:
-            v = recs[:, :, a:b]
-            s = jnp.maximum(jnp.abs(v).max(axis=2), 1e-12) / 127.0
-            qs.append(
-                jnp.clip(jnp.rint(v / s[..., None]), -127, 127).astype(jnp.int8)
-            )
-            scales.append(s)
-        counts = lax.all_to_all(recs[:, :, :head], axis_name, 0, 0, tiled=True)
-        qr = lax.all_to_all(
-            jnp.concatenate(qs, axis=2), axis_name, 0, 0, tiled=True
-        )
-        sr = lax.all_to_all(
-            jnp.stack(scales, axis=2), axis_name, 0, 0, tiled=True
-        )  # [n, K, n_sections]
-        outs = [counts]
-        off = 0
-        for si, (a, b) in enumerate(sections):
-            wsec = b - a
-            outs.append(
-                qr[:, :, off : off + wsec].astype(jnp.float32)
-                * sr[:, :, si : si + 1]
-            )
-            off += wsec
-        return jnp.concatenate(outs, axis=2)
-    return lax.all_to_all(recs, axis_name, 0, 0, tiled=True)
+    if mode == "int8":
+        counts = _a2a(recs[:, :, :head], axis_name)
+        vals = _int8_vals_a2a(recs, axis_name, sections)
+        return jnp.concatenate([counts, vals], axis=2)
+    if mode == "adaptive":
+        # four collectives: fp32 head for all K slots, bf16 hot values,
+        # int8 cold values + their scales. Hot and cold reassemble by
+        # concatenation because slicing K (axis 1) commutes with the
+        # all_to_all (which tiles axis 0): received bucket s's first H
+        # slots are exactly sender s's first H slots.
+        counts = _a2a(recs[:, :, :head], axis_name)
+        hot_vals = _bf16_vals_a2a(recs[:, :hot, head:], axis_name)
+        cold_vals = _int8_vals_a2a(recs[:, hot:, :], axis_name, sections)
+        vals = jnp.concatenate([hot_vals, cold_vals], axis=1)
+        return jnp.concatenate([counts, vals], axis=2)
+    return _a2a(recs, axis_name)
 
 
 def sharded_pull(
@@ -189,9 +227,19 @@ def sharded_push(
     ranks_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
 
     M = n * K
-    flat_ranks = ranks_recv.reshape(M)
-    flat_recs = recs_recv.reshape(M, gw + 2)
+    return _owner_merge_push(
+        table_local, ranks_recv.reshape(M), recs_recv.reshape(M, gw + 2),
+        layout, opt,
+    )
 
+
+def _owner_merge_push(table_local, flat_ranks, flat_recs, layout, opt):
+    """Owner-side merge+apply of M received push records [show, clk, grads].
+
+    Factored out of :func:`sharded_push` so a single-device caller (tests)
+    can run the exact merge the mesh owner runs, on the same flat record
+    order the all_to_all delivers (device-major)."""
+    M = flat_ranks.shape[0]
     # group duplicate ranks: sort, segment by run, merge records per run
     order = jnp.argsort(flat_ranks)
     sr = jnp.take(flat_ranks, order)

@@ -91,6 +91,11 @@ class DistributedWorkingSet:
         self.capacity = 0
         self.n_keys = 0  # locally referenced
         self.owned_shard_keys: Optional[List[np.ndarray]] = None
+        # bool [n_mesh_shards*capacity] hotness bits for the adaptive ICI
+        # wire (None = off/ablated); set by finalize via the gated ws-hot
+        # round — owners read their local tier, requesters get one bit per
+        # requested key
+        self.hot_rows: Optional[np.ndarray] = None
 
     def add_keys(self, keys: np.ndarray) -> None:
         if self._finalized:
@@ -259,6 +264,48 @@ class DistributedWorkingSet:
             sel = owners == h
             got = host_codec.decode_row_ids(rep_in[h])
             rows[sel] = got
+
+        # round 3 (gated): hotness bits for the adaptive ICI wire. Each
+        # owner reads its LOCAL tier's decayed shows (shows_peek — pure,
+        # never perturbs tier state) and replies one bit per requested key
+        # in the requester's key order, packed 8 keys/byte. The round only
+        # runs when the adaptive wire is engaged, so the ablation's host
+        # exchange is byte-identical to the two-round historical one.
+        from paddlebox_tpu.ops import wire_quant as _wq  # lazy: import cycle
+
+        if _wq.ici_adaptive_engaged():
+            thr = float(config.get_flag("ici_hot_show"))
+            owned_hot = (
+                (table.shows_peek(owned) >= thr)
+                if len(owned)
+                else np.zeros(0, bool)
+            )
+            hot_out = []
+            off = 0
+            for h in range(t.n_ranks):
+                k = req_keys[h]
+                bits = (
+                    owned_hot[pos_all[off : off + len(k)]]
+                    if len(k)
+                    else np.zeros(0, bool)
+                )
+                hot_out.append(np.packbits(bits.astype(np.uint8)).tobytes())
+                off += len(k)
+            STAT_ADD("wire.ws_hot_bytes", sum(len(b) for b in hot_out))
+            hot_in = t.alltoall(hot_out, f"ws-hot:{self.pass_id}@e{self.epoch}")
+            hot = np.zeros(self.n_mesh_shards * cap, dtype=bool)
+            for h in range(t.n_ranks):
+                if h not in live:
+                    continue
+                sel = owners == h
+                nk = int(sel.sum())
+                if nk:
+                    bits = np.unpackbits(
+                        np.frombuffer(hot_in[h], np.uint8), count=nk
+                    ).astype(bool)
+                    hot[rows[sel]] = bits
+            self.hot_rows = hot
+
         self.sorted_keys = referenced  # np.unique output: sorted
         self.row_of_sorted = rows
         self._finalized = True

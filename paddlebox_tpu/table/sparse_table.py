@@ -572,6 +572,37 @@ class HostSparseTable:
                 self._size += created
         return out
 
+    def shows_peek(self, keys: np.ndarray) -> np.ndarray:
+        """Decayed show counts for ``keys`` without creating, promoting or
+        touching anything. f32 [n]; keys on the disk tier or absent read 0.
+
+        This is the hotness source of the adaptive ICI wire (a key is hot
+        when its decayed show clears ``ici_hot_show``): a pure mem-tier
+        peek, because spill policy only evicts cold rows — a hot key that
+        somehow sits on disk just rides int8 until its next pull, which is
+        the graceful-degrade contract anyway. Keeping the read side-effect
+        free means the wire heuristic can never perturb tier state."""
+        if self._native is not None:
+            return self._native.shows_peek(keys)
+        out = np.zeros(len(keys), dtype=np.float32)
+        shard_ids = key_to_shard(keys, self.n_shards)
+        show_col = self.layout.SHOW
+        for s in range(self.n_shards):
+            sel = np.nonzero(shard_ids == s)[0]
+            if len(sel) == 0:
+                continue
+            shard = self._shards[s]
+            with shard.lock:
+                get = shard.index.get
+                klist = keys[sel].tolist()
+                rows = np.fromiter(
+                    (get(k, -1) for k in klist), dtype=np.int64, count=len(klist)
+                )
+                hit = rows >= 0
+                if hit.any():
+                    out[sel[hit]] = shard.values[rows[hit], show_col]
+        return out
+
     def prefetch_rows(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
         """Pull/create rows for a STAGED next pass; returns (rows, epoch).
 
@@ -1000,6 +1031,10 @@ class PassWorkingSet:
         self.row_of_sorted: Optional[np.ndarray] = None  # int64 [n] global rows
         self.capacity = 0  # rows per mesh shard (incl. padding row)
         self.n_keys = 0
+        # bool [n_mesh_shards*capacity] hotness bits for the adaptive ICI
+        # wire (None = adaptive off/ablated: the packer keeps the uniform
+        # slot order bitwise). Set by finalize() when the wire is engaged.
+        self.hot_rows: Optional[np.ndarray] = None
 
     def add_keys(self, keys: np.ndarray) -> None:
         """Feed feasigns seen in loaded records (PSAgent::AddKeys parity)."""
@@ -1083,6 +1118,11 @@ class PassWorkingSet:
         self._table = table
 
         if carrier is not None and not carrier.flushed and carrier.ws.n_keys:
+            # spliced boundary: resident keys' live shows sit on device, so
+            # hotness reads the host mem tier instead (possibly one pass
+            # stale — fine for a precision heuristic, and side-effect free)
+            if self._ici_adaptive():
+                self._set_hot_rows(global_rows, table.shows_peek(all_keys))
             return self._finalize_spliced(
                 table, carrier, all_keys, global_rows, ns, cap, prefetch
             )
@@ -1094,9 +1134,27 @@ class PassWorkingSet:
                 else np.zeros((0, table.layout.width), dtype=np.float32)
             )
         STAT_SET("boundary.pull_s", time.perf_counter() - t0)
+        if self._ici_adaptive() and len(all_keys):
+            # the classic pull already materialized every row: its decayed
+            # show column is the exact, free hotness source
+            self._set_hot_rows(global_rows, rows[:, table.layout.SHOW])
         dev = np.zeros((ns, cap, table.layout.width), dtype=np.float32)
         dev.reshape(ns * cap, -1)[global_rows] = rows
         return dev
+
+    @staticmethod
+    def _ici_adaptive() -> bool:
+        from paddlebox_tpu.ops import wire_quant  # lazy: avoids import cycle
+
+        return wire_quant.ici_adaptive_engaged()
+
+    def _set_hot_rows(self, global_rows: np.ndarray, shows: np.ndarray) -> None:
+        """Publish per-row hotness bits for the adaptive ICI wire."""
+        thr = float(config.get_flag("ici_hot_show"))
+        hot = np.zeros(self.n_mesh_shards * self.capacity, dtype=bool)
+        hot[global_rows] = np.asarray(shows, dtype=np.float32) >= thr
+        self.hot_rows = hot
+        STAT_SET("wire.ici_hot_keys", int(hot.sum()))
 
     def _finalize_spliced(
         self, table, carrier, all_keys, global_rows, ns, cap, prefetch=None

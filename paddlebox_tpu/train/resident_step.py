@@ -417,6 +417,8 @@ def make_resident_pv_mesh_superstep(
     are shared with the flat mesh tier; multi-host additionally requires
     per-device resident pass arrays (rp.per_device)."""
     import jax as _jax
+
+    from paddlebox_tpu.parallel.mesh import shard_map as _mesh_shard_map
     from jax.sharding import PartitionSpec as P
 
     from paddlebox_tpu.train.sharded_step import (
@@ -461,7 +463,7 @@ def make_resident_pv_mesh_superstep(
     arr_specs = {k: (P(ax) if per_device else P()) for k in rp_arrays}
 
     def superstep(state, pos_block, arrs, pv_idx, pv_ro, pv_w):
-        mapped = _jax.shard_map(
+        mapped = _mesh_shard_map(
             superstep_local,
             mesh=plan.mesh,
             in_specs=(
@@ -652,6 +654,8 @@ def make_resident_mesh_superstep(
     mesh step body runs (make_local_mesh_step — identical numerics to the
     host-packed path)."""
     import jax as _jax
+
+    from paddlebox_tpu.parallel.mesh import shard_map as _mesh_shard_map
     from jax.sharding import PartitionSpec as P
 
     from paddlebox_tpu.train.sharded_step import (
@@ -700,7 +704,7 @@ def make_resident_mesh_superstep(
     }
 
     def superstep(state, idx_block, arrs):
-        mapped = _jax.shard_map(
+        mapped = _mesh_shard_map(
             superstep_local,
             mesh=plan.mesh,
             in_specs=(

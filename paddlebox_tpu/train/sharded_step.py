@@ -28,7 +28,12 @@ from jax.sharding import PartitionSpec as P
 
 from paddlebox_tpu.fleet.zero import Zero1Optimizer
 from paddlebox_tpu.metrics.auc import AucState, auc_update
-from paddlebox_tpu.parallel.mesh import MeshPlan, put_replicated, put_sharded
+from paddlebox_tpu.parallel.mesh import (
+    MeshPlan,
+    put_replicated,
+    put_sharded,
+    shard_map,
+)
 from paddlebox_tpu.parallel.sharded_pullpush import sharded_pull, sharded_push
 from paddlebox_tpu.train.train_step import (
     TrainState,
@@ -409,7 +414,7 @@ def make_sharded_train_step(
         return {k: dp for k in batch}
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local_step,
             mesh=plan.mesh,
             in_specs=(state_specs, batch_specs(batch)),

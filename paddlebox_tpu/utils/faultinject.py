@@ -107,6 +107,14 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             OLD ownership epoch serving (stale-epoch
                             frames are unreceivable) and the plan is
                             simply retried at the next pass boundary
+    wire.ici_pack           data/device_pack.py  _route_sharded, before the
+                            hot-first bucket ordering of the adaptive ICI
+                            wire (fires only when the working set carries
+                            hotness bits) — a failure degrades that batch
+                            to the uniform slot order: hot keys ride the
+                            int8 region (correct values, just
+                            un-prioritized precision), counted under
+                            wire.ici_pack_errors
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -159,6 +167,7 @@ KNOWN_SITES = (
     "table.writeback_worker",
     "membership.adopt_shard",
     "migrate.transfer",
+    "wire.ici_pack",
 )
 
 

@@ -229,6 +229,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.pbx_table_shard_keys.argtypes = [
             ctypes.c_void_p, ctypes.c_int, _u64p, ctypes.c_int64,
         ]
+        lib.pbx_table_shows_peek.restype = ctypes.c_int
+        lib.pbx_table_shows_peek.argtypes = [
+            ctypes.c_void_p, _u64p, ctypes.c_int64, _f32p,
+        ]
         lib.pbx_table_snapshot_count.restype = ctypes.c_int64
         lib.pbx_table_snapshot_count.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
@@ -522,6 +526,20 @@ class NativeHostStore:
             if got < 0:
                 raise IOError(f"native shard_shows failed rc={got}")
             out = out[:got]
+        return out
+
+    def shows_peek(self, keys: np.ndarray) -> np.ndarray:
+        """Decayed shows for a key batch, mem tier only (disk/absent = 0);
+        pure read — never creates, promotes or touches a row."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.zeros(len(keys), np.float32)
+        if len(keys):
+            rc = int(self._lib.pbx_table_shows_peek(
+                self._h, _as_ptr(keys, ctypes.c_uint64), len(keys),
+                _as_ptr(out, ctypes.c_float),
+            ))
+            if rc < 0:
+                raise IOError(f"native shows_peek failed rc={rc}")
         return out
 
     def shard_keys(self, shard: int) -> np.ndarray:

@@ -19,6 +19,7 @@ from paddlebox_tpu.parallel import (
     pipeline_forward,
 )
 from paddlebox_tpu.parallel.pipeline import mlp_stage_apply, mlp_stage_init
+from paddlebox_tpu.parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 N_STAGES = 4
@@ -53,7 +54,7 @@ def test_pipeline_forward_matches_sequential(stages):
         return fwd(jax.tree.map(lambda a: a[0], params), xm)
 
     mapped = jax.jit(
-        jax.shard_map(
+        shard_map(
             run, mesh=plan.mesh,
             in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P()),
             out_specs=P(),

@@ -22,6 +22,7 @@ from paddlebox_tpu.parallel import (
     make_pipeline_train_step,
     pipeline_forward,
 )
+from paddlebox_tpu.parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 # 4 stages, heterogeneous widths AND layer counts; H = 16, L = 3
@@ -64,7 +65,7 @@ def test_hetero_forward_matches_unpadded(built):
 
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
     mapped = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda p, xm: fwd(jax.tree.map(lambda a: a[0], p), xm),
             mesh=plan.mesh,
             in_specs=(jax.tree.map(lambda _: P("pp"), stacked), P()),

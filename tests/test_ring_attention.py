@@ -11,6 +11,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from paddlebox_tpu.parallel import make_mesh, ring_attention, ulysses_attention
+from paddlebox_tpu.parallel.mesh import shard_map
 
 N_DEV = 8
 B, S_LOC, H, D = 2, 4, 8, 16  # global seq = 32
@@ -51,7 +52,7 @@ def test_matches_full_attention(causal, impl):
         return fn(ql, kl, vl, "sp", causal=causal)
 
     mapped = jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=plan.mesh,
             in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
             out_specs=P(None, "sp"),
@@ -76,7 +77,7 @@ def test_ring_attention_grads_match():
         return jnp.sum(o)
 
     mapped = jax.jit(
-        jax.shard_map(
+        shard_map(
             jax.grad(ring_sum, argnums=(0, 1, 2)),
             mesh=plan.mesh,
             in_specs=(P(None, "sp"),) * 3,
@@ -101,7 +102,7 @@ def test_ulysses_head_divisibility():
         return ulysses_attention(ql, ql, ql, "sp")
 
     with pytest.raises(ValueError, match="divisible"):
-        jax.shard_map(
+        shard_map(
             local, mesh=plan.mesh, in_specs=(P(None, "sp"),),
             out_specs=P(None, "sp"), check_vma=False,
         )(shard_seq(plan, jnp.tile(x, (1, N_DEV, 1, 1))[:, : S_LOC * N_DEV]))
@@ -118,7 +119,7 @@ def test_bf16_inputs_accumulate_in_f32(impl):
         return fn(ql, kl, vl, "sp", causal=True)
 
     mapped = jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=plan.mesh,
             in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
             check_vma=False,

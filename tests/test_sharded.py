@@ -20,6 +20,7 @@ from paddlebox_tpu.metrics.auc import auc_compute
 from paddlebox_tpu.models import DeepFM
 from paddlebox_tpu.ops.pull_push import pull_sparse_rows
 from paddlebox_tpu.parallel import make_mesh, sharded_pull
+from paddlebox_tpu.parallel.mesh import shard_map
 from paddlebox_tpu.table import (
     HostSparseTable,
     PassWorkingSet,
@@ -78,7 +79,7 @@ def test_sharded_pull_matches_direct(schema, setup):
         return jnp.take(pulled, inv[0], axis=0)[None]
 
     mapped = jax.jit(
-        jax.shard_map(
+        shard_map(
             pull_local,
             mesh=plan.mesh,
             in_specs=(P(plan.axis), P(plan.axis), P(plan.axis)),

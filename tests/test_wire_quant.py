@@ -11,6 +11,7 @@ import pytest
 jax = pytest.importorskip("jax")
 
 from paddlebox_tpu import config
+from paddlebox_tpu.parallel.mesh import shard_map
 from paddlebox_tpu.ops.wire_quant import (
     fetch_rows,
     row_wire_nbytes,
@@ -336,7 +337,7 @@ def test_ici_wire_preserves_full_counter_head_conv_layout():
         config.set_flag("ici_wire_dtype", mode)
         try:
             mapped = jax.jit(
-                jax.shard_map(
+                shard_map(
                     lambda t, r: sharded_pull(
                         t[0], r[0], lay, 0.0, 1.0, plan.axis
                     )[None],
@@ -398,7 +399,7 @@ def test_ici_int8_extended_pull_sections_isolate_expand():
         config.set_flag("ici_wire_dtype", mode)
         try:
             mapped = jax.jit(
-                jax.shard_map(
+                shard_map(
                     lambda t, r: sharded_pull(
                         t[0], r[0], lay, 0.0, 1.0, plan.axis, extended=True
                     )[None],
@@ -462,7 +463,7 @@ def test_ici_int8_push_sections_isolate_expand_grads():
         config.set_flag("ici_wire_dtype", mode)
         try:
             mapped = jax.jit(
-                jax.shard_map(
+                shard_map(
                     lambda r: _compressed_a2a(r[0], plan.axis, 2, sections)[None],
                     mesh=plan.mesh,
                     in_specs=(P(plan.axis),),

@@ -34,6 +34,17 @@ train + writeback — under seeded ``transport.send`` /
   JAX_PLATFORMS=cpu python tools/chaos_probe.py --distributed 3 \
       [--passes N] [--rows N] [--seed N] [--send-flake-prob P] [--json]
 
+``--ici-wire`` is the frequency-adaptive wire A/B: four mesh-trainer days
+over the SAME zipf-keyed day (4 virtual devices, embedx_dim=16) in fp32 /
+bf16 / adaptive / adaptive-with-ablation-off, reporting the compiled
+``wire.a2a_payload_bytes`` per mode plus AUC. Green iff the adaptive
+payload is >=2x under fp32 and below uniform bf16, the adaptive day is
+AUC-neutral vs fp32 (|delta| <= 0.02), hotness engaged, and the ablation
+day matches fp32 bitwise:
+
+  JAX_PLATFORMS=cpu python tools/chaos_probe.py --ici-wire \\
+      [--passes N] [--rows N] [--seed N] [--json]
+
 ``--kill-rank R`` is the elastic-membership soak: an N-rank supervised
 day (``--ranks``, default 4) loses rank R at the top of pass 1; the
 survivors run the membership verdict round, adopt the dead rank's shard
@@ -441,6 +452,163 @@ def run_serve(args):
         "caught_up_after_repair": bool(caught_up),
         "final_served_idx": v2.delta_idx,
         "parity_after_repair_bitwise": bool(recovered),
+        "ok": bool(ok),
+    }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if ok else 1
+
+
+
+def _ici_zipf_day(tmpdir, n_passes, rows, seed):
+    """A zipf-keyed day: a small hot set dominates the traffic, the long
+    tail shows up once or twice — the distribution the adaptive wire is
+    built for. Labels are learnable so AUC is meaningful."""
+    rng = np.random.default_rng(seed)
+    files = []
+    n_keys = 300
+    for p in range(n_passes):
+        path = os.path.join(tmpdir, f"zipf-{p}.txt")
+        with open(path, "w") as f:
+            for _ in range(rows):
+                keys = np.minimum(rng.zipf(1.3, S), n_keys)
+                keys = keys + np.arange(S) * n_keys  # per-slot key spaces
+                label = 1.0 if (keys % 7 == 0).any() else 0.0
+                parts = [f"1 {label}"] + [f"1 {k}" for k in keys]
+                f.write(" ".join(parts) + "\n")
+        files.append(path)
+    return files
+
+
+def run_ici_wire(args):
+    """A/B the frequency-adaptive ICI wire against the uniform modes.
+
+    Four mesh-trainer days over the SAME zipf day (4 virtual devices,
+    embedx_dim=16): fp32, bf16, adaptive, and adaptive with the
+    ici_wire_adaptive ablation off. Gates: the compiled a2a payload must
+    shrink >=2x vs fp32 and below uniform bf16, the adaptive day must stay
+    AUC-neutral vs fp32 (|delta| <= 0.02), hotness must actually engage
+    (hot keys > 0 once shows accumulate), and the ablation day must finish
+    bitwise-identical to fp32.
+    """
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import optax
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.data import BoxPSDataset
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.table import (
+        HostSparseTable,
+        SparseOptimizerConfig,
+        ValueLayout,
+    )
+    from paddlebox_tpu.train import CTRTrainer, TrainStepConfig
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    n_dev = 4
+    config.set_flag("ici_hot_frac", 0.125)
+    config.set_flag("ici_hot_show", 3.0)
+
+    def day(mode, adaptive_on, files):
+        config.set_flag("ici_wire_dtype", mode)
+        config.set_flag("ici_wire_adaptive", adaptive_on)
+        layout = ValueLayout(embedx_dim=16)
+        opt = SparseOptimizerConfig(
+            embedx_threshold=0.0, show_clk_decay=0.98, shrink_threshold=0.0
+        )
+        table = HostSparseTable(layout, opt, n_shards=n_dev, seed=0)
+        plan = make_mesh(n_dev)
+        ds = BoxPSDataset(
+            make_schema(), table, batch_size=B, n_mesh_shards=n_dev,
+            shuffle_mode="none",
+        )
+        model = DeepFM(
+            num_slots=S, feat_width=layout.pull_width,
+            embedx_dim=layout.embedx_dim, hidden=(16,),
+        )
+        cfg = TrainStepConfig(
+            num_slots=S, batch_size=B // n_dev, layout=layout,
+            sparse_opt=opt, auc_buckets=100, axis_name=plan.axis,
+        )
+        tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2), plan=plan)
+        tr.init_params(jax.random.PRNGKey(0))
+        overflow0 = int(STAT_GET("wire.ici_hot_overflow_keys"))
+        auc = float("nan")
+        for f in files:
+            ds.set_filelist([f])
+            ds.load_into_memory()
+            ds.begin_pass(round_to=8)
+            out = tr.train_pass(ds)
+            auc = float(out["auc"])
+            ds.end_pass(tr.trained_table())
+        keys = np.sort(table.keys())
+        from paddlebox_tpu.ops import wire_quant
+
+        # wire.ici_hot_keys is a gauge (STAT_SET at ws finalize) — a leg
+        # that never engages the adaptive wire would read the previous
+        # leg's stale value
+        engaged = wire_quant.ici_adaptive_engaged()
+        return {
+            "auc": auc,
+            "payload_bytes": int(STAT_GET("wire.a2a_payload_bytes")),
+            "fp32_bytes": int(STAT_GET("wire.a2a_fp32_bytes")),
+            "dtype_bits": int(STAT_GET("wire.a2a_dtype_bits")),
+            "hot_keys": int(STAT_GET("wire.ici_hot_keys")) if engaged else 0,
+            "hot_overflow": int(STAT_GET("wire.ici_hot_overflow_keys"))
+            - overflow0,
+            "table": (keys, table.pull_or_create(keys)),
+        }
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as tmpdir:
+        files = _ici_zipf_day(tmpdir, args.passes, args.rows, args.seed)
+        legs = {
+            "fp32": day("fp32", True, files),
+            "bf16": day("bf16", True, files),
+            "adaptive": day("adaptive", True, files),
+            "ablation": day("adaptive", False, files),
+        }
+    wall = time.perf_counter() - t0
+
+    kf, vf = legs["fp32"].pop("table")
+    ko, vo = legs["ablation"].pop("table")
+    legs["bf16"].pop("table")
+    legs["adaptive"].pop("table")
+    ablation_bitwise = bool(
+        np.array_equal(kf, ko) and np.array_equal(vf, vo)
+    )
+    pay = {m: legs[m]["payload_bytes"] for m in legs}
+    ratio_fp32 = _ratio(legs["adaptive"]["fp32_bytes"], pay["adaptive"])
+    auc_delta = abs(legs["adaptive"]["auc"] - legs["fp32"]["auc"])
+    ok = (
+        ratio_fp32 >= 2.0
+        and pay["adaptive"] < pay["bf16"]
+        and auc_delta <= 0.02
+        and legs["adaptive"]["hot_keys"] > 0
+        and ablation_bitwise
+        and legs["ablation"]["payload_bytes"] == pay["fp32"]
+    )
+    report = {
+        "probe": "ici_wire",
+        "passes": args.passes,
+        "rows": args.rows,
+        "seed": args.seed,
+        "devices": n_dev,
+        "legs": {
+            m: {k: v for k, v in r.items() if k != "table"}
+            for m, r in legs.items()
+        },
+        "payload_ratio_fp32_over_adaptive": round(ratio_fp32, 3),
+        "auc_delta_adaptive_vs_fp32": round(auc_delta, 5),
+        "adaptive_below_bf16": bool(pay["adaptive"] < pay["bf16"]),
+        "ablation_bitwise_fp32": ablation_bitwise,
+        "wall_s": round(wall, 2),
         "ok": bool(ok),
     }
     print(json.dumps(report, indent=None if args.json else 2))
@@ -1239,6 +1407,12 @@ def main(argv=None):
                          "skip a corrupted published delta with an alarm, "
                          "keep serving the last good version bitwise, and "
                          "catch up once the delta is repaired")
+    ap.add_argument("--ici-wire", action="store_true",
+                    help="A/B the frequency-adaptive ICI wire: mesh-trainer "
+                         "days over one zipf-keyed day in fp32 / bf16 / "
+                         "adaptive / ablation, gating the >=2x payload cut "
+                         "vs fp32, adaptive < bf16, AUC neutrality, and the "
+                         "off-ablation bitwise match")
     ap.add_argument("--json", action="store_true", help="machine output only")
     args = ap.parse_args(argv)
 
@@ -1246,6 +1420,8 @@ def main(argv=None):
         import native_sanitize
 
         return native_sanitize.main(["--tsan"] if args.tsan else [])
+    if args.ici_wire:
+        return run_ici_wire(args)
     if args.serve:
         return run_serve(args)
     if args.wedge_backend:

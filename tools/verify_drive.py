@@ -258,4 +258,30 @@ print(f"[10] elastic membership ok: rank {_live['killed_rank']} killed "
       f"mid-pass, {len(_live['survivors'])} survivors adopted "
       f"{_live['membership_adopts']} range(s), epoch -> "
       f"{_live['ownership_epoch_after']}, digest+AUC bitwise vs fresh run")
+
+# --- 11. frequency-adaptive ICI wire: A/B soak + committed artifact -----
+# The --ici-wire leg trains the SAME zipf day under fp32 / bf16 /
+# adaptive / ablation-off and gates the >=2x compiled-payload cut vs
+# fp32, adaptive below uniform bf16, AUC neutrality, and the off-
+# ablation bitwise match; SOAK_ICIWIRE.json is the committed record of
+# that gate and must agree with a live re-run.
+_iwsoak_path = os.path.join(os.path.dirname(_here), "SOAK_ICIWIRE.json")
+assert os.path.exists(_iwsoak_path), "SOAK_ICIWIRE.json missing from the repo"
+with open(_iwsoak_path) as _f:
+    _iw = _json.load(_f)
+assert _iw["ok"] and _iw["ablation_bitwise_fp32"], _iw
+assert _iw["payload_ratio_fp32_over_adaptive"] >= 2.0, _iw
+assert _iw["adaptive_below_bf16"] and _iw["auc_delta_adaptive_vs_fp32"] <= 0.02, _iw
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "chaos_probe.py"),
+     "--ici-wire", "--json"],
+    capture_output=True, text=True, timeout=600)
+assert r.returncode == 0, f"ici-wire soak red:\n{r.stdout}{r.stderr}"
+_iwl = _json.loads(r.stdout.strip().splitlines()[-1])
+assert _iwl["ok"] and _iwl["ablation_bitwise_fp32"], _iwl
+assert _iwl["payload_ratio_fp32_over_adaptive"] >= 2.0, _iwl
+print(f"[11] adaptive ICI wire ok: payload cut "
+      f"{_iwl['payload_ratio_fp32_over_adaptive']}x vs fp32, below bf16, "
+      f"AUC delta {_iwl['auc_delta_adaptive_vs_fp32']}, "
+      f"{_iwl['legs']['adaptive']['hot_keys']} hot key(s), ablation bitwise")
 print("VERIFY DRIVE PASS")
