@@ -577,6 +577,15 @@ def main():
             "selects": int(STAT_GET("kernel_plan.selects")),
             "selects_pallas": int(STAT_GET("kernel_plan.selects_pallas")),
         },
+        # elastic membership (parallel/membership.py): ownership epoch,
+        # fleet size and lifetime join commits — a single-process bench
+        # leaves all three gauges at zero; the elastic soaks
+        # (chaos_probe --kill-rank / --join-rank) move these
+        "membership": {
+            "epoch": int(STAT_GET("membership.epoch")),
+            "live_ranks": int(STAT_GET("membership.live_ranks")),
+            "joins_total": int(STAT_GET("membership.joins_total")),
+        },
         # pass-prepare pad sweep (native pbx_block_stats counter sweep):
         # must stay a small fraction of train_pass_s at any pass size
         "prepare_s": round(getattr(trainer, "last_prepare_s", -1.0), 3),

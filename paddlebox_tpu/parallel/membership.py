@@ -120,11 +120,21 @@ class OwnershipMap:
     @classmethod
     def even(cls, n_mesh_shards: int, n_ranks: int, epoch: int = 0) -> "OwnershipMap":
         """Canonical largest-remainder split over ranks 0..n_ranks-1."""
-        counts = apportion(n_mesh_shards, n_ranks)
+        return cls.even_over(n_mesh_shards, range(n_ranks), epoch)
+
+    @classmethod
+    def even_over(
+        cls, n_mesh_shards: int, ranks: Iterable[int], epoch: int = 0
+    ) -> "OwnershipMap":
+        """Largest-remainder split over an arbitrary live set — the
+        initial map of a fleet smaller than its endpoint list (slots
+        reserved for future joiners)."""
+        live = sorted(int(r) for r in ranks)
+        counts = apportion(n_mesh_shards, len(live))
         starts = [0]
         for c in counts:
             starts.append(starts[-1] + c)
-        return cls(n_mesh_shards, range(n_ranks), starts, epoch)
+        return cls(n_mesh_shards, live, starts, epoch)
 
     def shrink(self, dead: Iterable[int]) -> "OwnershipMap":
         """Successor map without ``dead``, epoch bumped. Deterministic —
@@ -152,6 +162,81 @@ class OwnershipMap:
     def rebalance(self, starts: Sequence[int]) -> "OwnershipMap":
         """Successor map with the same live set and new boundaries."""
         return OwnershipMap(self.n_mesh_shards, self.live_ranks, starts, self.epoch + 1)
+
+    def grow(self, joiner: int, shard_loads=None) -> "OwnershipMap":
+        """Successor map WITH ``joiner``, epoch bumped — the dual of
+        :meth:`shrink`. Deterministic from (map, joiner, loads): every
+        rank derives the identical successor, so only the decision to
+        admit rides the wire, never the map itself.
+
+        Minimal movement by design: only the joiner's flanking neighbors
+        in rank order cede shards — every other survivor KEEPS its exact
+        range, so the only live-to-live transfers a join ever needs are
+        flank -> joiner, streamed through the existing stage-then-commit
+        ``migrate_ranges`` path. The carve is hot-load-aware rather than
+        key-count-aware: the combined flanking window is recut at
+        cumulative-load quantiles (the :func:`plan_rebalance` sweep
+        applied to the neighborhood), so the joiner takes the load-heavy
+        middle of its neighborhood and the flanks keep balanced rims.
+        ``shard_loads`` is a length-``n_mesh_shards`` hotness/occupancy
+        vector (the supervisor feeds decayed show counts + tier
+        occupancy); None or all-zero falls back to a uniform carve."""
+        j = int(joiner)
+        if j < 0:
+            raise ValueError(f"joiner rank {j} must be >= 0")
+        if j in self.live_ranks:
+            raise ValueError(f"rank {j} is already live in {self!r}")
+        if shard_loads is None:
+            loads = np.ones(self.n_mesh_shards, dtype=np.float64)
+        else:
+            loads = np.asarray(shard_loads, dtype=np.float64)
+            if len(loads) != self.n_mesh_shards:
+                raise ValueError(
+                    f"need {self.n_mesh_shards} shard loads, got {len(loads)}"
+                )
+        live = sorted(self.live_ranks + (j,))
+        i = live.index(j)
+        left = live[i - 1] if i > 0 else None
+        right = live[i + 1] if i + 1 < len(live) else None
+        # the carve window: the flanking survivors' combined contiguous
+        # range (one flank when the joiner lands at either end)
+        win_lo = self.range_of(left)[0] if left is not None else self.range_of(right)[0]
+        win_hi = self.range_of(right)[1] if right is not None else self.range_of(left)[1]
+        parts = [r for r in (left, j, right) if r is not None]
+        cuts = [win_lo]
+        if win_hi > win_lo:
+            wloads = loads[win_lo:win_hi]
+            if float(wloads.sum()) <= 0:
+                wloads = np.ones(win_hi - win_lo, dtype=np.float64)
+            wtotal = float(wloads.sum())
+            cum = np.cumsum(wloads)
+            for k in range(1, len(parts)):
+                rel = int(
+                    np.searchsorted(cum, wtotal * k / len(parts), side="left")
+                ) + 1
+                cut = win_lo + rel
+                if win_hi - win_lo >= len(parts):
+                    # load mass piled at either edge of the window must not
+                    # starve a part into an empty range: when the window is
+                    # wide enough, every part (joiner included) lands at
+                    # least one shard
+                    cut = min(max(cut, win_lo + k), win_hi - (len(parts) - k))
+                cuts.append(min(max(cut, cuts[-1]), win_hi))
+        else:
+            # zero-width window (flanks own nothing): the joiner starts
+            # empty and the planned-migration path fills it in later
+            cuts.extend([win_lo] * (len(parts) - 1))
+        cuts.append(win_hi)
+        ranges = {
+            r: self.range_of(r)
+            for r in self.live_ranks
+            if r != left and r != right
+        }
+        for part_rank, lo, hi in zip(parts, cuts, cuts[1:]):
+            ranges[part_rank] = (lo, hi)
+        starts = [ranges[r][0] for r in live]
+        starts.append(self.n_mesh_shards)
+        return OwnershipMap(self.n_mesh_shards, live, starts, self.epoch + 1)
 
     # ---- queries ---------------------------------------------------------
 

@@ -238,6 +238,10 @@ class TcpTransport:
         self._links: Dict[int, _SendLink] = {
             r: _SendLink() for r in range(self.n_ranks)
         }
+        # accepted reader sockets: close() must tear these down too, or
+        # their local port stays busy and a successor incarnation of this
+        # rank cannot bind the same endpoint (elastic rejoin)
+        self._conns: set = set()  # guarded-by: _cond
         self._closed = False
         # listener
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -291,6 +295,20 @@ class TcpTransport:
                     # visible on this side
                     STAT_ADD("transport.accept_errors")
                 return
+            if self._closed:
+                # raced close(): a handshake here would impersonate a dead
+                # incarnation and silently eat the peer's retained tail
+                # best-effort courtesy shutdown; the close below is the
+                # real teardown and counts its own errors
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                # pbox-lint: disable=EXC007
+                except OSError:
+                    pass
+                self._close_sock(conn)
+                return
+            with self._cond:
+                self._conns.add(conn)
             threading.Thread(
                 target=self._reader, args=(conn,), daemon=True
             ).start()
@@ -321,9 +339,22 @@ class TcpTransport:
                     except (ConnectionError, OSError):
                         pass
                 return
+            incarnation_reset = False
             with self._cond:
+                if src in self._dead and self._delivered.get(src, 0) > 0:
+                    # a HELLO from a membership-dead rank is a NEW
+                    # incarnation dialing in (elastic rejoin): its stream
+                    # restarts at seq 1, so the old incarnation's delivered
+                    # count must not eat the fresh frames as duplicates.
+                    # Reset BEFORE the reply so the very first frame (the
+                    # join announce) is deliverable even while the rank is
+                    # still membership-dead.
+                    self._delivered[src] = 0
+                    incarnation_reset = True
                 delivered = self._delivered.get(src, 0)
                 self._last_seen[src] = time.monotonic()
+            if incarnation_reset:
+                STAT_ADD("transport.incarnation_resets")
             # resync point: the peer replays every frame after this count
             conn.sendall(_HELLO_REPLY.pack(_MAGIC, _VERSION, delivered))
             conn.settimeout(None)
@@ -415,6 +446,8 @@ class TcpTransport:
             return
         finally:
             self._close_sock(conn)
+            with self._cond:
+                self._conns.discard(conn)
 
     def _pop_locked(self, tag: str, src: int) -> bytes:
         with self._cond:  # re-entrant: callers already hold it
@@ -535,15 +568,38 @@ class TcpTransport:
     def mark_dead(self, ranks) -> None:
         """Confirm ranks dead at the membership layer: collectives stop
         sending to / waiting on them (their result slots become b""),
-        direct sends fail fast, heartbeats stop. Irreversible for the
-        transport's lifetime — a recovered host rejoins with a fresh
-        transport, not a resurrection."""
+        direct sends fail fast, heartbeats stop. Reversed only by an
+        explicit :meth:`mark_alive` when the membership layer admits a NEW
+        incarnation at that slot (elastic join) — a recovered host rejoins
+        with a fresh transport, not a resurrection of the old stream."""
         with self._cond:
             for r in ranks:
                 r = int(r)
                 if r != self.rank:
                     self._dead.add(r)
             # wake collectives blocked on a now-dead rank immediately
+            self._cond.notify_all()
+
+    def mark_alive(self, rank: int) -> None:
+        """Readmit a previously mark_dead rank: the membership layer
+        admitted a joiner at that slot (elastic grow).
+
+        Deliberately touches ONLY membership + detector state. The
+        outbound link keeps its seq space: a re-admitted peer that never
+        actually died (an aborted join attempt, retried) still holds our
+        delivered count, so resetting seqs would make every fresh frame
+        look like a duplicate to it. A genuinely NEW incarnation (killed
+        host rejoining with a fresh transport) is handled on the inbound
+        side instead — its HELLO resets the delivered counter (see
+        :meth:`_reader`), and its HELLO_REPLY resyncs our link the usual
+        way. The detector gets a fresh grace window so the readmitted
+        peer is not instantly re-declared dead by its old silence."""
+        r = int(rank)
+        if r == self.rank:
+            return
+        with self._cond:
+            self._dead.discard(r)
+            self._last_seen[r] = time.monotonic()
             self._cond.notify_all()
 
     def live_ranks(self) -> List[int]:
@@ -557,6 +613,15 @@ class TcpTransport:
     def is_marked_dead(self, rank: int) -> bool:
         with self._cond:
             return int(rank) in self._dead
+
+    def pending_sources(self, tag: str) -> List[int]:
+        """Non-consuming peek: source ranks with at least one queued frame
+        under ``tag``. The elastic boundary scan uses this to notice
+        waiting joiners without disturbing the inbox."""
+        with self._cond:
+            return sorted(
+                {src for (t, src), q in self._inbox.items() if t == tag and q}
+            )
 
     # ---- epoch discard ---------------------------------------------------
 
@@ -867,10 +932,38 @@ class TcpTransport:
         self._closed = True
         self._hb_stop.set()
         try:
+            # shutdown BEFORE close: the accept thread blocked in accept()
+            # holds the listening socket open past a bare close(), so the
+            # dead incarnation would keep completing handshakes and eat
+            # frames meant for its successor (elastic rejoin)
+            self._server.shutdown(socket.SHUT_RDWR)
+        # an already-dead listener (ENOTCONN and kin) is exactly the
+        # state shutdown is driving toward; close() below counts errors
+        # pbox-lint: disable=EXC007
+        except OSError:
+            pass
+        try:
             self._server.close()
         except OSError as e:
             STAT_ADD("transport.close_errors")
             PROFILER.instant("transport:close_error", {"error": repr(e)})
+        with self._cond:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            # shutdown BEFORE close: a reader blocked in recv() holds the
+            # kernel socket open, so a bare close() would neither send FIN
+            # to the peer nor wake the reader — the peer's link then looks
+            # healthy forever and its frames vanish into this dead
+            # incarnation instead of erroring over to the successor
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            # a peer-reset conn is already down — the state shutdown is
+            # driving toward; _close_sock counts real close errors
+            # pbox-lint: disable=EXC007
+            except OSError:
+                pass
+            self._close_sock(c)
         for r in range(self.n_ranks):
             with self._send_locks[r]:
                 link = self._links[r]

@@ -51,6 +51,68 @@ from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
 logger = logging.getLogger(__name__)
 
 
+def verify_chain_link(
+    root: str, rel: str, want_crc, require_manifest: bool
+) -> bool:
+    """CRC gate for one published chain link: the snapshot dir's manifest
+    must match the watermark's pin AND the manifest's per-file CRCs must
+    hold. Shared by the Follower's poll and the elastic joiner's catch-up
+    — both consume the SAME verification before trusting a snapshot."""
+    snap = os.path.join(root, rel)
+    if want_crc is not None and _manifest_crc(snap) != want_crc:
+        return False
+    return verify_snapshot(snap, require_manifest=require_manifest)
+
+
+def apply_published_chain(
+    root: str, table: HostSparseTable, require_manifest: bool = True
+) -> Optional[Dict[str, Any]]:
+    """CRC-verified base + delta chain apply into ``table`` — the
+    Follower's chain-apply path, shared with the elastic joiner's
+    catch-up so a joining rank trusts a published chain under exactly
+    the serve-replica rules.
+
+    Reads ``latest.json`` under ``root`` (atomic publish: a read sees a
+    whole watermark or the previous one), validates lineage (including
+    the mixed-epoch rejection — the trainer base-re-anchors at every
+    ownership-epoch flip, so a valid watermark is always single-epoch:
+    catching up across a mid-day re-anchor just means reading the
+    re-anchored chain), then verifies and applies base + every delta in
+    chain order. Returns the chain-head position dict (``date``,
+    ``delta_idx``, ``base_crc``, ``ownership_epoch``) or None on a cold
+    root; raises :class:`DeltaLineageError` on any CRC-failed link —
+    unlike a serving follower, a catch-up consumer has no last-good
+    version to keep, so a bad link is fatal to the attempt."""
+    wm = read_watermark(root)
+    if wm is None:
+        return None
+    validate_watermark(wm)
+    base_crc = wm["base"].get("manifest_crc")
+    if not verify_chain_link(root, wm["base"]["path"], base_crc, require_manifest):
+        raise DeltaLineageError(
+            f"base snapshot {wm['base']['path']!r} under {root} failed "
+            "CRC verification"
+        )
+    table.load(os.path.join(root, wm["base"]["path"]))
+    idx = int(wm["delta_idx"])
+    for i in range(1, idx + 1):
+        entry = wm["deltas"][i - 1]
+        if not verify_chain_link(
+            root, entry["path"], entry.get("manifest_crc"), require_manifest
+        ):
+            raise DeltaLineageError(
+                f"delta snapshot {entry['path']!r} under {root} failed "
+                "CRC verification (chain order is load-bearing)"
+            )
+        table.apply_delta(os.path.join(root, entry["path"]))
+    return {
+        "date": wm["date"],
+        "delta_idx": idx,
+        "base_crc": base_crc,
+        "ownership_epoch": int(wm.get("ownership_epoch", 0)),
+    }
+
+
 class Follower:
     """Tail a checkpoint root and maintain an atomically-served ScoringTable.
 
@@ -199,16 +261,10 @@ class Follower:
     # ---- internals -------------------------------------------------------
 
     def _verify(self, rel: str, want_crc, kind: str) -> bool:
-        """CRC gate for one chain link: the dir's manifest must match the
-        watermark's pin AND the manifest's per-file CRCs must hold. False
-        (+ alarm stats) on any mismatch — the caller keeps the last good
-        version serving."""
-        snap = os.path.join(self.root, rel)
-        ok = True
-        if want_crc is not None and _manifest_crc(snap) != want_crc:
-            ok = False
-        if ok:
-            ok = verify_snapshot(snap, require_manifest=self.require_manifest)
+        """Alarm-wrapped :func:`verify_chain_link`: False (+ alarm stats)
+        on any mismatch — the caller keeps the last good version
+        serving."""
+        ok = verify_chain_link(self.root, rel, want_crc, self.require_manifest)
         if not ok:
             STAT_ADD("serve.corrupt_skipped")
             STAT_SET("serve.last_corrupt_unix", time.time())

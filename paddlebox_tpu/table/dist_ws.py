@@ -431,3 +431,38 @@ class DistributedWorkingSet:
                 )
             if len(keys):
                 self._table.push(keys, flat[s, : len(keys)])
+
+
+def hot_shard_loads(table, ownership: OwnershipMap, rank: int) -> np.ndarray:
+    """Hotness-weighted per-mesh-shard load of ``rank``'s owned range
+    (float64, length ``hi - lo``) — the elastic planner's load vector.
+
+    The same Parallax-style frequency prior the adaptive ICI wire reads:
+    each owned key weighs its decayed show count (``shows_peek`` — pure,
+    mem-tier only) plus a residency term from the tiered store's
+    occupancy split (``tier_stats`` per-host-shard mem/disk rows): a key
+    whose host shard is mostly disk-resident is cheaper to move and
+    colder to serve, so it weighs half a mem-resident key. Migrating or
+    carving by this vector moves *hot* load, not raw key counts — a
+    joiner carved at its quantile cuts takes traffic, not tombstone mass.
+    Deterministic from the local table state; callers allgather the
+    per-rank slices into the global vector."""
+    lo, hi = ownership.range_of(int(rank))
+    if hi <= lo:
+        return np.zeros(0, dtype=np.float64)
+    keys = table.keys()
+    mesh = key_to_shard(keys, ownership.n_mesh_shards)
+    mine = (mesh >= lo) & (mesh < hi)
+    keys, mesh = keys[mine], mesh[mine]
+    if len(keys) == 0:
+        return np.zeros(hi - lo, dtype=np.float64)
+    st = table.tier_stats()
+    mem = np.asarray(st["per_shard"]["mem_rows"], dtype=np.float64)
+    disk = np.asarray(st["per_shard"]["disk_rows"], dtype=np.float64)
+    frac_mem = np.where(mem + disk > 0, mem / np.maximum(mem + disk, 1.0), 1.0)
+    host = key_to_shard(keys, table.n_shards)
+    residency = 0.5 + 0.5 * frac_mem[host]
+    w = residency + np.asarray(table.shows_peek(keys), dtype=np.float64)
+    return np.bincount(mesh - lo, weights=w, minlength=hi - lo).astype(
+        np.float64
+    )

@@ -230,6 +230,11 @@ class CheckpointManager:
         # supervisor when membership changes — the next save_base
         # re-anchors the chain, and save_delta refuses to straddle a flip.
         self.ownership_epoch = 0
+        # the live rank set the publishing epoch corresponds to (None =
+        # non-elastic). Also supervisor-set; surfaced in the watermark so
+        # a follower (or a joining rank) can see the fleet size a chain
+        # was published under without parsing ownership maps.
+        self.live_ranks: Optional[list] = None
         os.makedirs(root, exist_ok=True)
 
     # ---- paths -----------------------------------------------------------
@@ -314,6 +319,8 @@ class CheckpointManager:
             "deltas": [entry(f"{date}/delta-{i:04d}") for i in range(1, idx + 1)],
             "published_unix": time.time(),
         }
+        if self.live_ranks is not None:
+            wm["live_ranks"] = [int(r) for r in self.live_ranks]
         dense = cur.get("dense")
         if dense is not None:
             dpath = os.path.join(self._day(date), dense)

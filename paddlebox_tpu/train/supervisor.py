@@ -84,13 +84,23 @@ from paddlebox_tpu.obs.flight_recorder import FLIGHT_RECORDER
 from paddlebox_tpu.obs.metrics_writer import MetricsWriter
 from paddlebox_tpu.parallel import membership as _membership
 from paddlebox_tpu.parallel.transport import PeerDeadError
-from paddlebox_tpu.train.checkpoint import MembershipEpochError
+from paddlebox_tpu.train.checkpoint import MembershipEpochError, rank_root
+from paddlebox_tpu.utils.faultinject import InjectedFault
+from paddlebox_tpu.utils.faultinject import fire as _fault_fire
 from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from paddlebox_tpu.utils.trace import PROFILER
 
 # incident kinds that end a pass (or the day) rather than healing in
 # place: each one flushes the flight recorder into an incident bundle
 _FATAL_INCIDENT_KINDS = ("data_poisoned", "peer_abort", "gave_up")
+
+# PBTX control tags of the elastic join protocol (grow half). The
+# announce is an un-epoched knock — the joiner does not know the fleet's
+# clocks yet, so the tag cannot carry them; the offer is addressed per
+# joiner rank so a concurrent second announcer can never consume another
+# rank's admission.
+_JOIN_ANNOUNCE_TAG = "ctl:join:announce"
+_JOIN_OFFER_TAG = "ctl:join:offer"
 
 config.define_flag(
     "supervisor_max_retries",
@@ -214,12 +224,27 @@ class ElasticConfig:
     > 1.0 additionally arms planned migration: at a confirmed pass
     boundary, when the max/mean per-rank key-load ratio crosses it, the
     supervisor recuts ownership boundaries and streams the moving ranges
-    (see docs/ROBUSTNESS.md, "Elastic membership & key migration")."""
+    (see docs/ROBUSTNESS.md, "Elastic membership & key migration").
+
+    The grow half (docs/ROBUSTNESS.md, "Elastic grow & autoscale"):
+    ``initial_live`` names the ranks actually RUNNING at day start when
+    the transport's endpoint list reserves slots for future joiners —
+    the supervisor marks the others dead and installs the even ownership
+    split over the initial set. ``target_ranks`` is the autoscale
+    ceiling: a waiting joiner is admitted at a published pass boundary
+    only while the live count is below it (None admits whenever one
+    knocks). ``hot_migrate`` switches the planned-migration load vector
+    from raw key counts to the Parallax-style hotness prior (tier
+    residency + decayed shows, table/dist_ws.hot_shard_loads) — the
+    joiner carve is ALWAYS hotness-weighted."""
 
     shared_root: str
     migrate_skew: float = 0.0  # <= 1.0 disables planned migration
     adopt_retries: int = 2
     member_timeout: Optional[float] = None
+    target_ranks: Optional[int] = None
+    initial_live: Optional[Sequence[int]] = None
+    hot_migrate: bool = False
 
 
 @dataclass
@@ -262,7 +287,8 @@ class Incident:
     kind: str      # load_error | train_error | gate_nan | gate_auc |
                    # prefetch_error | ckpt_save_error | escalate_resume |
                    # gave_up | skipped | peer_abort | data_poisoned |
-                   # rank_death | migrate | migrate_abort
+                   # rank_death | migrate | migrate_abort | rank_join |
+                   # join_abort
     action: str    # retry | revert_retry | resume | raise | skip
     attempt: int
     detail: str = ""
@@ -331,6 +357,32 @@ class PassSupervisor:
         self.elastic = elastic
         if elastic is not None and self.coord is not None:
             self.coord.raise_peer_dead = True
+            tp = self.coord.transport
+            if elastic.initial_live is not None:
+                # the endpoint list reserves slots for FUTURE joiners: only
+                # initial_live ranks are running now. Mark the rest dead so
+                # collectives don't wait on empty slots, and start from the
+                # even ownership split over the actual fleet.
+                live0 = sorted(int(r) for r in elastic.initial_live)
+                if tp.rank not in live0:
+                    raise ValueError(
+                        f"rank {tp.rank} is not in initial_live {live0} — "
+                        "a rank outside the initial fleet joins via "
+                        "join_day, not run_day"
+                    )
+                tp.mark_dead([r for r in range(tp.n_ranks) if r not in live0])
+                if getattr(dataset, "ownership", None) is None:
+                    dataset.ownership = _membership.OwnershipMap.even_over(
+                        dataset.n_mesh_shards, live0
+                    )
+            omap0 = getattr(dataset, "ownership", None)
+            STAT_SET(
+                "membership.epoch", omap0.epoch if omap0 is not None else 0
+            )
+            STAT_SET(
+                "membership.live_ranks",
+                len(omap0.live_ranks) if omap0 is not None else tp.n_ranks,
+            )
         # set when ownership flipped mid-chain: the next checkpoint save
         # re-anchors with a base (a delta must not straddle an epoch flip)
         self._force_base = False
@@ -430,6 +482,10 @@ class PassSupervisor:
             STAT_ADD("supervisor_migrate")
         elif kind == "migrate_abort":
             STAT_ADD("supervisor_migrate_abort")
+        elif kind == "rank_join":
+            STAT_ADD("supervisor_rank_join")
+        elif kind == "join_abort":
+            STAT_ADD("supervisor_join_abort")
         else:  # pragma: no cover - new kinds must be added above
             STAT_ADD("supervisor_other")
         PROFILER.instant(f"supervisor:{kind}", inc.as_dict())
@@ -744,8 +800,10 @@ class PassSupervisor:
         self.ds.ownership = new_map
         if self.checkpoint is not None:
             self.checkpoint.ownership_epoch = new_map.epoch
+            self.checkpoint.live_ranks = [int(r) for r in new_map.live_ranks]
         self._force_base = True
         STAT_SET("membership.epoch", new_map.epoch)
+        STAT_SET("membership.live_ranks", len(new_map.live_ranks))
         if self.checkpoint is not None and self._date is not None:
             self._save_checkpoint("base")
 
@@ -889,36 +947,44 @@ class PassSupervisor:
         )
         PROFILER.instant("supervisor:membership_change", bundle)
 
-    def _maybe_migrate(self) -> None:
-        """Planned migration at a confirmed pass boundary: recut ownership
-        boundaries when per-rank key-load skew crosses the threshold and
-        stream the moving shard ranges owner->owner. Atomic at the
-        boundary: receivers stage, a commit verdict decides, and only a
-        global YES flips the epoch — any failure leaves the old epoch
-        serving and the plan is re-derived at the next boundary."""
+    def _gather_shard_loads(
+        self, omap, hot: bool, tag: str
+    ) -> np.ndarray:
+        """Allgather the global per-mesh-shard load vector under ``omap``.
+
+        Each live rank contributes exactly its owned slice as little-
+        endian float64 (8 bytes/shard). ``hot=False`` counts raw owned
+        keys; ``hot=True`` weighs them by the Parallax-style hotness
+        prior — tiered residency + decayed show counts, computed by
+        table/dist_ws.hot_shard_loads — so planners move traffic, not
+        tombstone mass. Either way the vector is deterministic from the
+        boundary's table state, so every rank derives the identical plan
+        from the identical gather."""
         from paddlebox_tpu.table.sparse_table import key_to_shard
 
-        assert self.elastic is not None and self.coord is not None
         tp = self.coord.transport
-        omap = self._ownership_map()
-        if len(omap.live_ranks) < 2:
-            return
         # the carried device table may hold rows the host store lags on —
-        # migration reads host rows, so everything owed must land first
+        # planners read host rows, so everything owed must land first
         drain = getattr(self.table, "drain_pending", None)
         if drain is not None:
             drain()
         lo, hi = omap.range_of(tp.rank)
-        keys = self.table.keys()
-        sh = key_to_shard(keys, omap.n_mesh_shards)
-        mine = sh[(sh >= lo) & (sh < hi)]
-        local = np.bincount(mine - lo, minlength=hi - lo).astype("<i8")
+        if hot:
+            from paddlebox_tpu.table.dist_ws import hot_shard_loads
+
+            local = hot_shard_loads(self.table, omap, tp.rank)
+        else:
+            keys = self.table.keys()
+            sh = key_to_shard(keys, omap.n_mesh_shards)
+            mine = sh[(sh >= lo) & (sh < hi)]
+            local = np.bincount(mine - lo, minlength=hi - lo).astype(
+                np.float64
+            )
         views = tp.allgather(
-            local.tobytes(),
-            f"ctl:load:{self._pass_seq}@e{self.coord.epoch}",
+            local.astype("<f8").tobytes(), tag,
             timeout=self.elastic.member_timeout,
         )
-        loads = np.zeros(omap.n_mesh_shards, np.int64)
+        loads = np.zeros(omap.n_mesh_shards, np.float64)
         for r in omap.live_ranks:
             rlo, rhi = omap.range_of(r)
             v = views[r]
@@ -931,7 +997,25 @@ class PassSupervisor:
                     f"load view from rank {r} has {len(v)} bytes, expected "
                     f"{(rhi - rlo) * 8} for shard range [{rlo},{rhi})"
                 )
-            loads[rlo:rhi] = np.frombuffer(v, dtype="<i8")
+            loads[rlo:rhi] = np.frombuffer(v, dtype="<f8")
+        return loads
+
+    def _maybe_migrate(self) -> None:
+        """Planned migration at a confirmed pass boundary: recut ownership
+        boundaries when per-rank key-load skew crosses the threshold and
+        stream the moving shard ranges owner->owner. Atomic at the
+        boundary: receivers stage, a commit verdict decides, and only a
+        global YES flips the epoch — any failure leaves the old epoch
+        serving and the plan is re-derived at the next boundary."""
+        assert self.elastic is not None and self.coord is not None
+        tp = self.coord.transport
+        omap = self._ownership_map()
+        if len(omap.live_ranks) < 2:
+            return
+        loads = self._gather_shard_loads(
+            omap, self.elastic.hot_migrate,
+            f"ctl:load:{self._pass_seq}@e{self.coord.epoch}",
+        )
         new_map = _membership.plan_rebalance(
             omap, loads, self.elastic.migrate_skew
         )
@@ -999,6 +1083,497 @@ class PassSupervisor:
                 "sent_bytes": int(xfer["sent_bytes"]),
             },
         )
+
+    # ---- elastic grow: the join protocol --------------------------------
+
+    def _boundary_elastic(self, publishing: bool) -> None:
+        """One elastic action per confirmed pass boundary, the autoscale
+        loop's decision point: admit a waiting joiner if the policy allows
+        (and the chain it must catch up from is being published), else
+        consider a planned hot-range migration. One action, not both — an
+        admission already recut ownership at this boundary, and the next
+        boundary re-derives skew under the grown map."""
+        admitted = False
+        if publishing:
+            admitted = self._maybe_admit_joiner()
+        if not admitted and self.elastic.migrate_skew > 1.0:
+            self._maybe_migrate()
+
+    def _maybe_admit_joiner(self) -> bool:
+        """Boundary scan of the grow half: look for announce knocks from
+        non-live ranks, converge the fleet on ONE joiner, and run the
+        admission round. The scan rides an allgather and admits only the
+        INTERSECTION of what every live rank saw — a knock still in
+        flight to some peer admits at the next boundary instead of
+        splitting the fleet. Returns True when a joiner was committed."""
+        assert self.elastic is not None and self.coord is not None
+        tp = self.coord.transport
+        omap = self._ownership_map()
+        pend = tp.pending_sources(_JOIN_ANNOUNCE_TAG)
+        waiting = [int(r) for r in pend if not omap.is_live(r)]
+        # consume the knocks now that they're counted: a waiting joiner
+        # re-announces every few hundred ms, and unconsumed frames from an
+        # already-admitted (or policy-refused) rank must not pile up
+        for r in pend:
+            while r in tp.pending_sources(_JOIN_ANNOUNCE_TAG):
+                tp.recv(_JOIN_ANNOUNCE_TAG, r, timeout=1.0)
+        views = tp.allgather(
+            json.dumps(waiting).encode(),
+            f"ctl:joinscan:{self._pass_seq}@e{self.coord.epoch}",
+            timeout=self.elastic.member_timeout,
+        )
+        common: Optional[set] = None
+        for r in omap.live_ranks:
+            seen = set(json.loads(views[r].decode() or "[]"))
+            common = seen if common is None else (common & seen)
+        if not common:
+            return False
+        if (
+            self.elastic.target_ranks is not None
+            and len(omap.live_ranks) >= self.elastic.target_ranks
+        ):
+            # at (or above) the autoscale target: leave announcers waiting
+            return False
+        return self._admit_joiner(min(common), omap)
+
+    def _admit_joiner(self, joiner: int, omap) -> bool:
+        """Survivor side of one admission round.
+
+        Hot loads are gathered among the CURRENT live set (the joiner
+        owns nothing and has nothing to vote with yet), the successor map
+        carves the joiner its quantile cuts, the lowest live rank
+        sponsors the offer, and the ceding flanks stream their ranges
+        through the staged ``migrate_ranges`` path. The commit verdict
+        composes with the death invariants: the JOINER dying mid-round
+        aborts the join cleanly at the old epoch (no shrink — the fleet
+        never grew); a SURVIVOR dying aborts the join and re-raises so
+        the caller's death handler runs the shrink."""
+        tp = self.coord.transport
+        loads = self._gather_shard_loads(
+            omap, True, f"ctl:jload:{self._pass_seq}@e{self.coord.epoch}"
+        )
+        new_map = omap.grow(joiner, loads)
+        planned = [
+            [int(lo), int(hi)]
+            for lo, hi, _src, dst in _membership.plan_moves(omap, new_map)
+            if dst == joiner
+        ]
+        seq = f"{self._pass_seq}.{new_map.epoch}"
+        # readmit BEFORE any collective that counts the joiner's slot.
+        # Deliberately after the load gather: mark_alive keeps the link's
+        # seq space (transport docstring), and a genuinely new incarnation
+        # already reset its inbound counter at HELLO.
+        tp.mark_alive(joiner)
+        if tp.rank == min(omap.live_ranks):
+            # one sponsor hands the joiner everything it needs to sync:
+            # both maps, the day/pass clocks, and the pass epoch its
+            # frames must carry
+            offer = {
+                "old_map": omap.to_json(),
+                "new_map": new_map.to_json(),
+                "date": self._date,
+                "pass_seq": self._pass_seq,
+                "pass_epoch": self.coord.epoch,
+            }
+            tp.send(
+                joiner, f"{_JOIN_OFFER_TAG}:{joiner}",
+                json.dumps(offer).encode(),
+            )
+        join_err: Optional[Exception] = None
+        xfer = None
+        try:
+            xfer = _membership.migrate_ranges(
+                tp, self.table, omap, new_map, seq, self.coord.epoch,
+                timeout=self.elastic.member_timeout,
+            )
+        except Exception as me:
+            join_err = me
+        try:
+            ok, detail = self.coord.exchange_verdict(
+                f"join:{seq}:{new_map.fingerprint()}",
+                join_err is None,
+                repr(join_err) if join_err else "",
+                fatal=True,
+            )
+        except PeerDeadError as e:
+            tp.mark_dead([joiner])
+            if set(int(d) for d in e.dead) <= {int(joiner)}:
+                # ONLY the joiner died mid-join: clean local abort, the
+                # fleet stays at the old epoch — no shrink round runs
+                # because membership never actually grew
+                self._join_abort(
+                    joiner, new_map, planned, f"joiner died mid-join: {e!r}"
+                )
+                return False
+            # a SURVIVOR died during the join: abort it, then let the
+            # caller's death handler run the shrink over the old map
+            self._join_abort(joiner, new_map, planned, repr(e))
+            raise
+        except (OSError, TimeoutError) as ve:
+            # commit-point uncertainty: same contract as migrate — die
+            # loudly rather than guess which side of the flip peers took
+            self._join_abort(joiner, new_map, planned, repr(ve))
+            raise PassFailure(
+                f"join commit verdict uncertain (transport failure "
+                f"mid-round): {ve!r}"
+            ) from ve
+        if not ok or join_err is not None:
+            # the joiner (or a ceding flank) voted NO: nothing was
+            # committed anywhere — receivers only staged — so the old
+            # epoch keeps serving bitwise and the joiner may re-announce
+            tp.mark_dead([joiner])
+            self._join_abort(
+                joiner, new_map, planned,
+                detail if join_err is None else repr(join_err),
+            )
+            return False
+        _membership.commit_staged(self.table, xfer["staged"])
+        self._install_ownership(new_map, prev_map=omap)
+        STAT_ADD("membership.joins_total")
+        self._record(
+            "rank_join", "commit", 0,
+            f"joiner={int(joiner)} ownership_epoch={new_map.epoch} "
+            f"planned_ranges={planned} sent_keys={xfer['sent_keys']}",
+        )
+        bundle = {
+            "joiner": int(joiner),
+            "live": [int(r) for r in new_map.live_ranks],
+            "ownership_epoch": int(new_map.epoch),
+            "planned_ranges": planned,
+            "sent_keys": int(xfer["sent_keys"]),
+        }
+        FLIGHT_RECORDER.note_incident("rank_join", bundle)
+        PROFILER.instant("supervisor:rank_join", bundle)
+        return True
+
+    def _join_abort(self, joiner: int, new_map, planned, reason) -> None:
+        """Abort bookkeeping for a failed or refused admission. Nothing
+        was committed (receivers only staged), so the fleet stays at the
+        OLD epoch bitwise; the incident bundle — joiner rank, the ranges
+        it would have taken, the epoch that never happened, and why —
+        lands under <ckpt>/obs/incidents for the postmortem."""
+        bundle = {
+            "joiner": int(joiner),
+            "planned_ranges": [[int(lo), int(hi)] for lo, hi in planned],
+            "ownership_epoch": int(new_map.epoch),
+            "reason": str(reason),
+        }
+        STAT_ADD("membership.joins_aborted")
+        self._record("join_abort", "retry", 0, json.dumps(bundle))
+        FLIGHT_RECORDER.note_incident("join_abort", bundle)
+        FLIGHT_RECORDER.dump(
+            "join_abort", json.dumps(bundle), dir_path=self._incident_dir
+        )
+        PROFILER.instant("supervisor:join_abort", bundle)
+
+    # ---- elastic grow: the joiner side -----------------------------------
+
+    def _announce_join(self) -> None:
+        """Best-effort knock on every potential sponsor. Fires the
+        ``membership.join_announce`` fault site (FLT008: an injected
+        failure aborts nothing durable — the announce is simply retried).
+        Unreachable peers are expected — the announcer does not know who
+        is live; the survivors' scan intersects what actually arrived."""
+        tp = self.coord.transport
+        _fault_fire("membership.join_announce")
+        for dst in range(tp.n_ranks):
+            if dst == tp.rank or tp.is_marked_dead(dst):
+                continue
+            try:
+                tp.send(dst, _JOIN_ANNOUNCE_TAG, b"")
+            # a knock bouncing off a dead or not-yet-up peer is the
+            # normal case — the announcer re-knocks every ~250ms and
+            # the survivors' scan intersects what actually arrived
+            # pbox-lint: disable=EXC007
+            except (ConnectionError, OSError):
+                continue
+
+    def _await_offer(self, deadline: float) -> Optional[Dict[str, Any]]:
+        """Announce (re-announcing every ~250ms) until a sponsor's offer
+        arrives; None on deadline. Every queued offer is consumed and the
+        NEWEST wins — a stale offer from an earlier aborted round must
+        not shadow the live one (its maps would fingerprint-mismatch the
+        fleet's verdict tag and stall the round out)."""
+        tp = self.coord.transport
+        tag = f"{_JOIN_OFFER_TAG}:{tp.rank}"
+        last_announce = -1.0
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                return None
+            if now - last_announce >= 0.25:
+                self._announce_join()
+                last_announce = now
+            payload = None
+            srcs = tp.pending_sources(tag)
+            while srcs:
+                for s in srcs:
+                    payload = tp.recv(tag, s, timeout=1.0)
+                srcs = tp.pending_sources(tag)
+            if payload is not None:
+                return json.loads(payload.decode())
+            time.sleep(0.02)
+
+    def _catch_up(self, old_map, new_map) -> Dict[str, Any]:
+        """Serve-follower catch-up: rebuild the gained ranges from the
+        ceding owners' PUBLISHED base+delta chains — the Follower's CRC-
+        verified chain apply (serve/follower.apply_published_chain),
+        including mid-chain epoch re-anchors: a valid watermark is always
+        single-epoch (validate_watermark rejects straddles), so a chain
+        that re-anchored mid-day is simply read from its newest base.
+
+        Returns per-piece (keys, rows) in ``plan_moves`` order — aligned
+        1:1 with what ``migrate_ranges`` stages — plus the ceding owners'
+        decay-epoch clock. Fires ``membership.catchup_apply`` once per
+        ceding source (FLT008: an injected failure aborts the join at the
+        OLD epoch — nothing was committed — and a retried join
+        succeeds)."""
+        from paddlebox_tpu.serve.follower import apply_published_chain
+        from paddlebox_tpu.table.sparse_table import (
+            HostSparseTable,
+            key_to_shard,
+        )
+
+        me = self.coord.transport.rank
+        pieces = [
+            (lo, hi, src)
+            for lo, hi, src, dst in _membership.plan_moves(old_map, new_map)
+            if dst == me
+        ]
+        scratches: Dict[int, Any] = {}
+        decay_epochs = 0
+        keys_by_piece: List[np.ndarray] = []
+        rows_by_piece: List[np.ndarray] = []
+        for lo, hi, src in pieces:
+            if src not in scratches:
+                _fault_fire("membership.catchup_apply")
+                scratch = HostSparseTable(
+                    self.table.layout, self.table.opt,
+                    n_shards=self.table.n_shards,
+                )
+                state = apply_published_chain(
+                    rank_root(self.elastic.shared_root, src), scratch
+                )
+                if state is None:
+                    raise RuntimeError(
+                        f"ceding rank {src} has no published chain under "
+                        f"{self.elastic.shared_root!r} — cannot catch up"
+                    )
+                scratches[src] = scratch
+                decay_epochs = max(
+                    decay_epochs, getattr(scratch, "decay_epochs", 0)
+                )
+            scratch = scratches[src]
+            keys = np.sort(scratch.keys())
+            sh = key_to_shard(keys, old_map.n_mesh_shards)
+            sel = keys[(sh >= lo) & (sh < hi)]
+            keys_by_piece.append(sel)
+            rows_by_piece.append(
+                scratch.pull_or_create(sel)
+                if len(sel)
+                else np.zeros((0, self.table.layout.width), np.float32)
+            )
+        return {
+            "keys_by_piece": keys_by_piece,
+            "rows_by_piece": rows_by_piece,
+            "decay_epochs": int(decay_epochs),
+            "keys": int(sum(len(k) for k in keys_by_piece)),
+        }
+
+    def _verify_catchup(self, catchup: Dict[str, Any], staged) -> None:
+        """Bitwise cross-check, chain vs wire: at a published boundary
+        the ceding owner's chain IS its table state, so the rows the
+        joiner rebuilt from disk must equal the rows it was streamed —
+        any divergence means a torn chain or a protocol bug, and the join
+        must abort (the migrated copy is never trusted on faith)."""
+        if len(staged) != len(catchup["keys_by_piece"]):
+            raise RuntimeError(
+                f"catch-up derived {len(catchup['keys_by_piece'])} pieces "
+                f"but the transfer staged {len(staged)}"
+            )
+        for i, (mkeys, mrows) in enumerate(staged):
+            ckeys = catchup["keys_by_piece"][i]
+            crows = catchup["rows_by_piece"][i]
+            if not (
+                np.array_equal(mkeys, ckeys) and np.array_equal(mrows, crows)
+            ):
+                raise RuntimeError(
+                    f"catch-up/transfer divergence on piece {i}: the "
+                    "published chain and the live migration disagree "
+                    f"({len(ckeys)} chain keys vs {len(mkeys)} wire keys)"
+                )
+
+    def _join_attempt(self, offer: Dict[str, Any]) -> bool:
+        """One admission attempt from a sponsor's offer (joiner side).
+
+        Sync the fleet's clocks, mark the ranks the successor map says
+        are dead, catch up from the published chains, receive the staged
+        transfer, cross-check the two bitwise, then vote in the commit
+        round. Once the offer is consumed this rank MUST vote — peers
+        block on its verdict slot, so every local failure (including a
+        dead ceding peer) folds into a NO vote rather than a silent bail;
+        only the verdict exchange itself failing abandons the round."""
+        tp = self.coord.transport
+        me = tp.rank
+        old_map = _membership.OwnershipMap.from_json(offer["old_map"])
+        new_map = _membership.OwnershipMap.from_json(offer["new_map"])
+        # adopt the fleet's clocks BEFORE any collective: verdict tags are
+        # scoped by pass_seq and pass epoch
+        self._pass_seq = int(offer["pass_seq"])
+        self._date = offer["date"]
+        epoch = int(offer["pass_epoch"])
+        self.coord.epoch = epoch
+        if hasattr(self.ds, "pass_epoch"):
+            self.ds.pass_epoch = epoch
+        tp.discard_epochs_below(epoch)
+        dead = [
+            r for r in range(tp.n_ranks)
+            if r != me and not new_map.is_live(r)
+        ]
+        if dead:
+            tp.mark_dead(dead)
+        seq = f"{self._pass_seq}.{new_map.epoch}"
+        planned = [
+            [int(lo), int(hi)]
+            for lo, hi, _src, dst in _membership.plan_moves(old_map, new_map)
+            if dst == me
+        ]
+        join_err: Optional[Exception] = None
+        xfer = None
+        catchup = None
+        try:
+            catchup = self._catch_up(old_map, new_map)
+            xfer = _membership.migrate_ranges(
+                tp, self.table, old_map, new_map, seq, epoch,
+                timeout=self.elastic.member_timeout,
+            )
+            self._verify_catchup(catchup, xfer["staged"])
+        except Exception as e:
+            # includes PeerDeadError: peers still block on this slot's
+            # verdict, so fold the failure into a NO vote
+            join_err = e
+        try:
+            ok, detail = self.coord.exchange_verdict(
+                f"join:{seq}:{new_map.fingerprint()}",
+                join_err is None,
+                repr(join_err) if join_err else "",
+                fatal=True,
+            )
+        except PeerDeadError as e:
+            # the fleet itself lost a rank mid-round: the survivors will
+            # shrink and re-offer; go back to announcing
+            tp.mark_dead(e.dead)
+            self._record(
+                "join_abort", "retry", 0, f"sponsor fleet lost a rank: {e!r}"
+            )
+            return False
+        except (OSError, TimeoutError) as ve:
+            raise PassFailure(
+                f"join commit verdict uncertain (transport failure "
+                f"mid-round): {ve!r}"
+            ) from ve
+        if not ok or join_err is not None:
+            self._join_abort(
+                me, new_map, planned,
+                detail if join_err is None else repr(join_err),
+            )
+            return False
+        _membership.commit_staged(self.table, xfer["staged"])
+        if catchup["decay_epochs"] and not getattr(
+            self.table, "decay_epochs", 0
+        ):
+            # the carved rows' decay clock must match their previous
+            # owner's, or the first decay after the join drifts off a
+            # fresh fixed-size run
+            self.table.decay_epochs = catchup["decay_epochs"]
+        self._install_ownership(new_map, prev_map=old_map)
+        STAT_ADD("membership.joins_total")
+        self._record(
+            "rank_join", "commit", 0,
+            f"joiner={me} ownership_epoch={new_map.epoch} "
+            f"recv_keys={xfer['recv_keys']} catchup_keys={catchup['keys']}",
+        )
+        bundle = {
+            "joiner": int(me),
+            "live": [int(r) for r in new_map.live_ranks],
+            "ownership_epoch": int(new_map.epoch),
+            "planned_ranges": planned,
+            "recv_keys": int(xfer["recv_keys"]),
+            "catchup_keys": int(catchup["keys"]),
+        }
+        FLIGHT_RECORDER.note_incident("rank_join", bundle)
+        PROFILER.instant("supervisor:rank_join", bundle)
+        return True
+
+    def join_day(
+        self,
+        pass_files: Sequence[Sequence[str]],
+        n_batches: Optional[int] = None,
+        publish: bool = True,
+        timeout: float = 60.0,
+    ) -> List[Optional[Dict[str, float]]]:
+        """JOINER-side day entrypoint: the grow dual of ``run_day``.
+
+        Announce -> await a sponsor's offer -> catch up from the ceding
+        owners' published base+delta chains (the serve follower's CRC-
+        verified chain apply) -> receive the carved ranges through the
+        staged migrate path -> global fingerprint-tagged commit verdict
+        -> durable base re-anchor (``_install_ownership``) -> run the
+        REMAINING passes of the day in lockstep with the fleet. An
+        aborted admission (injected fault mid-catch-up, a refused
+        verdict, a survivor death mid-round) leaves the fleet at the old
+        epoch bitwise and this rank simply re-announces; ``timeout``
+        bounds the total wait for admission.
+
+        Saves are always deltas: the admission itself re-anchored a base
+        at the new epoch, so the joiner's chain starts there and
+        ``save_delta``'s refuse-to-straddle rule is satisfied by
+        construction."""
+        if self.elastic is None or self.coord is None:
+            raise ValueError(
+                "join_day requires elastic mode and a coordinated transport"
+            )
+        deadline = time.monotonic() + timeout
+        while True:
+            if time.monotonic() >= deadline:
+                raise PassFailure(
+                    f"rank {self.coord.transport.rank} was not admitted "
+                    f"within {timeout:.1f}s"
+                )
+            try:
+                offer = self._await_offer(deadline)
+                if offer is None:
+                    continue
+                if self._join_attempt(offer):
+                    break
+            except InjectedFault as e:
+                # an injected announce/catch-up fault is retryable: note
+                # it and knock again (FLT008 recovery contract)
+                self._record("join_abort", "retry", 0, repr(e))
+            self.retry.sleep(0.01)
+        outs: List[Optional[Dict[str, float]]] = []
+        do_save = publish and self.checkpoint is not None
+        start = self._pass_seq
+        for p in range(start, len(pass_files)):
+            files = pass_files[p]
+            nxt = (
+                (self._date, tuple(pass_files[p + 1]))
+                if p + 1 < len(pass_files)
+                else None
+            )
+            outs.append(
+                self.run_pass(
+                    files, date=self._date, n_batches=n_batches,
+                    save="delta" if do_save else None, prefetch=nxt,
+                )
+            )
+            try:
+                self._boundary_elastic(do_save)
+            except PeerDeadError as e:
+                self._handle_rank_death(e)
+            if self.metrics is not None:
+                self.metrics.maybe_snapshot()
+        return outs
 
     # ---- the supervised pass --------------------------------------------
 
@@ -1182,16 +1757,13 @@ class PassSupervisor:
                     prefetch=nxt,
                 )
             )
-            if (
-                self.elastic is not None
-                and self.coord is not None
-                and self.elastic.migrate_skew > 1.0
-            ):
-                # confirmed + published boundary: the one place ownership
-                # may move planned ranges (atomic epoch flip on a global
-                # commit verdict)
+            if self.elastic is not None and self.coord is not None:
+                # confirmed + published boundary: the one place membership
+                # may grow (admit a waiting joiner) or ownership may move
+                # planned ranges — either way an atomic epoch flip on a
+                # global fingerprint-tagged commit verdict
                 try:
-                    self._maybe_migrate()
+                    self._boundary_elastic(do_save)
                 except PeerDeadError as e:
                     # a rank died during the boundary round: membership
                     # handling, then the next pass runs on the survivors

@@ -115,6 +115,19 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             int8 region (correct values, just
                             un-prioritized precision), counted under
                             wire.ici_pack_errors
+    membership.join_announce  train/supervisor.py  _announce_join, before
+                            the joiner knocks on the fleet's sponsors — a
+                            failure means the announce never went out;
+                            nothing durable moved, the joiner simply
+                            knocks again (join_day's retry loop)
+    membership.catchup_apply  train/supervisor.py  _catch_up, once per
+                            ceding source before its published base+delta
+                            chain is applied into the joiner's scratch —
+                            a failure folds into the joiner's NO vote on
+                            the join verdict: the fleet stays at the OLD
+                            ownership epoch bitwise (receivers only
+                            staged, nothing committed) and a retried join
+                            succeeds (FLT008 recovery contract)
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -168,6 +181,8 @@ KNOWN_SITES = (
     "membership.adopt_shard",
     "migrate.transfer",
     "wire.ici_pack",
+    "membership.join_announce",
+    "membership.catchup_apply",
 )
 
 

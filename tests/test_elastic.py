@@ -28,6 +28,7 @@ import json
 import os
 import socket
 import threading
+import time
 from types import SimpleNamespace
 
 import numpy as np
@@ -46,7 +47,7 @@ from paddlebox_tpu.parallel.membership import (
     plan_rebalance,
 )
 from paddlebox_tpu.parallel.transport import TcpTransport, TransportTimeout
-from paddlebox_tpu.table.dist_ws import DistributedWorkingSet
+from paddlebox_tpu.table.dist_ws import DistributedWorkingSet, hot_shard_loads
 from paddlebox_tpu.table.sparse_table import (
     HostSparseTable,
     SparseOptimizerConfig,
@@ -62,6 +63,7 @@ from paddlebox_tpu.train.checkpoint import (
 from paddlebox_tpu.train.supervisor import (
     ElasticConfig,
     HealthGates,
+    PassFailure,
     PassSupervisor,
     RetryPolicy,
 )
@@ -594,7 +596,7 @@ def _elastic_trainer(ds, recorder, kill_at=None):
 
 
 def _mk_sup(rank, tps, root, seed, recorder, kill_at=None, skewed=False,
-            migrate_skew=0.0):
+            migrate_skew=0.0, initial_live=None, target_ranks=None):
     table = _mk_table()
     ds = _ElasticDS(tps[rank], table, seed, skewed=skewed)
     tr = _elastic_trainer(ds, recorder, kill_at=kill_at)
@@ -609,7 +611,8 @@ def _mk_sup(rank, tps, root, seed, recorder, kill_at=None, skewed=False,
         transport=tps[rank],
         elastic=ElasticConfig(
             shared_root=root, migrate_skew=migrate_skew,
-            member_timeout=3.0,
+            member_timeout=3.0, initial_live=initial_live,
+            target_ranks=target_ranks,
         ),
     )
 
@@ -1018,3 +1021,363 @@ def test_migrate_load_view_size_mismatch_raises(tmp_path):
     finally:
         for t in tps:
             t.close()
+
+
+# ---------------------------------------------------------------------------
+# the grow half: OwnershipMap.grow + hot loads (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_grow_minimal_movement_and_uniform_carve():
+    # a middle joiner carves ONLY its flanks; everyone else keeps ranges
+    m = OwnershipMap.even_over(N_MESH, [0, 2, 3])  # starts [0,3,6,8]
+    g = m.grow(1)
+    assert g.epoch == m.epoch + 1
+    assert list(g.live_ranks) == [0, 1, 2, 3]
+    # uniform carve of the [0,6) flank window lands on the even split
+    assert [g.range_of(r) for r in g.live_ranks] == [
+        (0, 2), (2, 4), (4, 6), (6, 8)
+    ]
+    # the non-flank survivor kept its exact range
+    assert g.range_of(3) == m.range_of(3)
+    # moves are flank -> joiner only
+    for _lo, _hi, src, dst in plan_moves(m, g):
+        assert dst == 1 and src in (0, 2)
+
+
+def test_grow_hot_carve_follows_load():
+    m = OwnershipMap.even(N_MESH, 3)  # rank 2 owns [6,8)
+    # joiner lands at the end: the single flank window is [6,8)
+    loads = np.zeros(N_MESH)
+    loads[6], loads[7] = 10.0, 1.0
+    g = m.grow(3, loads)
+    # the hot shard 6 alone crosses the half-load quantile: the flank
+    # keeps just it and the joiner takes the cold rim
+    assert g.range_of(2) == (6, 7) and g.range_of(3) == (7, 8)
+    assert g.range_of(0) == m.range_of(0) and g.range_of(1) == m.range_of(1)
+    # load mass piled at the window's FAR edge must not starve the joiner
+    # into an empty range: every part still lands at least one shard
+    loads[:] = 0.0
+    loads[7] = 10.0
+    g = m.grow(3, loads)
+    assert g.range_of(2) == (6, 7) and g.range_of(3) == (7, 8)
+
+
+def test_grow_rejects_live_and_negative_ranks():
+    m = OwnershipMap.even(N_MESH, 2)
+    with pytest.raises(ValueError, match="already live"):
+        m.grow(1)
+    with pytest.raises(ValueError, match=">= 0"):
+        m.grow(-1)
+    with pytest.raises(ValueError, match="shard loads"):
+        m.grow(2, np.ones(N_MESH - 1))
+
+
+def test_hot_shard_loads_weights_shows():
+    t = _mk_table()
+    omap = OwnershipMap.even(N_MESH, 2)  # rank 0 owns [0,4)
+    keys = np.arange(1, 50, dtype=np.uint64)
+    sh = key_to_shard(keys, N_MESH)
+    mine = keys[sh < 4]
+    t.pull_or_create(mine)
+    base = hot_shard_loads(t, omap, 0)
+    assert base.shape == (4,)
+    counts = np.bincount(key_to_shard(mine, N_MESH), minlength=4)[:4]
+    # residency prior: every populated shard carries positive weight
+    assert np.all((base > 0) == (counts > 0))
+    # bump decayed shows on shard 0's keys: only that shard's load grows
+    hot = mine[key_to_shard(mine, N_MESH) == 0]
+    rows = t.pull_or_create(hot)
+    rows[:, LAYOUT.SHOW] = np.float32(7.0)
+    t.push(hot, rows)
+    after = hot_shard_loads(t, omap, 0)
+    assert after[0] > base[0]
+    np.testing.assert_allclose(after[1:], base[1:])
+    # a rank owning nothing contributes the empty vector
+    gempty = OwnershipMap(N_MESH, [0, 1], [0, 0, N_MESH], 0)
+    assert len(hot_shard_loads(t, gempty, 0)) == 0
+
+
+# ---------------------------------------------------------------------------
+# THE grow gate: join mid-day == fresh grown-membership run, bitwise
+# ---------------------------------------------------------------------------
+
+
+def _join_worker(sups, files, joiner, timeout=60.0):
+    def worker(r):
+        if r == joiner:
+            return sups[r].join_day(files, timeout=timeout)
+        return sups[r].run_day(DATE, files)
+
+    return worker
+
+
+def test_rank_join_mid_day_bitwise_equals_fresh_grown_run(tmp_path):
+    seed, passes = 23, 3
+    joins_before = STAT_GET("membership.joins_total")
+    root = str(tmp_path / "join")
+    tps = _cluster(4)
+    rec_j = {}
+    sups = [
+        _mk_sup(r, tps, root, seed, rec_j, initial_live=[0, 1, 2])
+        for r in range(3)
+    ]
+    sups.append(_mk_sup(3, tps, root, seed, rec_j))
+    files = [[f"pass-{p}"] for p in range(passes)]
+    try:
+        res = _run_ranks(_join_worker(sups, files, joiner=3), 4)
+    finally:
+        for t in tps:
+            t.close()
+    # every rank converged on the grown map: ONE flip, live [0,1,2,3]
+    for r in range(4):
+        omap = sups[r].ds.ownership
+        assert omap is not None and omap.epoch == 1
+        assert list(omap.live_ranks) == [0, 1, 2, 3]
+        assert "rank_join" in [i.kind for i in sups[r].incidents]
+    # the joiner was admitted at a boundary BEFORE the last pass and ran
+    # the rest of the day in lockstep
+    assert len(res[3]) >= 1 and all(o is not None for o in res[3])
+    assert all(len(res[r]) == passes for r in range(3))
+    assert STAT_GET("membership.joins_total") >= joins_before + 4
+    assert STAT_GET("membership.live_ranks") == 4
+    assert STAT_GET("membership.epoch") == 1
+    # the joiner's chain re-anchored at the join epoch, carries the grown
+    # live set, and validates as a single-epoch chain
+    wm = read_watermark(rank_root(root, 3))
+    assert wm["ownership_epoch"] == 1
+    assert wm["live_ranks"] == [0, 1, 2, 3]
+    validate_watermark(wm)
+    # rank_join incident bundle on every rank: joiner + planned ranges
+    for r in range(4):
+        joins = [i for i in sups[r].incidents if i.kind == "rank_join"]
+        assert "joiner=3" in joins[-1].detail
+
+    # the reference: a FRESH 4-rank run of the same day
+    rec_f = {}
+    sups_f, res_f = _run_day(4, str(tmp_path / "fresh"), seed, rec_f,
+                             passes=passes)
+    assert all(len(r) == passes for r in res_f)
+    jk, jv = _merged_digest(sups, [0, 1, 2, 3])
+    fk, fv = _merged_digest(sups_f, [0, 1, 2, 3])
+    np.testing.assert_array_equal(jk, fk)
+    np.testing.assert_array_equal(jv, fv)
+    # per-pass global AUC bitwise-equal (the pre-join passes ran on 3
+    # ranks, but the global record multiset per pass is membership-
+    # independent by construction)
+    for p in range(passes):
+        np.testing.assert_array_equal(_pass_auc(rec_j, p), _pass_auc(rec_f, p))
+
+
+def test_kill_then_rejoin_bitwise_equals_fresh_run(tmp_path):
+    """The full elastic cycle in one day: rank 1 dies mid-pass-1 (shrink,
+    epoch 1), its replacement incarnation rejoins once the shrunk fleet
+    is past the death (grow, epoch 2), and the day's final state is still
+    bitwise a fresh fixed-size 4-rank run of the same schedule."""
+    seed, passes = 31, 5
+    root = str(tmp_path / "rejoin")
+    config.set_flag("transport_peer_dead_s", 0.6)
+    eps = [f"127.0.0.1:{p}" for p in _free_ports(4)]
+    tps = [TcpTransport(r, eps, timeout=30.0) for r in range(4)]
+    rec_e = {}
+    sups = [
+        _mk_sup(r, tps, root, seed, rec_e, kill_at=1 if r == 1 else None)
+        for r in range(4)
+    ]
+    files = [[f"pass-{p}"] for p in range(passes)]
+
+    def worker(r):
+        if r != 1:
+            return sups[r].run_day(DATE, files)
+        try:
+            sups[1].run_day(DATE, files)
+            raise AssertionError("rank 1 was not killed")
+        except _RankKilled:
+            pass
+        # wait for every survivor to INSTALL the shrink (ownership epoch 1)
+        # before announcing: a fresh incarnation's heartbeats would
+        # otherwise mask the OLD incarnation's silence from the failure
+        # detector, and this is the earliest safe announce point — gating
+        # any later (e.g. on a pass count) risks the fleet finishing the
+        # day before the join lands
+        deadline = time.monotonic() + 60.0
+        while not all(
+            sups[r].ds.ownership is not None and sups[r].ds.ownership.epoch >= 1
+            for r in (0, 2, 3)
+        ):
+            if time.monotonic() >= deadline:
+                raise AssertionError("survivors never installed the shrink")
+            time.sleep(0.02)
+        tps[1] = TcpTransport(1, eps, timeout=30.0)
+        sup2 = _mk_sup(1, tps, root, seed, rec_e)
+        sups[1] = sup2
+        return sup2.join_day(files, timeout=60.0)
+
+    try:
+        res = _run_ranks(worker, 4)
+    finally:
+        config.set_flag("transport_peer_dead_s", 60.0)
+        for t in tps:
+            t.close()
+    # shrink then grow: epoch 2, the full live set restored
+    for r in range(4):
+        omap = sups[r].ds.ownership
+        assert omap is not None and omap.epoch == 2, r
+        assert list(omap.live_ranks) == [0, 1, 2, 3]
+    for r in (0, 2, 3):
+        kinds = [i.kind for i in sups[r].incidents]
+        assert "rank_death" in kinds and "rank_join" in kinds
+        assert len(res[r]) == passes and all(o is not None for o in res[r])
+    assert "rank_join" in [i.kind for i in sups[1].incidents]
+    # the rejoined rank trained at least the final pass
+    assert len(res[1]) >= 1 and all(o is not None for o in res[1])
+    wm = read_watermark(rank_root(root, 1))
+    assert wm["ownership_epoch"] == 2
+    assert wm["live_ranks"] == [0, 1, 2, 3]
+    validate_watermark(wm)
+
+    rec_f = {}
+    sups_f, res_f = _run_day(4, str(tmp_path / "fresh"), seed, rec_f,
+                             passes=passes)
+    assert all(len(r) == passes for r in res_f)
+    ek, ev = _merged_digest(sups, [0, 1, 2, 3])
+    fk, fv = _merged_digest(sups_f, [0, 1, 2, 3])
+    np.testing.assert_array_equal(ek, fk)
+    np.testing.assert_array_equal(ev, fv)
+    for p in range(passes):
+        np.testing.assert_array_equal(_pass_auc(rec_e, p), _pass_auc(rec_f, p))
+
+
+# ---------------------------------------------------------------------------
+# FLT008 for the two join fault sites
+# ---------------------------------------------------------------------------
+
+
+def test_join_catchup_fault_aborts_at_old_epoch_then_retry_commits(tmp_path):
+    """FLT008 for membership.catchup_apply: a join aborted mid-catch-up
+    leaves the fleet at the OLD epoch bitwise (receivers only staged,
+    nothing committed), the joiner re-announces, and the RETRIED join at
+    the next boundary succeeds — the day still lands bitwise on a fresh
+    4-rank run."""
+    seed, passes = 37, 3
+    aborted_before = STAT_GET("membership.joins_aborted")
+    root = str(tmp_path / "jfault")
+    tps = _cluster(4)
+    rec = {}
+    sups = [
+        _mk_sup(r, tps, root, seed, rec, initial_live=[0, 1, 2])
+        for r in range(3)
+    ]
+    sups.append(_mk_sup(3, tps, root, seed, rec))
+    files = [[f"pass-{p}"] for p in range(passes)]
+    try:
+        with inject(fail_nth("membership.catchup_apply", 1)) as plan:
+            res = _run_ranks(_join_worker(sups, files, joiner=3), 4)
+    finally:
+        for t in tps:
+            t.close()
+    assert plan.failures("membership.catchup_apply") == 1
+    assert STAT_GET("membership.joins_aborted") >= aborted_before + 4
+    for r in range(4):
+        kinds = [i.kind for i in sups[r].incidents]
+        # the abort strictly precedes the committed retry; exactly ONE
+        # flip ever happened (the aborted epoch never existed)
+        assert kinds.index("join_abort") < kinds.index("rank_join"), (r, kinds)
+        omap = sups[r].ds.ownership
+        assert omap is not None and omap.epoch == 1
+        assert list(omap.live_ranks) == [0, 1, 2, 3]
+    assert all(len(res[r]) == passes for r in range(3))
+    # satellite: the abort dumped an incident bundle — joiner rank, the
+    # ranges it would have taken, the epoch that never happened, and why
+    for r in range(4):
+        paths = glob.glob(os.path.join(
+            rank_root(root, r), "obs", "incidents", "incident-*.json",
+        ))
+        bundles = [json.load(open(p)) for p in paths]
+        aborts = [b for b in bundles if b.get("reason") == "join_abort"]
+        assert aborts, f"rank {r}: no join_abort incident bundle"
+        detail = json.loads(aborts[-1]["detail"])
+        assert detail["joiner"] == 3
+        assert detail["ownership_epoch"] == 1
+        assert detail["planned_ranges"]
+        assert detail["reason"]
+
+    rec_f = {}
+    sups_f, res_f = _run_day(4, str(tmp_path / "fresh"), seed, rec_f,
+                             passes=passes)
+    assert all(len(r) == passes for r in res_f)
+    jk, jv = _merged_digest(sups, [0, 1, 2, 3])
+    fk, fv = _merged_digest(sups_f, [0, 1, 2, 3])
+    np.testing.assert_array_equal(jk, fk)
+    np.testing.assert_array_equal(jv, fv)
+    for p in range(passes):
+        np.testing.assert_array_equal(_pass_auc(rec, p), _pass_auc(rec_f, p))
+
+
+def test_join_announce_fault_is_retried_and_join_lands(tmp_path):
+    """FLT008 for membership.join_announce: a failed announce moved
+    nothing durable — the joiner records the retryable fault and simply
+    knocks again; the join still commits."""
+    seed, passes = 41, 3
+    root = str(tmp_path / "afault")
+    tps = _cluster(4)
+    rec = {}
+    sups = [
+        _mk_sup(r, tps, root, seed, rec, initial_live=[0, 1, 2])
+        for r in range(3)
+    ]
+    sups.append(_mk_sup(3, tps, root, seed, rec))
+    files = [[f"pass-{p}"] for p in range(passes)]
+    try:
+        with inject(fail_nth("membership.join_announce", 1)) as plan:
+            res = _run_ranks(_join_worker(sups, files, joiner=3), 4)
+    finally:
+        for t in tps:
+            t.close()
+    assert plan.failures("membership.join_announce") == 1
+    for r in range(4):
+        omap = sups[r].ds.ownership
+        assert omap is not None and omap.epoch == 1
+        assert list(omap.live_ranks) == [0, 1, 2, 3]
+        assert "rank_join" in [i.kind for i in sups[r].incidents]
+    # the joiner noted the retryable announce fault before landing
+    aborts = [i for i in sups[3].incidents if i.kind == "join_abort"]
+    assert any("membership.join_announce" in a.detail for a in aborts)
+    assert all(len(res[r]) == passes for r in range(3))
+
+
+def test_autoscale_target_refuses_admission_at_target(tmp_path):
+    """The autoscale policy half of the loop: at (or above) target_ranks
+    a waiting joiner keeps knocking but is never admitted — the day ends
+    at the ORIGINAL epoch and live set, and the joiner times out."""
+    seed, passes = 43, 2
+    root = str(tmp_path / "tgt")
+    tps = _cluster(3)
+    rec = {}
+    sups = [
+        _mk_sup(r, tps, root, seed, rec, initial_live=[0, 1],
+                target_ranks=2)
+        for r in range(2)
+    ]
+    sups.append(_mk_sup(2, tps, root, seed, rec))
+    files = [[f"pass-{p}"] for p in range(passes)]
+
+    def worker(r):
+        if r == 2:
+            with pytest.raises(PassFailure, match="not admitted"):
+                sups[2].join_day(files, timeout=2.0)
+            return "refused"
+        return sups[r].run_day(DATE, files)
+
+    try:
+        res = _run_ranks(worker, 3)
+    finally:
+        for t in tps:
+            t.close()
+    assert res[2] == "refused"
+    for r in (0, 1):
+        assert len(res[r]) == passes and all(o is not None for o in res[r])
+        omap = sups[r].ds.ownership
+        assert omap is not None and omap.epoch == 0
+        assert list(omap.live_ranks) == [0, 1]
+        assert "rank_join" not in [i.kind for i in sups[r].incidents]

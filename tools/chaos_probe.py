@@ -1224,6 +1224,211 @@ def _elastic_pass_auc(recorder, p):
     return np.asarray(auc_compute(state))
 
 
+def _elastic_run_day_rejoin(n, root, seed, n_records, passes, recorder,
+                            join_rank):
+    """N-rank day where ``join_rank`` dies at the top of pass 1 and a
+    successor incarnation of the SAME rank rejoins mid-day. The rejoin
+    waits until every survivor has INSTALLED the shrink (ownership epoch
+    >= 1) — the earliest announce point that cannot mask the old
+    incarnation's silence from the failure detector — so the join lands
+    with the most day left to train."""
+    from paddlebox_tpu.parallel.transport import TcpTransport
+
+    eps = [f"127.0.0.1:{p}" for p in _dist_free_ports(n)]
+    tps = [TcpTransport(r, eps, timeout=60.0) for r in range(n)]
+    sups = [
+        _elastic_mk_sup(
+            r, tps, root, seed, n_records, recorder,
+            kill_at=(1 if r == join_rank else None),
+        )[0]
+        for r in range(n)
+    ]
+    files = [[f"pass-{p}"] for p in range(passes)]
+    survivors = [r for r in range(n) if r != join_rank]
+
+    def day(r):
+        if r != join_rank:
+            return sups[r].run_day("20260101", files)
+        try:
+            sups[r].run_day("20260101", files)
+            raise AssertionError("join rank was not killed")
+        except _ProbeRankKilled:
+            pass
+        deadline = time.monotonic() + 120.0
+        while not all(
+            sups[s].ds.ownership is not None
+            and sups[s].ds.ownership.epoch >= 1
+            for s in survivors
+        ):
+            if time.monotonic() >= deadline:
+                raise AssertionError("survivors never installed the shrink")
+            time.sleep(0.02)
+        tps[r] = TcpTransport(r, eps, timeout=60.0)
+        sups[r] = _elastic_mk_sup(r, tps, root, seed, n_records, recorder)[0]
+        return sups[r].join_day(files, timeout=120.0)
+
+    t0 = time.perf_counter()
+    try:
+        results, errors = _probe_run_threads(day, n)
+    finally:
+        for t in tps:
+            t.close()
+    if errors:
+        raise errors[0][1]
+    return sups, results, time.perf_counter() - t0
+
+
+def run_join_rank(args):
+    """Elastic grow soak (``--join-rank=R``): an N-rank supervised day
+    loses rank R at the top of pass 1 (shrink, epoch 1); a successor
+    incarnation of the same rank announces once the shrunk fleet has
+    installed the shrink, catches up from the published chains, receives
+    its carved ranges through stage-then-commit migration and the fleet
+    flips to epoch 2 — and the final ownership-filtered digest plus
+    per-pass global AUC must be bitwise-equal to a FRESH fixed-size
+    N-rank run of the same day. Exit 0 iff every gate holds.
+
+      JAX_PLATFORMS=cpu python tools/chaos_probe.py --join-rank 1 \\
+          --passes 5 [--json]
+    """
+    import glob as globmod
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.train.checkpoint import (
+        rank_root,
+        read_watermark,
+        validate_watermark,
+    )
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    n, join_rank, passes = args.ranks, args.join_rank, args.passes
+    if not (0 <= join_rank < n):
+        print(f"--join-rank must be in [0, {n})", file=sys.stderr)
+        return 2
+    if passes < 4:
+        print("--passes must be >= 4 (the kill, the shrink and the "
+              "rejoin all land mid-day)", file=sys.stderr)
+        return 2
+    n_records = args.rows
+    saved = {
+        name: config.get_flag(name)
+        for name in (
+            "transport_heartbeat_s", "transport_backoff_s",
+            "transport_send_retries", "transport_peer_dead_s",
+        )
+    }
+    config.set_flag("transport_heartbeat_s", 0.05)
+    config.set_flag("transport_backoff_s", 0.005)
+    config.set_flag("transport_send_retries", 6)
+    joins_before = STAT_GET("membership.joins_total")
+    try:
+        with tempfile.TemporaryDirectory() as tmpdir:
+            # the elastic day: rank R dies at pass 1, rejoins mid-day
+            config.set_flag("transport_peer_dead_s", 0.6)
+            rec_e = {}
+            root_e = os.path.join(tmpdir, "elastic")
+            sups_e, res_e, wall_e = _elastic_run_day_rejoin(
+                n, root_e, args.seed, n_records, passes, rec_e,
+                join_rank=join_rank,
+            )
+            config.set_flag("transport_peer_dead_s", 60.0)
+            survivors = [r for r in range(n) if r != join_rank]
+            finished_ok = all(
+                isinstance(res_e[r], list) and len(res_e[r]) == passes
+                for r in survivors
+            )
+            rejoined_passes = (
+                len(res_e[join_rank])
+                if isinstance(res_e[join_rank], list) else -1
+            )
+            epochs = [
+                sups_e[r].ds.ownership.epoch
+                if sups_e[r].ds.ownership is not None else 0
+                for r in range(n)
+            ]
+            live_after = (
+                list(sups_e[0].ds.ownership.live_ranks)
+                if sups_e[0].ds.ownership is not None else []
+            )
+            kinds_surv = sorted({
+                i.kind for r in survivors for i in sups_e[r].incidents
+            })
+            joiner_kinds = sorted({
+                i.kind for i in sups_e[join_rank].incidents
+            })
+            bundles = sum(
+                len(globmod.glob(os.path.join(
+                    rank_root(root_e, r), "obs", "incidents",
+                    "incident-*.json",
+                )))
+                for r in range(n)
+            )
+            wm = read_watermark(rank_root(root_e, join_rank))
+            validate_watermark(wm)
+            wm_epoch = int(wm["ownership_epoch"])
+            wm_live = list(wm.get("live_ranks", []))
+
+            # the reference: a FRESH fixed-size N-rank run of the same day
+            rec_f = {}
+            sups_f, res_f, wall_f = _elastic_run_day(
+                n, os.path.join(tmpdir, "fresh"), args.seed,
+                n_records, passes, rec_f,
+            )
+            fresh_ok = all(
+                isinstance(r, list) and len(r) == passes for r in res_f
+            )
+            ek, ev = _elastic_merged_digest(sups_e, list(range(n)))
+            fk, fv = _elastic_merged_digest(sups_f, list(range(n)))
+            digest_equal = bool(
+                np.array_equal(ek, fk) and np.array_equal(ev, fv)
+            )
+            auc_equal = all(
+                np.array_equal(
+                    _elastic_pass_auc(rec_e, p), _elastic_pass_auc(rec_f, p)
+                )
+                for p in range(passes)
+            )
+    finally:
+        for name, v in saved.items():
+            config.set_flag(name, v)
+
+    joins = int(STAT_GET("membership.joins_total") - joins_before)
+    ok = (
+        finished_ok and fresh_ok and rejoined_passes >= 1
+        and all(e == 2 for e in epochs) and live_after == list(range(n))
+        and wm_epoch == 2 and wm_live == list(range(n))
+        and "rank_death" in kinds_surv and "rank_join" in kinds_surv
+        and "rank_join" in joiner_kinds
+        and joins >= n and bundles >= 1
+        and digest_equal and auc_equal
+    )
+    report = {
+        "mode": "join-rank",
+        "ranks": n,
+        "join_rank": join_rank,
+        "kill_at_pass": 1,
+        "passes": passes,
+        "records_per_pass": n_records,
+        "survivors_finished": bool(finished_ok),
+        "rejoined_trained_passes": rejoined_passes,
+        "ownership_epoch_after": epochs[0] if epochs else None,
+        "live_ranks_after": live_after,
+        "watermark_ownership_epoch": wm_epoch,
+        "watermark_live_ranks": wm_live,
+        "membership_joins": joins,
+        "incident_kinds": sorted(set(kinds_surv) | set(joiner_kinds)),
+        "incident_bundles": bundles,
+        "digest_keys": int(len(ek)),
+        "bitwise_equal_to_fresh_grown_run": digest_equal,
+        "auc_equal_per_pass": bool(auc_equal),
+        "wall_elastic_s": round(wall_e, 2),
+        "wall_fresh_s": round(wall_f, 2),
+        "ok": bool(ok),
+    }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if ok else 1
+
+
 def run_kill_rank(args):
     """Elastic-membership soak (``--kill-rank=R``): an N-rank supervised
     day loses rank R mid-pass; survivors agree on the shrunk membership,
@@ -1380,8 +1585,15 @@ def main(argv=None):
                          "day loses rank R mid-pass; survivors must adopt "
                          "its shard ranges and finish bitwise-equal to a "
                          "fresh (N-1)-rank run of the same day")
+    ap.add_argument("--join-rank", type=int, default=None, metavar="R",
+                    help="elastic grow soak: rank R dies at pass 1 "
+                         "(shrink), a successor incarnation rejoins once "
+                         "the survivors installed the shrink (grow, epoch "
+                         "2), and the day must finish bitwise-equal to a "
+                         "fresh fixed-size N-rank run")
     ap.add_argument("--ranks", type=int, default=4,
-                    help="cluster size for the --kill-rank soak")
+                    help="cluster size for the --kill-rank / --join-rank "
+                         "soaks")
     ap.add_argument("--corrupt-rate", type=float, default=0.0, metavar="P",
                     help="iid per-line data corruption probability; "
                          "switches to the quarantine/degrade soak "
@@ -1426,6 +1638,8 @@ def main(argv=None):
         return run_serve(args)
     if args.wedge_backend:
         return run_wedge_backend(args)
+    if args.join_rank is not None:
+        return run_join_rank(args)
     if args.kill_rank is not None:
         return run_kill_rank(args)
     if args.distributed:

@@ -284,4 +284,30 @@ print(f"[11] adaptive ICI wire ok: payload cut "
       f"{_iwl['payload_ratio_fp32_over_adaptive']}x vs fp32, below bf16, "
       f"AUC delta {_iwl['auc_delta_adaptive_vs_fp32']}, "
       f"{_iwl['legs']['adaptive']['hot_keys']} hot key(s), ablation bitwise")
+# --- 12. elastic grow: join-rank soak + committed artifact --------------
+# The --join-rank soak kills rank 1 at pass 1 (shrink, epoch 1), rejoins
+# a successor incarnation once the survivors installed the shrink (grow,
+# epoch 2), and requires the final 4-rank digest + per-pass AUC to be
+# bitwise-equal to a fresh fixed-size 4-rank run; the "join" block of
+# SOAK_ELASTIC.json v2 is the committed record of that gate and must
+# agree with a live re-run.
+assert _soak.get("version", 1) >= 2 and "join" in _soak, \
+    "SOAK_ELASTIC.json must be v2 with a join block"
+_join = _soak["join"]
+assert _join["ok"] and _join["bitwise_equal_to_fresh_grown_run"], _join
+assert _join["auc_equal_per_pass"] and _join["ownership_epoch_after"] == 2, _join
+assert _join["rejoined_trained_passes"] >= 1, _join
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "chaos_probe.py"),
+     "--join-rank", "1", "--passes", "5", "--json"],
+    capture_output=True, text=True, timeout=600)
+assert r.returncode == 0, f"join-rank soak red:\n{r.stdout}{r.stderr}"
+_jl = _json.loads(r.stdout.strip().splitlines()[-1])
+assert _jl["ok"] and _jl["bitwise_equal_to_fresh_grown_run"], _jl
+assert _jl["auc_equal_per_pass"] and _jl["ownership_epoch_after"] == 2, _jl
+print(f"[12] elastic grow ok: rank {_jl['join_rank']} killed at pass "
+      f"{_jl['kill_at_pass']}, rejoined and trained "
+      f"{_jl['rejoined_trained_passes']} pass(es), epoch -> "
+      f"{_jl['ownership_epoch_after']}, {_jl['membership_joins']} join "
+      f"commit(s), digest+AUC bitwise vs fresh fixed-size run")
 print("VERIFY DRIVE PASS")
