@@ -21,12 +21,21 @@ from .core import (
     load_baseline,
     save_baseline,
 )
+from .protocol import (
+    ProtocolModel,
+    ProtoSite,
+    extract_protocol,
+    get_protocol,
+    patterns_may_match,
+)
+from .rules_distributed import DistributedDisciplineRule
 from .rules_exceptions import ExceptionFlowRule
 from .rules_faultflow import FaultSiteCoverageRule
 from .rules_io import DurableWriteRule
 from .rules_jit import JitPurityRule
 from .rules_locks import LockDisciplineRule
 from .rules_registry import RegistryConsistencyRule
+from .rules_resources import ResourceLifecycleRule
 from .rules_stats import StatNameRule
 from .rules_threads import RaceDetectorRule
 
@@ -39,6 +48,8 @@ ALL_RULES = [
     RaceDetectorRule,
     ExceptionFlowRule,
     FaultSiteCoverageRule,
+    DistributedDisciplineRule,
+    ResourceLifecycleRule,
 ]
 
 
@@ -67,12 +78,19 @@ __all__ = [
     "lint_paths",
     "load_baseline",
     "save_baseline",
+    "DistributedDisciplineRule",
     "DurableWriteRule",
     "ExceptionFlowRule",
     "FaultSiteCoverageRule",
     "JitPurityRule",
     "LockDisciplineRule",
+    "ProtoSite",
+    "ProtocolModel",
     "RaceDetectorRule",
     "RegistryConsistencyRule",
+    "ResourceLifecycleRule",
     "StatNameRule",
+    "extract_protocol",
+    "get_protocol",
+    "patterns_may_match",
 ]

@@ -264,10 +264,14 @@ def lint_paths(
 # threads with intentional shared state (harness fixtures), call jit only
 # through the package, and exercise the flag/fault-site registry machinery
 # with synthetic names (REG003's contract is about package code firing
-# real sites), so those rules would drown signal there; everything
-# IO/stat/exception-shaped stays on everywhere.
+# real sites), so those rules would drown signal there; likewise test
+# fixtures build deliberately half-torn protocol and resource scenarios
+# (unanswered collectives, threads the test itself owns), so the
+# distributed-discipline and lifecycle rules (DST009/RES010) gate package
+# and tools code only; everything IO/stat/exception-shaped stays on
+# everywhere.
 DEFAULT_PROFILES: Dict[str, Sequence[str]] = {
-    "tests/": ("JIT001", "THR006", "REG003"),
+    "tests/": ("JIT001", "THR006", "REG003", "DST009", "RES010"),
 }
 
 

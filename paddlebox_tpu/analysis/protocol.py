@@ -1,0 +1,392 @@
+"""Protocol vocabulary extraction for pbox-verify.
+
+The elastic control plane talks through tagged PBTX frames: point-to-point
+``send``/``recv`` pairs, collective rounds (``allgather``/``alltoall``/
+``allreduce_max``/``barrier``), the verdict wrapper
+(``EpochCoordinator.exchange_verdict``), the membership convergence loop
+(``agree_membership``) and the epoch floor (``discard_epochs_below``).
+This pass statically recovers that vocabulary from the real code so the
+distributed-discipline rule (DST009) and the model checker
+(tools/proto_check.py) can be checked *against the code*, not against a
+hand-maintained list that drifts.
+
+Every tag expression is resolved to a **pattern**: constant parts stay
+literal, runtime parts (f-string fields, unresolvable names) become
+``*``.  ``f"migrate:{seq}:{lo}-{hi}@e{epoch}"`` extracts as
+``migrate:*:*-*@e*``; ``"barrier:" + tag`` as ``barrier:*``.  Resolution
+follows module-level string constants (``_JOIN_ANNOUNCE_TAG``) and
+single-assignment locals (``tag = f"{_JOIN_OFFER_TAG}:{tp.rank}"`` two
+lines above the ``recv``), which covers every tag site in the package.
+
+Two patterns *may match* when the literal head of one (text up to the
+first ``*``) is a prefix of the other's — deliberately over-matching, so
+the black-holed-frame check under-reports rather than cries wolf.  A tag
+expression that resolves to nothing literal at all (a bare parameter,
+``sock.recv(1024)``'s byte count) yields an *opaque* site: opaque recvs
+conservatively satisfy any send, opaque sends are never reported.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import ModuleCtx
+
+# op -> (argument index of the tag, direction)
+#   "out"  — the site emits frames with this tag
+#   "in"   — the site consumes frames with this tag
+#   "both" — collective: every rank sends and receives under the tag
+_TAG_OPS: Dict[str, Tuple[int, str]] = {
+    "send": (1, "out"),
+    "recv": (0, "in"),
+    "pending_sources": (0, "in"),
+    "allgather": (1, "both"),
+    "alltoall": (1, "both"),
+    "allreduce_max": (1, "both"),
+    "barrier": (0, "both"),
+    "exchange_verdict": (0, "both"),
+}
+
+_COLLECTIVE_OPS = frozenset(
+    ("allgather", "alltoall", "allreduce_max", "barrier",
+     "exchange_verdict", "agree_membership")
+)
+
+# prefixes of the control-plane vocabulary: any string literal with one of
+# these heads counts as protocol vocabulary even when it reaches the
+# transport through a helper parameter (e.g. the ctl:load / ctl:jload
+# f-strings handed to the shard-load gather)
+CONTROL_PREFIXES = ("ctl:", "migrate:", "barrier:", "shuffle:")
+
+STAR = "*"
+
+
+@dataclass(frozen=True)
+class ProtoSite:
+    """One protocol call site: a tagged transport op, a membership round,
+    or an epoch gate."""
+
+    module: str
+    line: int
+    op: str  # key of _TAG_OPS, or "agree_membership" / "epoch_gate"
+    direction: str  # "out" | "in" | "both" | "gate"
+    pattern: str  # tag pattern with runtime parts as "*"; "" for gates
+    opaque: bool = False  # True when nothing literal could be recovered
+    fatal: bool = False  # exchange_verdict(..., fatal=True) commit points
+    has_fingerprint: bool = False  # tag/key embeds a .fingerprint() call
+
+    @property
+    def has_epoch(self) -> bool:
+        return "@e" in self.pattern
+
+    @property
+    def is_collective(self) -> bool:
+        return self.op in _COLLECTIVE_OPS
+
+
+def literal_head(pattern: str) -> str:
+    """Constant prefix of a pattern (text before the first ``*``)."""
+    i = pattern.find(STAR)
+    return pattern if i < 0 else pattern[:i]
+
+
+def patterns_may_match(a: str, b: str) -> bool:
+    """Conservative unification: literal patterns must be equal; once a
+    wildcard is involved, the literal heads must be prefix-compatible.
+    Errs toward matching (DST009 under-reports black holes)."""
+    if STAR not in a and STAR not in b:
+        return a == b
+    ha, hb = literal_head(a), literal_head(b)
+    return ha.startswith(hb) or hb.startswith(ha)
+
+
+# ---- tag expression resolution ---------------------------------------------
+
+
+def _module_str_consts(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Constant):
+            v = stmt.value.value
+            if isinstance(v, str):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = v
+    return out
+
+
+def _local_assigns(fn: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> value exprs assigned to it anywhere in ``fn`` (excluding
+    nested defs, whose locals are their own)."""
+    out: Dict[str, List[ast.AST]] = {}
+
+    def walk(node: ast.AST, top: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not top:
+                    continue
+                walk(child, False)
+                continue
+            if isinstance(child, ast.Assign) and len(child.targets) == 1:
+                t = child.targets[0]
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(child.value)
+            walk(child, top)
+
+    # fn itself is the def whose body we want; nested defs are skipped
+    for stmt in getattr(fn, "body", []):
+        walk(stmt, True)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                out.setdefault(t.id, []).append(stmt.value)
+    return out
+
+
+class _Resolver:
+    """Resolves a tag expression to a pattern string, or None when the
+    expression is definitely not a string (numeric recv byte counts)."""
+
+    def __init__(self, consts: Dict[str, str], local_env: Dict[str, List[ast.AST]]):
+        self.consts = consts
+        self.local_env = local_env
+
+    def resolve(self, expr: ast.AST, depth: int = 0) -> Optional[str]:
+        if depth > 6:
+            return STAR
+        if isinstance(expr, ast.Constant):
+            return expr.value if isinstance(expr.value, str) else None
+        if isinstance(expr, ast.JoinedStr):
+            parts: List[str] = []
+            for v in expr.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                elif isinstance(v, ast.FormattedValue):
+                    inner = self.resolve(v.value, depth + 1)
+                    parts.append(inner if inner not in (None, "") else STAR)
+                else:
+                    parts.append(STAR)
+            return "".join(parts)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left = self.resolve(expr.left, depth + 1)
+            right = self.resolve(expr.right, depth + 1)
+            if left is None and right is None:
+                return None
+            return (left or STAR) + (right or STAR)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.consts:
+                return self.consts[expr.id]
+            vals = self.local_env.get(expr.id, [])
+            if len(vals) == 1:
+                return self.resolve(vals[0], depth + 1) or STAR
+            return STAR
+        # attributes, calls, subscripts: runtime values
+        return STAR
+
+
+def _has_fingerprint(
+    expr: ast.AST, res: Optional["_Resolver"] = None, depth: int = 0
+) -> bool:
+    """True when a ``.fingerprint()`` call flows into ``expr`` — directly,
+    or (like pattern resolution) via a single-assignment local."""
+    if depth > 4:
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "fingerprint":
+                return True
+        if res is not None and isinstance(node, ast.Name):
+            vals = res.local_env.get(node.id, [])
+            if len(vals) == 1 and _has_fingerprint(vals[0], res, depth + 1):
+                return True
+    return False
+
+
+# ---- extraction -------------------------------------------------------------
+
+
+@dataclass
+class ProtocolModel:
+    """The extracted vocabulary plus the send/recv matching table."""
+
+    sites: List[ProtoSite] = field(default_factory=list)
+    # control-prefixed string literals seen anywhere (op="tag_literal"):
+    # vocabulary that reaches the transport through helper parameters
+    literal_tags: List[ProtoSite] = field(default_factory=list)
+
+    def tag_patterns(self) -> Set[str]:
+        return {s.pattern for s in self.sites if s.pattern and not s.opaque}
+
+    def control_patterns(self) -> Set[str]:
+        """Every control-vocabulary pattern: direct tag-op sites plus
+        control-prefixed literals routed through helpers."""
+        out = {
+            p for p in self.tag_patterns()
+            if literal_head(p).startswith(CONTROL_PREFIXES)
+        }
+        out.update(s.pattern for s in self.literal_tags)
+        return out
+
+    def sites_in(self, module: str) -> List[ProtoSite]:
+        return [s for s in self.sites if s.module == module]
+
+    def send_sites(self) -> List[ProtoSite]:
+        return [s for s in self.sites if s.direction == "out"]
+
+    def recv_sites(self) -> List[ProtoSite]:
+        return [s for s in self.sites if s.direction == "in"]
+
+    def collective_sites(self) -> List[ProtoSite]:
+        return [s for s in self.sites if s.is_collective]
+
+    def epoch_gates(self) -> List[ProtoSite]:
+        return [s for s in self.sites if s.op == "epoch_gate"]
+
+    def receivers_for(self, send: ProtoSite) -> List[ProtoSite]:
+        """Recv-side sites whose pattern may match this send's."""
+        out: List[ProtoSite] = []
+        for s in self.recv_sites():
+            if s.opaque or patterns_may_match(send.pattern, s.pattern):
+                out.append(s)
+        return out
+
+    def unmatched_sends(self) -> List[ProtoSite]:
+        """Point-to-point sends with no possible receiver anywhere in the
+        scanned set — black-holed frames.  Opaque sends are skipped (we
+        could not read their tag, so we cannot call them unmatched)."""
+        return [
+            s for s in self.send_sites()
+            if not s.opaque and not self.receivers_for(s)
+        ]
+
+    def covers_tag(self, tag: str) -> bool:
+        """True when a concrete runtime tag is within the extracted
+        vocabulary (some non-opaque pattern or control literal matches)."""
+        pats = self.tag_patterns() | {s.pattern for s in self.literal_tags}
+        return any(patterns_may_match(tag, p) for p in pats)
+
+
+def extract_protocol(modules: Sequence[ModuleCtx]) -> ProtocolModel:
+    model = ProtocolModel()
+    for ctx in modules:
+        consts = _module_str_consts(ctx.tree)
+        # walk per-function so locals resolve against the right scope;
+        # module-level calls resolve against constants only
+        funcs = [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        covered: Set[int] = set()
+        # ast.walk is breadth-first, so reversing visits nested defs before
+        # their hosts — a call inside a nested def must resolve against the
+        # nested scope's locals, not the host's
+        for fn in reversed(funcs):
+            env = _local_assigns(fn)
+            res = _Resolver(consts, env)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and id(node) not in covered:
+                    site = _site_for_call(ctx, node, res)
+                    if site is not None:
+                        covered.add(id(node))
+                        model.sites.append(site)
+        res = _Resolver(consts, {})
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and id(node) not in covered:
+                site = _site_for_call(ctx, node, res)
+                if site is not None:
+                    model.sites.append(site)
+        # secondary sweep: control-prefixed literals anywhere in the module
+        # (tags handed to helpers as parameters never hit a tag op directly)
+        inside_fstring = {
+            id(v) for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.JoinedStr) for v in node.values
+        }
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Constant, ast.JoinedStr)):
+                if id(node) in inside_fstring:
+                    continue  # fragments report through their JoinedStr
+                pat = res.resolve(node)
+                if pat and literal_head(pat).startswith(CONTROL_PREFIXES):
+                    model.literal_tags.append(ProtoSite(
+                        module=ctx.path, line=getattr(node, "lineno", 0),
+                        op="tag_literal", direction="lit", pattern=pat,
+                    ))
+    model.sites.sort(key=lambda s: (s.module, s.line, s.op))
+    model.literal_tags.sort(key=lambda s: (s.module, s.line, s.pattern))
+    return model
+
+
+def _call_tail(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _site_for_call(
+    ctx: ModuleCtx, node: ast.Call, res: _Resolver
+) -> Optional[ProtoSite]:
+    name = _call_tail(node)
+    if name is None:
+        return None
+    if name == "agree_membership":
+        return ProtoSite(
+            module=ctx.path, line=node.lineno, op=name, direction="both",
+            pattern="ctl:member:*",
+        )
+    if name == "discard_epochs_below":
+        return ProtoSite(
+            module=ctx.path, line=node.lineno, op="epoch_gate",
+            direction="gate", pattern="",
+        )
+    if name not in _TAG_OPS:
+        return None
+    idx, direction = _TAG_OPS[name]
+    if len(node.args) <= idx:
+        tag_expr = None
+        for kw in node.keywords:
+            if kw.arg == "tag" or (name == "exchange_verdict" and kw.arg == "key"):
+                tag_expr = kw.value
+        if tag_expr is None:
+            return None
+    else:
+        tag_expr = node.args[idx]
+    pattern = res.resolve(tag_expr)
+    if pattern is None:
+        return None  # definitely not a string tag (socket.recv byte count)
+    fatal = False
+    if name == "exchange_verdict":
+        if len(node.args) > 3 and isinstance(node.args[3], ast.Constant):
+            fatal = bool(node.args[3].value)
+        for kw in node.keywords:
+            if kw.arg == "fatal" and isinstance(kw.value, ast.Constant):
+                fatal = bool(kw.value.value)
+        # the wrapper builds f"ctl:verdict:{key}@e{epoch}" around the key
+        pattern = f"ctl:verdict:{pattern}@e{STAR}"
+    if name == "barrier":
+        pattern = "barrier:" + pattern
+    opaque = literal_head(pattern) == "" and pattern.replace(STAR, "") == ""
+    return ProtoSite(
+        module=ctx.path, line=node.lineno, op=name, direction=direction,
+        pattern=pattern, opaque=opaque, fatal=fatal,
+        has_fingerprint=_has_fingerprint(tag_expr, res),
+    )
+
+
+_CACHE: Dict[int, ProtocolModel] = {}
+
+
+def get_protocol(modules: Sequence[ModuleCtx]) -> ProtocolModel:
+    """Build (or reuse) the extraction for this exact module list —
+    mirrors get_callgraph's one-live-graph cache."""
+    key = hash(tuple(id(m) for m in modules))
+    model = _CACHE.get(key)
+    if model is None:
+        _CACHE.clear()
+        model = extract_protocol(modules)
+        _CACHE[key] = model
+    return model

@@ -1,0 +1,192 @@
+"""DST009 — distributed discipline for the tagged-frame control plane.
+
+Three statically checkable ways a PBTX protocol change deadlocks or
+splits the fleet, all of which the elastic soaks only catch *after* a
+hang (docs/STATIC_ANALYSIS.md "Protocol verification"):
+
+- **rank-conditional collective**: a collective round (``allgather``/
+  ``alltoall``/``allreduce_max``/``barrier``/``exchange_verdict``/
+  ``agree_membership``) reached under an ``if`` whose test mentions a
+  rank identity, with no matching collective on the other arm.  Ranks
+  taking the other arm never enter the round: the entering ranks block
+  until the transport timeout.  Collectives must run unconditionally or
+  symmetrically on every arm (the package's own idiom — see the
+  ``carry-gate`` comment in data/dataset.py: "must still answer, or the
+  hosts that can would hang").
+- **black-holed frame**: a point-to-point ``send`` whose tag pattern no
+  ``recv``/``pending_sources`` site in the whole scanned set can match.
+  The frame sits in the receiver's pending map forever (or trips the
+  stale-epoch floor); the payload is silently lost.
+- **verdict discipline**: a verdict round whose tag lacks the ``@e``
+  epoch component would be answerable by frames from a previous
+  incarnation (split-brain risk); a *commit-point* verdict
+  (``exchange_verdict(..., fatal=True)`` — the all-or-die map flips)
+  whose key lacks a ``fingerprint()`` component would let ranks whose
+  bases diverged commit the same epoch number over different maps — the
+  exact hole the PR 16 fingerprint-tagged verdicts closed.
+
+Resolution rides analysis/protocol.py: runtime tag components are ``*``
+wildcards and matching is prefix-conservative, so every check here
+under-reports rather than inventing deadlocks.  A tag the extractor
+cannot read at all (opaque) satisfies any send and is never reported
+itself.  Rank-conditional detection matches an exact ``rank`` name or
+attribute in the branch test (``tp.rank == 0``, ``if rank:``) —
+``n_ranks`` comparisons and early-``return`` guard styles are out of
+scope and stay on the model checker (tools/proto_check.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding, ModuleCtx, Rule
+from .protocol import (
+    _COLLECTIVE_OPS,
+    _TAG_OPS,
+    ProtoSite,
+    get_protocol,
+    patterns_may_match,
+)
+
+_COLLECTIVE_CALL_NAMES = frozenset(_COLLECTIVE_OPS)
+
+
+def _is_rank_conditional(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id == "rank":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "rank":
+            return True
+    return False
+
+
+def _collectives_under(arm: Sequence[ast.stmt]) -> List[ast.Call]:
+    """Collective call sites syntactically inside an If arm, excluding
+    nested def bodies (a def under a branch is not a call)."""
+    out: List[ast.Call] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Call):
+                f = child.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                if name in _COLLECTIVE_CALL_NAMES:
+                    out.append(child)
+            walk(child)
+
+    for stmt in arm:
+        walk(stmt)
+    return out
+
+
+class DistributedDisciplineRule(Rule):
+    id = "DST009"
+    doc = "collectives must be rank-symmetric; sends need receivers; verdicts need epoch+fingerprint"
+
+    def finalize(self, modules: Sequence[ModuleCtx]) -> List[Finding]:
+        model = get_protocol(modules)
+        by_path: Dict[str, ModuleCtx] = {m.path: m for m in modules}
+        site_at: Dict[Tuple[str, int, str], ProtoSite] = {
+            (s.module, s.line, s.op): s for s in model.sites
+        }
+        findings: List[Finding] = []
+
+        # ---- black-holed frames -------------------------------------------
+        for s in model.unmatched_sends():
+            ctx = by_path.get(s.module)
+            if ctx is None:
+                continue
+            f = self.finding(
+                ctx, s.line,
+                f'send tag "{s.pattern}" has no matching recv/'
+                "pending_sources site anywhere in the scanned set — the "
+                "frame is black-holed in the receiver's pending map",
+            )
+            if f is not None:
+                findings.append(f)
+
+        # ---- verdict discipline -------------------------------------------
+        for s in model.collective_sites():
+            ctx = by_path.get(s.module)
+            if ctx is None or s.opaque:
+                continue
+            if "verdict" in s.pattern and not s.has_epoch:
+                f = self.finding(
+                    ctx, s.line,
+                    f'verdict round "{s.pattern}" carries no @e epoch '
+                    "component — frames from a dead incarnation could "
+                    "answer it (split-brain risk)",
+                )
+                if f is not None:
+                    findings.append(f)
+            if s.op == "exchange_verdict" and s.fatal and not s.has_fingerprint:
+                f = self.finding(
+                    ctx, s.line,
+                    f'commit-point verdict "{s.pattern}" (fatal=True) has '
+                    "no map fingerprint() component in its key — diverged "
+                    "bases could commit the same epoch over different maps",
+                )
+                if f is not None:
+                    findings.append(f)
+
+        # ---- rank-conditional collectives ---------------------------------
+        for ctx in modules:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.If):
+                    continue
+                if not _is_rank_conditional(node.test):
+                    continue
+                body_c = _collectives_under(node.body)
+                else_c = _collectives_under(node.orelse)
+                for here, there, arm in (
+                    (body_c, else_c, "true"),
+                    (else_c, body_c, "false"),
+                ):
+                    for call in here:
+                        f_ = call.func
+                        op = f_.attr if isinstance(f_, ast.Attribute) else (
+                            f_.id if isinstance(f_, ast.Name) else "")
+                        site = site_at.get((ctx.path, call.lineno, op))
+                        pattern = site.pattern if site else None
+                        if self._arm_matches(
+                            ctx, there, pattern, site_at
+                        ):
+                            continue
+                        tag = f' tag "{pattern}"' if pattern else ""
+                        f = self.finding(
+                            ctx, call,
+                            f"collective {op}(){tag} runs only on the "
+                            f"{arm} arm of a rank-conditional branch — "
+                            "ranks taking the other arm never enter the "
+                            "round and the callers block until timeout "
+                            "(static deadlock)",
+                        )
+                        if f is not None:
+                            findings.append(f)
+        return findings
+
+    def _arm_matches(
+        self,
+        ctx: ModuleCtx,
+        other_arm: List[ast.Call],
+        pattern: Optional[str],
+        site_at: Dict[Tuple[str, int, str], ProtoSite],
+    ) -> bool:
+        """True when the other arm holds a collective that could pair with
+        this one (same/compatible tag, or either side unresolvable)."""
+        for call in other_arm:
+            f = call.func
+            op = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            site = site_at.get((ctx.path, call.lineno, op))
+            other = site.pattern if site else None
+            if pattern is None or other is None:
+                return True  # conservative: unreadable tags may pair
+            if patterns_may_match(pattern, other):
+                return True
+        return False

@@ -1,0 +1,288 @@
+"""RES010 — resource lifecycle: threads joined, sockets shut down, handles closed.
+
+Encodes the teardown invariants the elastic planes learned the hard way
+(docs/STATIC_ANALYSIS.md "Resource lifecycle"):
+
+- **threads**: every ``threading.Thread(...)`` must either be
+  ``daemon=True`` or reach a ``.join()`` on the name/attribute it is
+  bound to.  A non-daemon thread nobody joins turns interpreter exit
+  into an unbounded wait and hides the errors the target raised; a
+  fire-and-forget ``Thread(...).start()`` is flagged outright.
+- **sockets**: a *listening or accepted* socket must see ``shutdown()``
+  before ``close()`` — the PR 16 rejoin invariant: a bare ``close()`` on
+  a dead incarnation's server/reader socket neither sends FIN nor wakes
+  a blocked reader, so the successor's frames are silently eaten.
+  Connect-side and bind-only (port-pick) sockets have no blocked peer
+  and are out of scope.
+- **executors**: a ``ThreadPoolExecutor``/``ProcessPoolExecutor`` must be
+  used as a context manager or reach ``.shutdown()`` on its binding.
+- **files**: an ``open()`` result bound to a name outside a ``with``
+  must reach ``.close()`` on that binding (IO004 owns the durability of
+  *write* paths; this arm owns the descriptor itself).
+
+The analysis is module-scoped and name-based: a resource bound to
+``x``/``self.x`` is satisfied by ``x.join()``, ``self.x.join()``, a
+loop ``for t in xs: t.join()`` over its list, or an alias
+(``t = self.x`` / ``t = getattr(self, "x", None)``).  A resource handed
+to another function or returned is not tracked (under-reporting, never
+false alarms); deliberate fire-and-forget threads carry a justified
+``# pbox-lint: disable=RES010`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleCtx, Rule
+
+_EXECUTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_SOCKET_MAKERS = {"socket", "create_server"}
+
+
+def _call_tail(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _receiver_key(expr: ast.AST) -> Optional[Tuple[str, str]]:
+    """("name", x) for ``x.meth()``, ("attr", x) for ``<any>.x.meth()``."""
+    if isinstance(expr, ast.Name):
+        return ("name", expr.id)
+    if isinstance(expr, ast.Attribute):
+        return ("attr", expr.attr)
+    return None
+
+
+class _ModuleScan:
+    """One pass over a module collecting method receivers, aliases and
+    with-statement context expressions."""
+
+    def __init__(self, tree: ast.Module):
+        self.parent: Dict[int, ast.AST] = {}
+        self.with_ctx: Set[int] = set()
+        # method name -> receiver keys it was called on
+        self.called_on: Dict[str, Set[Tuple[str, str]]] = {}
+        # local name -> attr tails it aliases (v = self.x / getattr(o, "x"));
+        # a multi-map: the same local name in different functions may alias
+        # different attributes
+        self.alias_attr: Dict[str, Set[str]] = {}
+        # loop var -> iterated name/attr key
+        self.loop_src: Dict[str, Tuple[str, str]] = {}
+        # names receiving call args of close-like helpers (_close_sock(s))
+        self.closed_via_helper: Set[str] = set()
+
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[id(child)] = node
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    self.with_ctx.add(id(item.context_expr))
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    key = _receiver_key(node.func.value)
+                    if key is not None:
+                        self.called_on.setdefault(
+                            node.func.attr, set()).add(key)
+                tail = _call_tail(node)
+                if tail and "close" in tail.lower():
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            self.closed_via_helper.add(a.id)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    v = node.value
+                    if isinstance(v, ast.Attribute):
+                        self.alias_attr.setdefault(t.id, set()).add(v.attr)
+                    elif (
+                        isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Name)
+                        and v.func.id == "getattr"
+                        and len(v.args) >= 2
+                        and isinstance(v.args[1], ast.Constant)
+                        and isinstance(v.args[1].value, str)
+                    ):
+                        self.alias_attr.setdefault(t.id, set()).add(
+                            v.args[1].value)
+            elif isinstance(node, ast.For):
+                if isinstance(node.target, ast.Name):
+                    key = _receiver_key(node.iter)
+                    if key is not None:
+                        self.loop_src[node.target.id] = key
+
+    def receivers_of(self, method: str) -> Set[Tuple[str, str]]:
+        """Receiver keys ``method`` is called on, expanded through aliases
+        and loop variables: ``t.join()`` where ``t = getattr(o, "x")``
+        also satisfies ("attr", "x"); ``for t in xs: t.join()`` satisfies
+        ("name", "xs") / ("attr", "xs")."""
+        base = set(self.called_on.get(method, ()))
+        out = set(base)
+        for kind, name in base:
+            if kind != "name":
+                continue
+            for attr in self.alias_attr.get(name, ()):
+                out.add(("attr", attr))
+            if name in self.loop_src:
+                src = self.loop_src[name]
+                out.add(src)
+                out.add(("attr", src[1]))
+        return out
+
+    def binding_of(self, call: ast.Call) -> Optional[Tuple[str, str]]:
+        """Climb parents to the binding of a creation call: through
+        IfExp/comprehensions/list displays to an Assign target, or an
+        ``xs.append(...)`` receiver.  None when untrackable."""
+        node: ast.AST = call
+        for _ in range(8):
+            p = self.parent.get(id(node))
+            if p is None:
+                return None
+            if isinstance(p, ast.Assign):
+                for t in p.targets:
+                    key = _receiver_key(t)
+                    if key is not None:
+                        return key
+                    if isinstance(t, ast.Tuple):
+                        for e in t.elts:
+                            if isinstance(e, ast.Name) and not e.id.startswith("_"):
+                                return ("name", e.id)
+                return None
+            if isinstance(p, ast.Call) and isinstance(p.func, ast.Attribute) \
+                    and p.func.attr == "append":
+                return _receiver_key(p.func.value)
+            if isinstance(
+                p,
+                (ast.IfExp, ast.ListComp, ast.GeneratorExp, ast.SetComp,
+                 ast.List, ast.Tuple, ast.comprehension, ast.Starred),
+            ):
+                node = p
+                continue
+            return None
+        return None
+
+    def started_inline(self, call: ast.Call) -> bool:
+        """True for ``Thread(...).start()`` — created and fired unbound."""
+        p = self.parent.get(id(call))
+        return isinstance(p, ast.Attribute) and p.attr == "start"
+
+
+def _kw_true(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+class ResourceLifecycleRule(Rule):
+    id = "RES010"
+    doc = "threads joined or daemon; listening sockets shutdown-before-close; executors/files closed"
+
+    def check_module(self, ctx: ModuleCtx) -> List[Finding]:
+        scan = _ModuleScan(ctx.tree)
+        findings: List[Finding] = []
+        joined = scan.receivers_of("join")
+        shut = scan.receivers_of("shutdown")
+        closed = scan.receivers_of("close")
+        listened = scan.receivers_of("listen")
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_tail(node)
+
+            if tail == "Thread":
+                if _kw_true(node, "daemon"):
+                    continue
+                if scan.started_inline(node):
+                    f = self.finding(
+                        ctx, node,
+                        "non-daemon Thread(...).start() is never joinable — "
+                        "bind it and join, set daemon=True, or justify with "
+                        "a RES010 suppression",
+                    )
+                    if f is not None:
+                        findings.append(f)
+                    continue
+                key = scan.binding_of(node)
+                if key is not None and key not in joined:
+                    f = self.finding(
+                        ctx, node,
+                        f'non-daemon thread bound to "{key[1]}" is never '
+                        "joined in this module — interpreter exit blocks on "
+                        "it and its errors are lost",
+                    )
+                    if f is not None:
+                        findings.append(f)
+
+            elif tail in _EXECUTORS:
+                if id(node) in scan.with_ctx:
+                    continue
+                key = scan.binding_of(node)
+                if key is None:
+                    f = self.finding(
+                        ctx, node,
+                        f"{tail} is neither a context manager nor bound for "
+                        "shutdown() — worker threads outlive the work",
+                    )
+                    if f is not None:
+                        findings.append(f)
+                elif key not in shut:
+                    f = self.finding(
+                        ctx, node,
+                        f'executor bound to "{key[1]}" never reaches '
+                        "shutdown() in this module — worker threads leak "
+                        "past the work that spawned them",
+                    )
+                    if f is not None:
+                        findings.append(f)
+
+            elif tail == "accept" or (
+                tail in _SOCKET_MAKERS and isinstance(node.func, ast.Attribute)
+            ):
+                key = scan.binding_of(node)
+                if key is None:
+                    continue
+                peered = tail != "socket" or key in listened
+                is_closed = (
+                    key in closed
+                    or (key[0] == "name" and key[1] in scan.closed_via_helper)
+                )
+                if peered and is_closed and key not in shut:
+                    what = (
+                        "accepted socket" if tail == "accept"
+                        else "listening socket"
+                    )
+                    f = self.finding(
+                        ctx, node,
+                        f'{what} bound to "{key[1]}" is closed without '
+                        "shutdown() — a bare close neither sends FIN nor "
+                        "wakes a blocked reader, so a peer of a dead "
+                        "incarnation silently eats the successor's frames "
+                        "(the transport.py teardown invariant)",
+                    )
+                    if f is not None:
+                        findings.append(f)
+
+            elif tail == "open" and isinstance(node.func, ast.Name):
+                if id(node) in scan.with_ctx:
+                    continue
+                key = scan.binding_of(node)
+                if key is None:
+                    continue  # anonymous/one-expression opens: refcount-scoped
+                if key not in closed and not (
+                    key[0] == "name" and key[1] in scan.closed_via_helper
+                ):
+                    f = self.finding(
+                        ctx, node,
+                        f'file handle bound to "{key[1]}" never reaches '
+                        "close() in this module — the descriptor leaks on "
+                        "normal exit paths",
+                    )
+                    if f is not None:
+                        findings.append(f)
+        return findings

@@ -1523,8 +1523,10 @@ class BoxPSDataset:
 
         self._end_pass_fut = fut
         # non-daemon: interpreter exit JOINS an in-flight publish instead of
-        # killing it mid-write (truncated delta files, lost writeback)
-        threading.Thread(target=worker, daemon=False).start()
+        # killing it mid-write (truncated delta files, lost writeback);
+        # wait_end_pass joins the handle once the future settles
+        self._end_pass_thread = threading.Thread(target=worker, daemon=False)
+        self._end_pass_thread.start()
 
     def wait_end_pass(self) -> dict:
         """Join a pending end_pass_async; returns its result dict (or the
@@ -1544,6 +1546,13 @@ class BoxPSDataset:
                 raise
             finally:
                 self._end_pass_fut = None
+                # the future settles inside the worker, so this join only
+                # covers the record_event epilogue — but it retires the
+                # handle instead of abandoning a zombie Thread object
+                t = getattr(self, "_end_pass_thread", None)
+                if t is not None:
+                    t.join()
+                    self._end_pass_thread = None
             blocked = time.perf_counter() - t0
             hidden = max(
                 0.0, self._end_pass_result.get("secs", 0.0) - blocked

@@ -158,9 +158,13 @@ class TableCarrier:
             except BaseException as e:
                 fut.set_exception(e)
 
-        threading.Thread(target=work, daemon=False).start()
+        # non-daemon so interpreter exit joins an in-flight push; join_push
+        # retires the handle once the future settles
+        th = threading.Thread(target=work, daemon=False)
+        th.start()
         with self._push_lock:
             self._push_fut = (fut, pos)
+            self._push_thread = th
 
     def join_push(self) -> None:
         """Wait for an in-flight departure push (idempotent).
@@ -172,6 +176,8 @@ class TableCarrier:
         silently drop exactly the rows whose push failed."""
         with self._push_lock:
             fut_pos, self._push_fut = self._push_fut, None
+            th = getattr(self, "_push_thread", None)
+            self._push_thread = None
         if fut_pos is not None:
             fut, pos = fut_pos
             try:
@@ -183,6 +189,9 @@ class TableCarrier:
                     else None
                 )
                 raise
+            finally:
+                if th is not None:
+                    th.join()
 
     def wait_push(self) -> None:
         """Block until any in-flight departure push lands, WITHOUT

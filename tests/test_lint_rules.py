@@ -607,6 +607,196 @@ class TestFaultSiteCoverage:
         assert len(msgs) == 1 and "dead.site" in msgs[0]
 
 
+# ---- DST009 ----------------------------------------------------------------
+
+
+class TestDistributedDiscipline:
+    def test_black_holed_send(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def push(tp):
+                tp.send(1, "ctl:orphan:ping", b"")
+
+            def paired(tp):
+                tp.send(1, "ctl:pair:pong", b"")
+
+            def pull(tp):
+                return tp.recv("ctl:pair:pong", 0)
+        """)
+        msgs = [f.message for f in rule_findings(res, "DST009")]
+        assert len(msgs) == 1
+        assert "ctl:orphan:ping" in msgs[0] and "black-holed" in msgs[0]
+
+    def test_rank_conditional_collective(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def lopsided(tp):
+                if tp.rank == 0:
+                    tp.allgather(b"", "ctl:member:probe")
+
+            def symmetric(tp):
+                if tp.rank == 0:
+                    tp.allgather(b"lead", "barrier:x")
+                else:
+                    tp.allgather(b"flw", "barrier:x")
+
+            def pull(tp):
+                # the lopsided member tag still needs a nominal receiver
+                return tp.recv("ctl:member:probe", 0)
+        """)
+        msgs = [f.message for f in rule_findings(res, "DST009")]
+        assert len(msgs) == 1
+        assert "static deadlock" in msgs[0] and "allgather" in msgs[0]
+
+    def test_verdict_discipline(self, tmp_path):
+        res = lint_source(tmp_path, """
+            class Sup:
+                def exchange_verdict(self, key, ok, detail="", fatal=False):
+                    return ok
+
+                def unfenced(self, tp):
+                    tp.allgather(b"", "ctl:verdict:load")
+
+                def unfingerprinted(self, ok):
+                    self.exchange_verdict("migrate", ok, fatal=True)
+
+                def fenced_commit(self, ok, m):
+                    key = "migrate:" + m.fingerprint()
+                    self.exchange_verdict(key, ok, fatal=True)
+        """)
+        msgs = [f.message for f in rule_findings(res, "DST009")]
+        assert any("no @e epoch" in m and "split-brain" in m for m in msgs)
+        assert any("fingerprint()" in m and "fatal=True" in m for m in msgs)
+        assert len(msgs) == 2  # fenced_commit stays quiet
+
+    def test_clean_protocol_is_quiet(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def exchange(tp, epoch):
+                tp.send(1, f"ctl:state:{tp.rank}@e{epoch}", b"")
+                got = tp.recv(f"ctl:state:{1 - tp.rank}@e{epoch}", 1 - tp.rank)
+                tp.allgather(got, f"ctl:round:sync@e{epoch}")
+        """)
+        assert rule_findings(res, "DST009") == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint_source(tmp_path, """
+            def push(tp):
+                # best-effort diagnostic frame; loss is acceptable
+                # pbox-lint: disable=DST009
+                tp.send(1, "ctl:orphan:ping", b"")
+        """)
+        assert rule_findings(res, "DST009") == []
+
+
+# ---- RES010 ----------------------------------------------------------------
+
+
+class TestResourceLifecycle:
+    def test_thread_positive(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+
+            def fire_and_forget(fn):
+                threading.Thread(target=fn).start()
+
+            def bound_but_abandoned(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+        """)
+        msgs = [f.message for f in rule_findings(res, "RES010")]
+        assert any("never joinable" in m for m in msgs)
+        assert any('"t" is never joined' in m for m in msgs)
+
+    def test_thread_clean(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def spawn(self, fn):
+                    self._th = threading.Thread(target=fn, daemon=False)
+                    self._th.start()
+                    w = threading.Thread(target=fn, daemon=True)
+                    w.start()
+
+                def stop(self):
+                    t = getattr(self, "_th", None)
+                    if t is not None:
+                        t.join()
+        """)
+        assert rule_findings(res, "RES010") == []
+
+    def test_socket_shutdown_before_close(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import socket
+
+            def bad_teardown(srv):
+                conn, addr = srv.accept()
+                conn.close()
+
+            def good_teardown(srv):
+                peer, addr = srv.accept()
+                peer.shutdown(socket.SHUT_RDWR)
+                peer.close()
+        """)
+        msgs = [f.message for f in rule_findings(res, "RES010")]
+        assert len(msgs) == 1
+        assert '"conn"' in msgs[0] and "shutdown()" in msgs[0]
+
+    def test_listening_socket(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import socket
+
+            def serve_bad():
+                s = socket.socket()
+                s.listen(8)
+                s.close()
+
+            def port_pick_ok():
+                # bind-only probe: no peer is ever blocked on it
+                s2 = socket.socket()
+                s2.bind(("127.0.0.1", 0))
+                port = s2.getsockname()[1]
+                s2.close()
+                return port
+        """)
+        msgs = [f.message for f in rule_findings(res, "RES010")]
+        assert len(msgs) == 1 and '"s"' in msgs[0]
+
+    def test_executor_and_open(self, tmp_path):
+        res = lint_source(tmp_path, """
+            from concurrent.futures import ThreadPoolExecutor
+
+            def leaky(fn, path):
+                ex = ThreadPoolExecutor(2)
+                ex.submit(fn)
+                f = open(path)
+                return f.read()
+
+            def tidy(fn, path):
+                with ThreadPoolExecutor(2) as ex:
+                    ex.submit(fn)
+                pool = ThreadPoolExecutor(2)
+                pool.submit(fn)
+                pool.shutdown(wait=True)
+                with open(path) as f:
+                    return f.read()
+        """)
+        msgs = [f.message for f in rule_findings(res, "RES010")]
+        assert any('"ex"' in m and "shutdown()" in m for m in msgs)
+        assert any('"f"' in m and "close()" in m for m in msgs)
+        assert len(msgs) == 2
+
+    def test_suppressed(self, tmp_path):
+        res = lint_source(tmp_path, """
+            import threading
+
+            def watchdog(fn):
+                # process-lifetime watcher; joined by interpreter exit
+                # pbox-lint: disable=RES010
+                threading.Thread(target=fn).start()
+        """)
+        assert rule_findings(res, "RES010") == []
+
+
 # ---- baseline round-trip ---------------------------------------------------
 
 

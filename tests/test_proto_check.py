@@ -1,0 +1,137 @@
+"""tools/proto_check.py contract: the clean membership-protocol model
+explores to a fixpoint with zero invariant violations; every deliberately
+broken variant is caught on exactly the invariant it breaks; and the
+model's tag vocabulary is pinned as a subset of what analysis/protocol.py
+extracts from the real package — so the model cannot silently drift away
+from the code it claims to verify."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROTO_CHECK = os.path.join(REPO, "tools", "proto_check.py")
+
+
+def _load_proto_check():
+    spec = importlib.util.spec_from_file_location("pbox_proto_check", PROTO_CHECK)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass field resolution looks the module up by name
+    sys.modules["pbox_proto_check"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+pc = _load_proto_check()
+
+
+# ---- clean model ------------------------------------------------------------
+
+
+def test_clean_model_reaches_fixpoint_with_no_violations():
+    res = pc.Checker(ranks=3, deaths=1, joins=0, nos=1, max_epochs=2).run()
+    assert res.complete, "state budget must not truncate the bounded model"
+    assert res.ok, res.violations
+    # the bounds are non-trivial: deaths and no-votes interleave with
+    # votes and per-recipient deliveries
+    assert res.states > 1_000
+    assert res.transitions > res.states
+
+
+def test_clean_join_path_is_safe():
+    res = pc.Checker(ranks=3, deaths=1, joins=1, nos=1, max_epochs=2).run()
+    assert res.complete and res.ok, res.violations
+
+
+def test_budget_exhaustion_is_reported_not_hidden():
+    res = pc.Checker(ranks=3, deaths=1, joins=1, nos=1, max_epochs=3,
+                     max_states=200).run()
+    assert not res.complete
+    assert res.states <= 200
+
+
+# ---- broken variants --------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(pc.BROKEN))
+def test_broken_variant_trips_exactly_its_invariant(name):
+    inv, _desc, bounds = pc.BROKEN[name]
+    res = pc.Checker(broken=name, **bounds).run()
+    assert res.violations, f"{name} must be caught"
+    assert {v["invariant"] for v in res.violations} == {inv}
+
+
+def test_every_invariant_has_a_broken_witness():
+    covered = {pc.BROKEN[n][0] for n in pc.BROKEN}
+    assert covered == set(pc.INVARIANTS)
+
+
+# ---- model vocabulary pinned to the real extraction -------------------------
+
+
+@pytest.fixture(scope="module")
+def real_model():
+    from paddlebox_tpu.analysis import extract_protocol
+    from paddlebox_tpu.analysis.core import ModuleCtx, iter_py_files
+
+    # package only: scanning tools/ would let proto_check.py's own
+    # MODEL_TAGS literals satisfy the pin trivially
+    mods = []
+    for p in iter_py_files([os.path.join(REPO, "paddlebox_tpu")]):
+        rel = os.path.relpath(p, REPO).replace(os.sep, "/")
+        mods.append(ModuleCtx.parse(p, rel))
+    return extract_protocol(mods)
+
+
+@pytest.mark.parametrize("transition", sorted(pc.MODEL_TAGS))
+def test_model_tags_are_subset_of_extraction(transition, real_model):
+    tag = pc.MODEL_TAGS[transition]
+    if tag.endswith(":"):
+        # a tag-family prefix: some real site must mint tags under it
+        pats = real_model.tag_patterns() | {
+            s.pattern for s in real_model.literal_tags
+        }
+        assert any(p.startswith(tag) for p in pats), (
+            f"model transition {transition!r} abstracts tag family "
+            f"{tag!r}, but no site in the package mints it"
+        )
+    else:
+        assert real_model.covers_tag(tag), (
+            f"model transition {transition!r} abstracts tag {tag!r}, "
+            f"but the extraction does not know it"
+        )
+
+
+# ---- CLI contract -----------------------------------------------------------
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, PROTO_CHECK, *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_exit_codes_and_json():
+    r = run_cli("--deaths", "0", "--joins", "0", "--nos", "0",
+                "--max-epochs", "1", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    d = json.loads(r.stdout)
+    assert d["complete"] and d["violations"] == [] and d["states"] > 0
+
+    r = run_cli("--broken", "double_owner")
+    assert r.returncode == 1
+    assert "VIOLATION I2" in r.stdout
+
+    r = run_cli("--deaths", "1", "--joins", "1", "--max-states", "50")
+    assert r.returncode == 2
+    assert "budget exhausted" in r.stdout
+
+    r = run_cli("--list-broken")
+    assert r.returncode == 0
+    for name in pc.BROKEN:
+        assert name in r.stdout

@@ -1,0 +1,192 @@
+"""Pin the static protocol model to runtime reality.
+
+Two drift checks in the FLT008 spirit (a registry is only trustworthy if
+a test fails when code and registry diverge):
+
+- every control tag a real 2-rank cluster puts on the wire while running
+  the membership rounds (agreement, mapsync, migrate, barrier) must be
+  covered by the analysis/protocol.py extraction — if someone mints a
+  new ``ctl:`` tag the extractor cannot see, this fails before DST009
+  silently under-reports;
+- every ``wire.*``/``membership.*``/``serve.*`` counter the bench/soak
+  harnesses export via ``STAT_GET`` must be a name package code actually
+  bumps — bench blocks must not export dead gauges.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.analysis import extract_protocol
+from paddlebox_tpu.analysis.core import ModuleCtx, iter_py_files
+from paddlebox_tpu.analysis.protocol import CONTROL_PREFIXES
+from paddlebox_tpu.parallel.membership import (
+    OwnershipMap,
+    agree_membership,
+    migrate_ranges,
+    sync_map,
+)
+from paddlebox_tpu.parallel.transport import TcpTransport
+from paddlebox_tpu.table.sparse_table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddlebox_tpu")
+
+
+@pytest.fixture(scope="module")
+def pkg_model():
+    mods = []
+    for p in iter_py_files([PKG]):
+        rel = os.path.relpath(p, REPO).replace(os.sep, "/")
+        mods.append(ModuleCtx.parse(p, rel))
+    return extract_protocol(mods)
+
+
+# ---- runtime control-tag coverage ------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fast_transport():
+    names = ("transport_heartbeat_s", "transport_backoff_s")
+    prev = {n: config.get_flag(n) for n in names}
+    config.set_flag("transport_heartbeat_s", 0.05)
+    config.set_flag("transport_backoff_s", 0.005)
+    yield
+    for n, v in prev.items():
+        config.set_flag(n, v)
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _run_ranks(fn, n):
+    results = [None] * n
+    errors = []
+
+    def wrap(r):
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=wrap, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+def test_runtime_control_tags_are_covered_by_extraction(pkg_model):
+    """Run the same membership rounds tests/test_elastic.py exercises and
+    check every control frame's tag against the static vocabulary."""
+    eps = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+    tps = [TcpTransport(r, eps, timeout=30.0) for r in range(2)]
+    seen = set()
+    lock = threading.Lock()
+    for tp in tps:
+        orig = tp.send
+
+        def send(dst, tag, payload, _orig=orig):
+            with lock:
+                seen.add(tag)
+            return _orig(dst, tag, payload)
+
+        tp.send = send
+
+    layout = ValueLayout(embedx_dim=2)
+    opt = SparseOptimizerConfig(embedx_threshold=0.0)
+    old_map = OwnershipMap(4, [0, 1], [0, 2, 4], epoch=0)
+    new_map = OwnershipMap(4, [0, 1], [0, 3, 4], epoch=1)
+
+    def run(rank):
+        tp = tps[rank]
+        try:
+            assert agree_membership(tp, "pin") == []
+            got = sync_map(tp, "pin", [], old_map)
+            assert got.epoch == old_map.epoch
+            table = HostSparseTable(layout, opt, n_shards=4, seed=rank)
+            table.pull_or_create(
+                (rank * 7 + 1) + 2 * np.arange(3, dtype=np.int64))
+            migrate_ranges(tp, table, old_map, new_map, "pin", 1)
+            tp.barrier("pin-done")
+        finally:
+            tp.close()
+
+    _run_ranks(run, 2)
+
+    control = {t for t in seen if t.startswith(CONTROL_PREFIXES)}
+    # the exercise itself must have produced the core families
+    for family in ("ctl:member:", "ctl:mapsync:", "migrate:", "barrier:"):
+        assert any(t.startswith(family) for t in control), (
+            f"round exercise produced no {family!r} frames: {sorted(seen)}"
+        )
+    uncovered = sorted(t for t in control if not pkg_model.covers_tag(t))
+    assert not uncovered, (
+        "runtime control tags unknown to analysis/protocol.py "
+        f"(extend the extractor or fix the tag): {uncovered}"
+    )
+
+
+# ---- stat-name drift --------------------------------------------------------
+
+
+def _literal_stat_names(path, fn_names):
+    out = set()
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if (
+            name in fn_names
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+def test_bench_exported_stats_are_bumped_in_package():
+    exported = set()
+    for p in iter_py_files([os.path.join(REPO, "tools")]):
+        exported |= _literal_stat_names(p, {"STAT_GET"})
+    exported = {
+        n for n in exported
+        if n.startswith(("wire.", "membership.", "serve."))
+    }
+    assert exported, "the bench/soak harnesses export no counters?"
+
+    bumped = set()
+    for p in iter_py_files([PKG]):
+        bumped |= _literal_stat_names(
+            p, {"STAT_ADD", "STAT_SET", "STAT_OBSERVE"})
+
+    dead = sorted(exported - bumped)
+    assert not dead, (
+        "bench/soak harnesses export counters no package code bumps "
+        f"(dead gauges): {dead}"
+    )

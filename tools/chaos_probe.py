@@ -459,6 +459,45 @@ def run_serve(args):
 
 
 
+def run_proto_check(args):
+    """Membership-protocol model check (``--proto-check``): explore the
+    bounded elastic state machine (deaths, joins and no-votes injectable
+    at every step) to a fixpoint, require zero invariant violations, and
+    require every deliberately broken protocol variant to be caught on
+    exactly the invariant it breaks — the checker demonstrates it can
+    fail before its clean pass counts.
+
+      python tools/chaos_probe.py --proto-check [--json]
+    """
+    import proto_check
+
+    clean = proto_check.Checker(
+        ranks=min(args.ranks, 3), deaths=1, joins=1, nos=1, max_epochs=2
+    ).run()
+    variants = {}
+    ok = clean.complete and clean.ok
+    for name in sorted(proto_check.BROKEN):
+        inv, _desc, bounds = proto_check.BROKEN[name]
+        res = proto_check.Checker(broken=name, **bounds).run()
+        caught = bool(res.violations) and all(
+            v["invariant"] == inv for v in res.violations
+        )
+        variants[name] = {
+            "invariant": inv,
+            "caught": caught,
+            "states": res.states,
+        }
+        ok = ok and caught
+    report = {
+        "mode": "proto-check",
+        "clean": clean.as_dict(),
+        "broken": variants,
+        "ok": bool(ok),
+    }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if ok else 1
+
+
 def _ici_zipf_day(tmpdir, n_passes, rows, seed):
     """A zipf-keyed day: a small hot set dominates the traffic, the long
     tail shows up once or twice — the distribution the adaptive wire is
@@ -1625,6 +1664,12 @@ def main(argv=None):
                          "adaptive / ablation, gating the >=2x payload cut "
                          "vs fp32, adaptive < bf16, AUC neutrality, and the "
                          "off-ablation bitwise match")
+    ap.add_argument("--proto-check", action="store_true",
+                    help="model-check the bounded elastic membership "
+                         "protocol instead: the clean model must reach a "
+                         "fixpoint with zero invariant violations and "
+                         "every broken variant must be caught on its "
+                         "invariant (tools/proto_check.py)")
     ap.add_argument("--json", action="store_true", help="machine output only")
     args = ap.parse_args(argv)
 
@@ -1632,6 +1677,8 @@ def main(argv=None):
         import native_sanitize
 
         return native_sanitize.main(["--tsan"] if args.tsan else [])
+    if args.proto_check:
+        return run_proto_check(args)
     if args.ici_wire:
         return run_ici_wire(args)
     if args.serve:

@@ -1,0 +1,621 @@
+#!/usr/bin/env python3
+"""proto-check: explicit-state model checker for the elastic membership protocol.
+
+A compact Python model of the control plane that membership.py and
+supervisor.py implement over the wire, exhaustively explored by BFS over
+every interleaving of votes, frame deliveries, decisions, deaths and
+joins within small bounds (<= 3 ranks, bounded epochs, injectable
+failures at every step).  The model is deliberately tiny — its value is
+that the enumeration is *exhaustive* within the bounds, so an invariant
+that holds here holds for every schedule the bounds can express,
+including the adversarial ones a soak run hits once a week.
+
+Correspondence to the real protocol (tags pinned against the
+analysis/protocol.py extraction by tests/test_proto_check.py):
+
+- ``begin``      ~ agree_membership + sync_map   (ctl:member:*, ctl:mapsync:*)
+- ``vote/deliver/decide`` ~ exchange_verdict     (ctl:verdict:*@e*)
+- ``announce_join`` ~ the join handshake         (ctl:join:announce, ctl:join:offer:*)
+- a commit's map install ~ the range handoff     (migrate:*)
+
+State: per-rank installed map (epoch + an ownership carve of NSHARDS
+shard ranges) or None, the set of live processes, at most one active
+round (migrate / shrink / join) with per-rank votes, per-rank *delivered*
+vote snapshots (delivery is per-recipient — the whole point), and
+per-rank decisions.  A death clears the dead rank's installed map (the
+process state dies with it) and may strand its vote undelivered to some
+recipients but not others — exactly the TCP-teardown race PR 16 is
+about.
+
+Invariants, checked on every reachable state / round completion:
+
+- **I1 epoch-monotonic**: a rank never installs a lower epoch than it has.
+- **I2 ownership-partition**: every installed map's ranges partition
+  [0, NSHARDS) — single owner per shard range, no gaps.
+- **I3 epoch-content**: two live ranks holding the same epoch hold the
+  identical map (same-epoch different-fingerprint = split-brain).
+- **I4 verdict-agreement**: no round ends with one rank committing and
+  another recording a *vote*-abort (death-aborts and wedges are
+  distinct outcomes and legal alongside a commit).
+- **I5 join-abort-rollback**: a join round with zero commits leaves every
+  surviving old member at the base epoch and the joiner uninstalled.
+
+``--broken NAME`` swaps in one deliberately wrong protocol variant
+(see BROKEN); each variant violates exactly one invariant, which is how
+the checker itself is tested.  Exit codes: 0 clean fixpoint, 1 any
+violation, 2 state budget exhausted before the fixpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import deque, namedtuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+NSHARDS = 6
+
+# Model-transition -> wire-tag vocabulary the transition abstracts.
+# tests/test_proto_check.py pins every value as covered by the
+# analysis/protocol.py extraction, so the model cannot silently drift
+# from the code it claims to check.
+MODEL_TAGS = {
+    "member": "ctl:member:",
+    "mapsync": "ctl:mapsync:",
+    "verdict": "ctl:verdict:",
+    "join_announce": "ctl:join:announce",
+    "join_offer": "ctl:join:offer:",
+    "migrate": "migrate:",
+}
+
+MapT = namedtuple("MapT", "epoch ranges")  # ranges: ((owner, lo, hi), ...)
+Round = namedtuple(
+    "Round", "kind base_epoch new_map parts joiner votes seen decided"
+)
+State = namedtuple(
+    "State", "alive installed rnd deaths_left joins_left nos_left joiner"
+)
+
+YES, NO = "y", "n"
+COMMIT, ABORT, ABORT_DEATH, WEDGED = "commit", "abort", "abort_death", "wedged"
+
+# name -> (invariant it violates, what the bug is, bounds that reach it)
+BROKEN: Dict[str, Tuple[str, str, Dict[str, int]]] = {
+    "stale_adopt": (
+        "I1",
+        "sync_map adopts the minimum-epoch map among the living instead "
+        "of the maximum, downgrading fresher ranks",
+        {"ranks": 3, "deaths": 1, "joins": 0, "nos": 0, "max_epochs": 2},
+    ),
+    "skip_mapsync": (
+        "I3",
+        "a round's base is the proposer's own installed map, not the max "
+        "among the living — a rank that wedged through the previous "
+        "commit re-mints an epoch number under different contents",
+        {"ranks": 3, "deaths": 1, "joins": 0, "nos": 0, "max_epochs": 2},
+    ),
+    "nonatomic_commit": (
+        "I4",
+        "a peer death mid-round is recorded as a plain vote-abort, so a "
+        "rank that already saw every vote commits while its survivor "
+        "neighbour aborts the same round",
+        {"ranks": 3, "deaths": 1, "joins": 0, "nos": 0, "max_epochs": 2},
+    ),
+    "join_abort_keeps_epoch": (
+        "I5",
+        "an aborted join leaves the proposed map installed on the "
+        "joiner instead of rolling back to 'never a member'",
+        {"ranks": 3, "deaths": 0, "joins": 1, "nos": 1, "max_epochs": 2},
+    ),
+    "double_owner": (
+        "I2",
+        "the shard carve lets the first range bleed one shard into the "
+        "second — two owners for the same range",
+        {"ranks": 3, "deaths": 0, "joins": 0, "nos": 0, "max_epochs": 1},
+    ),
+}
+
+INVARIANTS = {
+    "I1": "epoch-monotonic",
+    "I2": "ownership-partition",
+    "I3": "epoch-content",
+    "I4": "verdict-agreement",
+    "I5": "join-abort-rollback",
+}
+
+
+def carve(order, nshards=NSHARDS, overlap=False):
+    """Contiguous shard carve over ``order`` (an owner sequence)."""
+    n = len(order)
+    per, extra = divmod(nshards, n)
+    ranges = []
+    lo = 0
+    for i, r in enumerate(order):
+        hi = lo + per + (1 if i < extra else 0)
+        ranges.append((r, lo, hi))
+        lo = hi
+    if overlap and len(ranges) >= 2:
+        o, l, h = ranges[0]
+        ranges[0] = (o, l, min(h + 1, nshards))
+    return tuple(ranges)
+
+
+def map_members(m: MapT) -> frozenset:
+    return frozenset(r for r, _, _ in m.ranges)
+
+
+@dataclass
+class CheckResult:
+    states: int
+    transitions: int
+    violations: List[Dict[str, str]]
+    complete: bool
+    bounds: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def as_dict(self) -> Dict:
+        return {
+            "states": self.states,
+            "transitions": self.transitions,
+            "violations": self.violations,
+            "complete": self.complete,
+            "bounds": self.bounds,
+        }
+
+
+class Checker:
+    def __init__(
+        self,
+        ranks: int = 3,
+        deaths: int = 1,
+        joins: int = 1,
+        nos: int = 1,
+        max_epochs: int = 3,
+        nshards: int = NSHARDS,
+        broken: Optional[str] = None,
+        max_states: int = 400_000,
+        max_violations: int = 5,
+    ):
+        if broken is not None and broken not in BROKEN:
+            raise ValueError(f"unknown broken variant: {broken!r}")
+        if ranks < 2:
+            raise ValueError("need at least 2 ranks")
+        self.ranks = ranks
+        self.deaths = deaths
+        self.joins = joins
+        self.nos = nos
+        self.max_epochs = max_epochs
+        self.nshards = nshards
+        self.b = broken
+        self.max_states = max_states
+        self.max_violations = max_violations
+        self.violations: List[Dict[str, str]] = []
+
+    # -- invariant plumbing --------------------------------------------------
+
+    def _violate(self, inv: str, detail: str) -> None:
+        v = {"invariant": inv, "detail": detail}
+        if v not in self.violations:
+            self.violations.append(v)
+
+    def _install(self, installed, r, m):
+        """Install map ``m`` on rank ``r``; None when it would violate I1."""
+        old = installed[r]
+        if old is not None and m.epoch < old.epoch:
+            self._violate(
+                "I1",
+                f"rank {r} installed epoch {old.epoch} would be replaced "
+                f"by epoch {m.epoch}",
+            )
+            return None
+        return tuple(m if i == r else x for i, x in enumerate(installed))
+
+    def _check_state(self, s: State) -> bool:
+        """I2/I3 over the installed maps of live ranks."""
+        ok = True
+        by_epoch: Dict[int, Tuple[int, tuple]] = {}
+        for r in sorted(s.alive):
+            m = s.installed[r]
+            if m is None:
+                continue
+            rs = sorted(m.ranges, key=lambda t: t[1])
+            lo, good = 0, True
+            for _, l, h in rs:
+                if l != lo or h <= l:
+                    good = False
+                    break
+                lo = h
+            if not (good and lo == self.nshards):
+                self._violate(
+                    "I2",
+                    f"rank {r} map e{m.epoch} ranges {m.ranges} do not "
+                    f"partition [0,{self.nshards})",
+                )
+                ok = False
+            prev = by_epoch.get(m.epoch)
+            if prev is not None and prev[1] != m.ranges:
+                self._violate(
+                    "I3",
+                    f"epoch {m.epoch} installed with two contents: rank "
+                    f"{prev[0]} {prev[1]} vs rank {r} {m.ranges}",
+                )
+                ok = False
+            else:
+                by_epoch.setdefault(m.epoch, (r, m.ranges))
+        return ok
+
+    # -- state space ---------------------------------------------------------
+
+    def initial(self) -> State:
+        # with a join budget the last rank starts as a live standby
+        # (announced processes exist before they are members)
+        n_members = self.ranks - (1 if self.joins > 0 else 0)
+        m0 = MapT(0, carve(tuple(range(n_members)), self.nshards))
+        installed = tuple(
+            m0 if r < n_members else None for r in range(self.ranks)
+        )
+        return State(
+            alive=frozenset(range(self.ranks)),
+            installed=installed,
+            rnd=None,
+            deaths_left=self.deaths,
+            joins_left=self.joins,
+            nos_left=self.nos,
+            joiner=None,
+        )
+
+    def _begin_kind(self, s: State, base: MapT, kind: str):
+        mem = map_members(base)
+        live_mem = tuple(r for r in sorted(mem) if r in s.alive)
+        if not live_mem:
+            return None
+        new_epoch = base.epoch + 1
+        if new_epoch > self.max_epochs:
+            return None
+        overlap = self.b == "double_owner"
+        joiner = None
+        if kind == "migrate":
+            # rebalance: membership intact, ownership order rotated
+            if len(live_mem) < 2 or len(live_mem) != len(mem):
+                return None
+            order = [r for r, _, _ in base.ranges]
+            order = order[1:] + order[:1]
+            parts = live_mem
+            new_map = MapT(new_epoch, carve(order, self.nshards, overlap))
+        elif kind == "shrink":
+            if len(live_mem) == len(mem):
+                return None  # nobody to shrink out
+            parts = live_mem
+            new_map = MapT(new_epoch, carve(live_mem, self.nshards, overlap))
+        else:  # join
+            if s.joiner is None or s.joiner not in s.alive:
+                return None
+            joiner = s.joiner
+            order = tuple(sorted(set(live_mem) | {joiner}))
+            parts = tuple(sorted(set(live_mem) | {joiner}))
+            new_map = MapT(new_epoch, carve(order, self.nshards, overlap))
+        # mapsync: lagging participants adopt the base before voting
+        inst = s.installed
+        if self.b == "skip_mapsync":
+            pass  # the bug: nobody syncs, everyone votes from its own map
+        else:
+            for p in live_mem:
+                cur = inst[p]
+                adopt = cur is None or cur.epoch < base.epoch
+                if self.b == "stale_adopt":
+                    adopt = cur is None or cur.epoch != base.epoch
+                if adopt:
+                    nxt = self._install(inst, p, base)
+                    if nxt is None:
+                        return None  # I1 recorded; drop the branch
+                    inst = nxt
+        n = len(parts)
+        rnd = Round(
+            kind=kind,
+            base_epoch=base.epoch,
+            new_map=new_map,
+            parts=parts,
+            joiner=joiner,
+            votes=(None,) * n,
+            seen=(frozenset(),) * n,
+            decided=(None,) * n,
+        )
+        return s._replace(
+            installed=inst,
+            rnd=rnd,
+            joiner=None if kind == "join" else s.joiner,
+        )
+
+    def _begins(self, s: State) -> List[State]:
+        holders = [r for r in sorted(s.alive) if s.installed[r] is not None]
+        if not holders:
+            return []
+        maps = sorted(
+            {s.installed[r] for r in holders},
+            key=lambda m: (m.epoch, m.ranges),
+        )
+        if self.b == "skip_mapsync":
+            bases = maps  # any holder may propose from its own map
+        elif self.b == "stale_adopt":
+            bases = [maps[0]]
+        else:
+            bases = [maps[-1]]
+        out = []
+        for base in bases:
+            for kind in ("migrate", "shrink", "join"):
+                ns = self._begin_kind(s, base, kind)
+                if ns is not None:
+                    out.append(ns)
+        return out
+
+    def _end_round(self, s: State) -> Optional[State]:
+        rnd = s.rnd
+        idx = {p: i for i, p in enumerate(rnd.parts)}
+        decided = [rnd.decided[idx[p]] for p in rnd.parts]
+        commits = decided.count(COMMIT)
+        if commits and ABORT in decided:
+            self._violate(
+                "I4",
+                f"{rnd.kind} round @e{rnd.new_map.epoch}: "
+                f"commit and vote-abort in the same round ({decided})",
+            )
+            return None
+        if rnd.kind == "join" and commits == 0:
+            j = rnd.joiner
+            if j in s.alive and s.installed[j] is not None:
+                self._violate(
+                    "I5",
+                    f"aborted join @e{rnd.new_map.epoch}: joiner {j} still "
+                    f"has a map installed",
+                )
+                return None
+            for p in rnd.parts:
+                if p == j or p not in s.alive:
+                    continue
+                m = s.installed[p]
+                if m is not None and m.epoch != rnd.base_epoch:
+                    self._violate(
+                        "I5",
+                        f"aborted join @e{rnd.new_map.epoch}: rank {p} at "
+                        f"epoch {m.epoch}, expected base {rnd.base_epoch}",
+                    )
+                    return None
+        return s._replace(rnd=None)
+
+    def successors(self, s: State) -> List[State]:
+        out: List[State] = []
+        # -- die: any live process, as long as one map holder survives
+        if s.deaths_left > 0:
+            for r in sorted(s.alive):
+                holders = [
+                    x for x in s.alive
+                    if x != r and s.installed[x] is not None
+                ]
+                if not holders:
+                    continue
+                inst = tuple(
+                    None if i == r else m for i, m in enumerate(s.installed)
+                )
+                out.append(
+                    s._replace(
+                        alive=s.alive - {r},
+                        installed=inst,
+                        deaths_left=s.deaths_left - 1,
+                        joiner=None if s.joiner == r else s.joiner,
+                    )
+                )
+        # -- announce_join: a live standby (no map) asks in
+        if s.joins_left > 0 and s.joiner is None and s.rnd is None:
+            for r in sorted(s.alive):
+                if s.installed[r] is None:
+                    out.append(
+                        s._replace(joins_left=s.joins_left - 1, joiner=r)
+                    )
+        rnd = s.rnd
+        if rnd is None:
+            out.extend(self._begins(s))
+            return out
+        idx = {p: i for i, p in enumerate(rnd.parts)}
+        # -- vote
+        for p in rnd.parts:
+            i = idx[p]
+            if p not in s.alive or rnd.votes[i] is not None:
+                continue
+            v = tuple(
+                YES if j == i else x for j, x in enumerate(rnd.votes)
+            )
+            out.append(s._replace(rnd=rnd._replace(votes=v)))
+            if s.nos_left > 0:
+                v2 = tuple(
+                    NO if j == i else x for j, x in enumerate(rnd.votes)
+                )
+                out.append(
+                    s._replace(
+                        rnd=rnd._replace(votes=v2),
+                        nos_left=s.nos_left - 1,
+                    )
+                )
+        # -- deliver: a recipient's allgather snapshot catches up to the
+        # votes cast so far (frames from the already-dead included: a
+        # final frame may or may not survive the sender's teardown)
+        voted = frozenset(
+            p for p in rnd.parts if rnd.votes[idx[p]] is not None
+        )
+        for p in rnd.parts:
+            i = idx[p]
+            if (
+                p in s.alive
+                and rnd.decided[i] is None
+                and not voted <= rnd.seen[i]
+            ):
+                seen = tuple(
+                    voted | x if j == i else x
+                    for j, x in enumerate(rnd.seen)
+                )
+                out.append(s._replace(rnd=rnd._replace(seen=seen)))
+        # -- decide
+        for p in rnd.parts:
+            i = idx[p]
+            if p not in s.alive or rnd.decided[i] is not None:
+                continue
+            seen = rnd.seen[i]
+            delivered_no = any(rnd.votes[idx[q]] == NO for q in seen)
+            dead_missing = [
+                q for q in rnd.parts if q not in s.alive and q not in seen
+            ]
+            inst = s.installed
+            if delivered_no:
+                verdict = ABORT
+                if (
+                    self.b == "join_abort_keeps_epoch"
+                    and rnd.kind == "join"
+                    and p == rnd.joiner
+                ):
+                    nxt = self._install(inst, p, rnd.new_map)
+                    if nxt is None:
+                        continue
+                    inst = nxt
+            elif seen >= set(rnd.parts):
+                verdict = COMMIT
+                nxt = self._install(inst, p, rnd.new_map)
+                if nxt is None:
+                    continue
+                inst = nxt
+            elif dead_missing:
+                # someone's vote can never arrive: PeerDeadError
+                if rnd.kind == "join" and set(dead_missing) <= {rnd.joiner}:
+                    verdict = ABORT_DEATH
+                else:
+                    verdict = WEDGED
+                if self.b == "nonatomic_commit":
+                    verdict = ABORT
+            else:
+                continue  # still waiting on live voters
+            d = tuple(
+                verdict if j == i else x
+                for j, x in enumerate(rnd.decided)
+            )
+            out.append(
+                s._replace(installed=inst, rnd=rnd._replace(decided=d))
+            )
+        # -- end_round: every live participant has decided
+        if all(
+            p not in s.alive or rnd.decided[idx[p]] is not None
+            for p in rnd.parts
+        ):
+            ns = self._end_round(s)
+            if ns is not None:
+                out.append(ns)
+        return out
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> CheckResult:
+        self.violations = []
+        init = self.initial()
+        self._check_state(init)
+        visited = {init}
+        q = deque([init])
+        transitions = 0
+        complete = True
+        while q:
+            if len(self.violations) >= self.max_violations:
+                complete = False
+                break
+            s = q.popleft()
+            for ns in self.successors(s):
+                transitions += 1
+                if ns in visited:
+                    continue
+                if len(visited) >= self.max_states:
+                    complete = False
+                    q.clear()
+                    break
+                visited.add(ns)
+                if not self._check_state(ns):
+                    continue  # recorded; do not expand a broken state
+                q.append(ns)
+        return CheckResult(
+            states=len(visited),
+            transitions=transitions,
+            violations=list(self.violations),
+            complete=complete,
+            bounds={
+                "ranks": self.ranks,
+                "deaths": self.deaths,
+                "joins": self.joins,
+                "nos": self.nos,
+                "max_epochs": self.max_epochs,
+                "broken": self.b or "",
+            },
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proto_check",
+        description="model-check the elastic membership protocol",
+    )
+    ap.add_argument("--ranks", type=int, default=None)
+    ap.add_argument("--deaths", type=int, default=None)
+    ap.add_argument("--joins", type=int, default=None)
+    ap.add_argument("--nos", type=int, default=None,
+                    help="budget of no-votes (resource refusals)")
+    ap.add_argument("--max-epochs", type=int, default=None)
+    ap.add_argument("--max-states", type=int, default=400_000)
+    ap.add_argument("--broken", default=None, choices=sorted(BROKEN))
+    ap.add_argument("--list-broken", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_broken:
+        for name in sorted(BROKEN):
+            inv, desc, _ = BROKEN[name]
+            print(f"{name:24s} {inv} ({INVARIANTS[inv]}): {desc}")
+        return 0
+
+    defaults = {"ranks": 3, "deaths": 1, "joins": 1, "nos": 1,
+                "max_epochs": 3}
+    if args.broken:
+        defaults.update(BROKEN[args.broken][2])
+    bounds = {
+        k: getattr(args, k) if getattr(args, k) is not None else v
+        for k, v in defaults.items()
+    }
+
+    chk = Checker(broken=args.broken, max_states=args.max_states, **bounds)
+    res = chk.run()
+
+    if args.json:
+        print(json.dumps(res.as_dict(), indent=2))
+    else:
+        tag = args.broken or "-"
+        print(
+            f"proto-check: ranks={bounds['ranks']} deaths={bounds['deaths']} "
+            f"joins={bounds['joins']} nos={bounds['nos']} "
+            f"max_epochs={bounds['max_epochs']} broken={tag}"
+        )
+        fix = "fixpoint" if res.complete else "budget exhausted"
+        print(f"explored {res.states} states / {res.transitions} "
+              f"transitions ({fix})")
+        inv_line = ", ".join(f"{k} {v}" for k, v in INVARIANTS.items())
+        print(f"invariants: {inv_line}")
+        if res.ok:
+            print("OK: no violations")
+        else:
+            for v in res.violations:
+                print(f"VIOLATION {v['invariant']}: {v['detail']}")
+    if not res.ok:
+        return 1
+    if not res.complete:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

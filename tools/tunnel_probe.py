@@ -103,6 +103,8 @@ def main():
         jax.block_until_ready(tiny(s))
     print(f"tiny jit round-trip: {(time.perf_counter()-t0)/20*1e3:8.2f} ms")
 
+    ex.shutdown(wait=True)
+
 
 if __name__ == "__main__":
     main()

@@ -310,4 +310,32 @@ print(f"[12] elastic grow ok: rank {_jl['join_rank']} killed at pass "
       f"{_jl['rejoined_trained_passes']} pass(es), epoch -> "
       f"{_jl['ownership_epoch_after']}, {_jl['membership_joins']} join "
       f"commit(s), digest+AUC bitwise vs fresh fixed-size run")
+# --- 13. protocol verification: incremental lint + model check ----------
+# The incremental lint path (--changed resolves context modules whole-
+# program but reports only on the diff) must stay exit-0, and the
+# bounded membership-protocol model must explore its state space to a
+# fixpoint with zero invariant violations while a deliberately broken
+# variant is caught on its invariant — the checker proves itself able
+# to fail before its clean pass counts for anything.
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "run_lint.py"), "--changed"],
+    capture_output=True, text=True, timeout=300)
+assert r.returncode == 0, f"incremental lint red:\n{r.stdout}{r.stderr}"
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "proto_check.py"),
+     "--ranks", "3", "--deaths", "1", "--joins", "1", "--nos", "1",
+     "--max-epochs", "2", "--json"],
+    capture_output=True, text=True, timeout=300)
+assert r.returncode == 0, f"proto-check red:\n{r.stdout}{r.stderr}"
+_pcl = _json.loads(r.stdout)
+assert _pcl["complete"] and not _pcl["violations"] and _pcl["states"] > 0, _pcl
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "proto_check.py"),
+     "--broken", "nonatomic_commit"],
+    capture_output=True, text=True, timeout=300)
+assert r.returncode == 1 and "VIOLATION I4" in r.stdout, \
+    f"broken protocol variant not caught:\n{r.stdout}{r.stderr}"
+print(f"[13] protocol verification ok: incremental lint clean, model "
+      f"fixpoint {_pcl['states']} states / {_pcl['transitions']} "
+      f"transitions with zero violations, broken variant caught on I4")
 print("VERIFY DRIVE PASS")
