@@ -41,6 +41,7 @@ from .core import ModuleCtx
 _TAG_OPS: Dict[str, Tuple[int, str]] = {
     "send": (1, "out"),
     "recv": (0, "in"),
+    "recv_first": (0, "in"),
     "pending_sources": (0, "in"),
     "allgather": (1, "both"),
     "alltoall": (1, "both"),
@@ -58,7 +59,7 @@ _COLLECTIVE_OPS = frozenset(
 # these heads counts as protocol vocabulary even when it reaches the
 # transport through a helper parameter (e.g. the ctl:load / ctl:jload
 # f-strings handed to the shard-load gather)
-CONTROL_PREFIXES = ("ctl:", "migrate:", "barrier:", "shuffle:")
+CONTROL_PREFIXES = ("ctl:", "migrate:", "barrier:", "shuffle:", "serve:")
 
 STAR = "*"
 

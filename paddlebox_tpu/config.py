@@ -262,6 +262,71 @@ define_flag(
     "manifest trees need False; the trainer-side resume path stays lenient "
     "either way)",
 )
+define_flag(
+    "serve_request_timeout_ms",
+    30000.0,
+    "default per-request deadline for score requests, honored by the "
+    "in-process ScoreServer.score wrapper (a wedged batcher surfaces as a "
+    "typed ServeTimeoutError instead of blocking the caller forever) and "
+    "used as the fleet client's default end-to-end budget",
+)
+define_flag(
+    "serve_shed_queue_depth",
+    256,
+    "load-shedding threshold: a score submit arriving while the batcher "
+    "queue already holds this many requests is refused with the typed "
+    "ServeOverloadError (counted under serve.shed_requests) instead of "
+    "growing an unbounded backlog; 0 disables shedding",
+)
+define_flag(
+    "serve_health_beat_s",
+    0.25,
+    "cadence of each fleet follower's ctl:serve:health gossip beat to the "
+    "front-end client (state, chain position, staleness, queue depth)",
+)
+define_flag(
+    "serve_health_dead_s",
+    2.0,
+    "fleet-view freshness horizon: a follower whose last health beat is "
+    "older than this is treated as dead by the load-balancing client and "
+    "not queried (independent of the transport failure detector)",
+)
+define_flag(
+    "serve_lag_deltas",
+    2,
+    "staleness gossip threshold: a follower whose applied delta_idx "
+    "trails the fleet's freshest (same ownership epoch) by more than this "
+    "many deltas is marked lagging and not queried until it catches up",
+)
+define_flag(
+    "serve_hedge_ms",
+    250.0,
+    "hedged-dispatch trigger: when the primary follower has not answered "
+    "within this budget (p99 about to blow), the fleet client re-sends "
+    "the same request to a second healthy follower and takes the first "
+    "answer; 0 disables hedging",
+)
+define_flag(
+    "serve_client_retries",
+    3,
+    "bounded retry budget of the fleet client: attempts beyond the first "
+    "pick a different follower with exponential backoff before the typed "
+    "ServeRequestError surfaces to the caller",
+)
+define_flag(
+    "serve_client_backoff_s",
+    0.05,
+    "base of the exponential backoff between fleet-client retry attempts "
+    "(doubles per attempt)",
+)
+define_flag(
+    "fleet_stage_dir",
+    "",
+    "host-local staging directory the fleet stager mirrors the published "
+    "base+delta chain into — N followers on the host tail the stage, so "
+    "the origin checkpoint root is fetched once per publish, not N times "
+    "(empty: the FleetStage caller must pass an explicit directory)",
+)
 
 # --- metrics ---
 define_flag("auc_num_buckets", 1_000_000, "AUC wuauc bucket table size (reference box_wrapper.h:61)")

@@ -527,6 +527,57 @@ class TcpTransport:
         streamed protocols (TcpShuffleRouter) build on."""
         return self._take_all([(tag, src)], f"recv(tag={tag!r})", timeout)[0]
 
+    def recv_first(
+        self, tag: str, srcs: List[int], timeout: Optional[float] = None
+    ) -> Tuple[int, bytes]:
+        """Client-mode receive: block until ANY of ``srcs`` has a queued
+        frame under ``tag``; pop and return ``(src, payload)``.
+
+        The serve front-end's primitive: a fleet client listening to N
+        followers takes whichever response/health beat lands first (which
+        is what makes hedged dispatch a pure race, no cancellation
+        protocol). Unlike :meth:`_take_all`, ONE dead source is normal
+        here — the call only fails fast with :class:`PeerDeadError` when
+        EVERY source is membership- or detector-dead, because a fleet
+        with any live follower must keep consuming from it."""
+        srcs = [int(s) for s in srcs]
+        if not srcs:
+            raise ValueError("recv_first needs at least one source rank")
+        budget = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + budget
+        dead_s = float(config.get_flag("transport_peer_dead_s"))
+        with self._cond:
+            while True:
+                for src in srcs:
+                    if (tag, src) in self._inbox:
+                        return src, self._pop_locked(tag, src)
+                now = time.monotonic()
+                dead = sorted(
+                    src for src in set(srcs)
+                    if src != self.rank
+                    and (
+                        src in self._dead
+                        or (
+                            src in self._last_seen
+                            and now - self._last_seen[src] >= dead_s
+                        )
+                    )
+                )
+                if len(dead) == len(set(srcs)):
+                    raise PeerDeadError(
+                        f"rank {self.rank}: recv_first(tag={tag!r}) failed "
+                        f"— every source rank {dead} considered dead",
+                        dead,
+                    )
+                if now >= deadline:
+                    raise TransportTimeout(
+                        f"rank {self.rank}: recv_first(tag={tag!r}) timed "
+                        f"out after {budget:.1f}s with no frame from any "
+                        f"of ranks {sorted(set(srcs))}",
+                        [(tag, s) for s in srcs],
+                    )
+                self._cond.wait(min(0.25, deadline - now))
+
     # ---- failure detector ------------------------------------------------
 
     def _peer_status_locked(self, src: int, now: float) -> str:

@@ -4,13 +4,24 @@ base+delta checkpoint stream (docs/SERVING.md).
 - follower.py       tails latest.json, CRC-verifies, applies delta chains
 - scoring_table.py  atomic-swap versions backing the scorers
 - server.py         compiled forward-only scoring + batched front-end
+- fleet.py          networked fleet: shared staging, health/drain gossip,
+                    load-balancing client with retries + hedging
 """
 
+from paddlebox_tpu.serve.fleet import (
+    FleetClient,
+    FleetFollower,
+    FleetStage,
+    FleetView,
+    ServeRequestError,
+)
 from paddlebox_tpu.serve.follower import Follower
 from paddlebox_tpu.serve.scoring_table import ScoringTable, TableVersion
 from paddlebox_tpu.serve.server import (
     ScoreServer,
     Scorer,
+    ServeOverloadError,
+    ServeTimeoutError,
     table_source,
     version_source,
 )
@@ -21,6 +32,13 @@ __all__ = [
     "TableVersion",
     "Scorer",
     "ScoreServer",
+    "FleetClient",
+    "FleetFollower",
+    "FleetStage",
+    "FleetView",
+    "ServeOverloadError",
+    "ServeRequestError",
+    "ServeTimeoutError",
     "table_source",
     "version_source",
 ]

@@ -150,6 +150,13 @@ class Follower:
         # re-published base under the same date forces a full reload
         self._applied: Optional[Dict[str, Any]] = None
         self._dense_loaded: Optional[str] = None
+        # health-gossip surface: ``reanchoring`` is True from the moment a
+        # mid-day ownership-epoch flip is detected until the re-anchored
+        # chain head is fully applied — the fleet view drains (stops
+        # querying) a follower for exactly that window. Written by the one
+        # poller thread, read by the health-beat thread.
+        self.reanchoring = False
+        self.epoch_reanchors = 0  # per-instance (serve.epoch_reanchors is global)
 
     def _fresh_staging(self) -> HostSparseTable:
         # seed is irrelevant: the staging table only ever load()s published
@@ -162,6 +169,27 @@ class Follower:
 
     def version(self) -> TableVersion:
         return self.scoring.version()
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        """The follower half of a ctl:serve:health gossip beat: chain
+        position, epoch, re-anchor window, and train-to-serve staleness.
+        Reads only atomically-swapped references, so any thread may call
+        it concurrently with the poller."""
+        v = self.version()
+        applied = self._applied
+        return {
+            "delta_idx": v.delta_idx,
+            "date": v.date,
+            "ownership_epoch": 0 if applied is None else int(
+                applied.get("ownership_epoch", 0)),
+            "reanchoring": bool(self.reanchoring),
+            "epoch_reanchors": int(self.epoch_reanchors),
+            "warm": v.params is not None,
+            "staleness_s": (
+                None if v.published_unix is None
+                else max(0.0, time.time() - v.published_unix)
+            ),
+        }
 
     def poll_once(self) -> bool:
         """One watermark poll; returns True when any new state was applied.
@@ -200,6 +228,8 @@ class Follower:
             # trainer rank set changed mid-day: the re-anchored base under
             # the new ownership epoch supersedes the old chain wholesale
             STAT_ADD("serve.epoch_reanchors")
+            self.epoch_reanchors += 1
+            self.reanchoring = True
             logger.info(
                 "follower: ownership epoch %s -> %s mid-day (%s) — "
                 "reloading from the re-anchored base",
@@ -237,6 +267,11 @@ class Follower:
                 self._load_dense(wm)
             self._commit(wm, delta_idx=i, base_crc=base_crc)
             advanced = True
+        if self.reanchoring and self._applied["delta_idx"] == idx:
+            # re-anchored chain head fully applied: the fleet view may
+            # re-admit this follower (a broken link above leaves the flag
+            # up — still draining, correctly, until the chain heals)
+            self.reanchoring = False
         return advanced
 
     def run(self, stop: threading.Event, poll_interval_s: Optional[float] = None) -> None:

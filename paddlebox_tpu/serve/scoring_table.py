@@ -101,6 +101,11 @@ class TableVersion:
             hit = self.keys[pos] == q
             out[hit] = self.rows[pos[hit]]
             n_miss = int(np.count_nonzero(~hit))
+        if n_miss:
+            # the zero-row fallback is intentional but must never be
+            # silent: an all-miss request usually means a key-hash or
+            # lineage bug, and only the counter makes that visible
+            STAT_ADD("serve.key_misses", n_miss)
         return out, n_miss
 
 

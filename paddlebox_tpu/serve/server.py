@@ -54,6 +54,19 @@ from paddlebox_tpu.obs.histogram import Histogram
 from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 
 
+class ServeOverloadError(RuntimeError):
+    """Typed load-shed refusal: the batcher queue is past
+    ``serve_shed_queue_depth``. Clients treat it as retriable — on another
+    follower, not by growing this one's backlog."""
+
+
+class ServeTimeoutError(TimeoutError):
+    """Typed per-request deadline expiry: the batcher did not answer
+    within the caller's budget (``serve_request_timeout_ms`` by default).
+    Subclasses TimeoutError so pre-fleet callers that caught the builtin
+    keep working."""
+
+
 class _RowSource:
     """Adapter giving PassWorkingSet.finalize a host-table interface over
     any pull function (TableVersion lookup, or a live HostSparseTable)."""
@@ -173,7 +186,11 @@ class _Pending:
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self.done.wait(timeout):
-            raise TimeoutError("score request timed out")
+            STAT_ADD("serve.request_timeouts")
+            raise ServeTimeoutError(
+                f"score request timed out after {timeout}s — the batcher "
+                "never answered (wedged scorer or overloaded queue)"
+            )
         if self.error is not None:
             raise self.error
         return self.preds
@@ -221,13 +238,35 @@ class ScoreServer:
     def submit(self, records: Sequence) -> _Pending:
         if not len(records):
             raise ValueError("empty score request")
+        depth = int(config.get_flag("serve_shed_queue_depth"))
+        if depth > 0 and self._q.qsize() >= depth:
+            # shed at admission, not mid-queue: a refused request costs the
+            # client one retry on another follower; an admitted-then-late
+            # one costs its full deadline
+            STAT_ADD("serve.shed_requests")
+            raise ServeOverloadError(
+                f"score queue holds >= {depth} requests "
+                "(serve_shed_queue_depth) — request shed"
+            )
         req = _Pending(list(records))
         self._q.put(req)
         return req
 
-    def score(self, records: Sequence, timeout: float = 60.0) -> np.ndarray:
-        """Synchronous convenience wrapper: submit + wait."""
+    def score(
+        self, records: Sequence, timeout: Optional[float] = None
+    ) -> np.ndarray:
+        """Synchronous convenience wrapper: submit + wait. ``timeout=None``
+        means the ``serve_request_timeout_ms`` flag — a deadline always
+        applies, so a wedged batcher surfaces as ServeTimeoutError instead
+        of blocking the caller forever."""
+        if timeout is None:
+            timeout = float(config.get_flag("serve_request_timeout_ms")) / 1000.0
         return self.submit(records).result(timeout)
+
+    def queue_depth(self) -> int:
+        """Requests waiting for the batcher (the health-gossip load signal
+        and the shed threshold's input)."""
+        return self._q.qsize()
 
     # ---- batcher ---------------------------------------------------------
 

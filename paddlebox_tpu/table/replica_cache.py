@@ -33,7 +33,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from paddlebox_tpu.utils.monitor import STAT_SET
+from paddlebox_tpu.utils.monitor import STAT_GET, STAT_SET
 
 try:  # jax only needed for to_device / device gathers
     import jax
@@ -128,6 +128,10 @@ class ReplicaCache:
             n = len(self._rows)
         STAT_SET("serve.replica_rows", n)
         STAT_SET("serve.replica_mem_mb", n * self.dim * 4 / 1024.0 / 1024.0)
+        # cumulative lookup misses snapshotted at each commit: the delta
+        # between two commits is the miss volume the OUTGOING version
+        # served, which is what a per-version miss-rate dashboard needs
+        STAT_SET("serve.key_misses_at_commit", float(STAT_GET("serve.key_misses")))
 
 
 def pull_cache_value(cache: "jnp.ndarray", ids: "jnp.ndarray") -> "jnp.ndarray":

@@ -128,6 +128,31 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             ownership epoch bitwise (receivers only
                             staged, nothing committed) and a retried join
                             succeeds (FLT008 recovery contract)
+    serve.request_recv      serve/fleet.py  front-end request loop, after a
+                            score-request frame is consumed off the wire
+                            and before it is decoded/handed to the batcher
+                            — an injected failure is a request lost inside
+                            the serving host: counted under
+                            serve.request_recv_errors, the loop keeps
+                            serving, and the CLIENT's bounded-backoff
+                            retry (same request id) succeeds
+    serve.fleet_stage       serve/fleet.py  FleetStage.stage_once, after a
+                            new origin watermark is seen and before any
+                            chain link is mirrored into fleet_stage_dir —
+                            a failure is a torn host-local stage fetch:
+                            the stage watermark never advances (followers
+                            keep serving the last staged version; no
+                            partial version is ever visible) and the next
+                            stage poll retries the same mirror
+                            idempotently
+    serve.drain             serve/fleet.py  drain-command handling, after
+                            a ctl:serve:drain frame is consumed and
+                            before the follower flips its drain state —
+                            a failure drops the command: counted under
+                            serve.drain_errors, the follower stays in its
+                            previous state, and the client re-sends until
+                            the health gossip confirms (drain/admit are
+                            idempotent)
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -183,6 +208,9 @@ KNOWN_SITES = (
     "wire.ici_pack",
     "membership.join_announce",
     "membership.catchup_apply",
+    "serve.request_recv",
+    "serve.fleet_stage",
+    "serve.drain",
 )
 
 

@@ -190,3 +190,87 @@ def test_bench_exported_stats_are_bumped_in_package():
         "bench/soak harnesses export counters no package code bumps "
         f"(dead gauges): {dead}"
     )
+
+
+def test_serve_fleet_runtime_tags_are_covered_by_extraction(pkg_model, tmp_path):
+    """Run a live 1-follower serving fleet — request/response framing,
+    health gossip, and a confirmed drain — and check every control tag it
+    puts on the wire against the static vocabulary, same contract as the
+    membership-round capture above."""
+    import json as _json
+    import time as _time
+
+    from paddlebox_tpu.serve import FleetClient, FleetFollower, Follower
+    from paddlebox_tpu.serve import fleet as fleet_mod
+
+    prev_beat = config.get_flag("serve_health_beat_s")
+    config.set_flag("serve_health_beat_s", 0.05)
+    eps = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+    tps = [TcpTransport(r, eps, timeout=30.0) for r in range(2)]
+    seen = set()
+    lock = threading.Lock()
+    for tp in tps:
+        orig = tp.send
+
+        def send(dst, tag, payload, _orig=orig):
+            with lock:
+                seen.add(tag)
+            return _orig(dst, tag, payload)
+
+        tp.send = send
+
+    class _BoomCfg:
+        batch_size = 8
+
+    class _BoomScorer:
+        # the capture needs frames, not scores: every request answers on
+        # the typed error path, which still rides serve:resp
+        cfg = _BoomCfg()
+
+        def score_records(self, *a, **k):
+            raise RuntimeError("no model in the tag-capture fleet")
+
+    layout = ValueLayout(embedx_dim=2)
+    opt = SparseOptimizerConfig(embedx_threshold=0.0)
+    fol = Follower(str(tmp_path), layout, opt, n_host_shards=2, trainer=None)
+    ff = FleetFollower(tps[1], 0, fol, _BoomScorer(), None)
+    client = FleetClient(tps[0], [1])
+    try:
+        ff.start(poll=False)
+        client.start()
+        # gossip up (ctl:serve:health), then a confirmed drain round trip
+        # (ctl:serve:drain) and one raw request (serve:req -> serve:resp;
+        # the draining follower answers with the typed refusal)
+        deadline = _time.monotonic() + 10
+        while client.view.gossip_state(1) is None and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert client.drain(1, wait_s=10.0) is True
+        tps[0].send(
+            1, fleet_mod._REQ_TAG,
+            _json.dumps({"id": 7, "deadline_ms": 2000.0, "lines": ["x"]}).encode(),
+        )
+        want = {
+            fleet_mod._REQ_TAG, fleet_mod._RESP_TAG,
+            fleet_mod._HEALTH_TAG, fleet_mod._DRAIN_TAG,
+        }
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            with lock:
+                if want <= seen:
+                    break
+            _time.sleep(0.02)
+    finally:
+        client.stop()
+        ff.stop()
+        for tp in tps:
+            tp.close()
+        config.set_flag("serve_health_beat_s", prev_beat)
+
+    with lock:
+        control = {t for t in seen if t.startswith(CONTROL_PREFIXES)}
+    assert want <= seen, f"fleet exercise missed frames: {sorted(seen)}"
+    uncovered = sorted(t for t in control if not pkg_model.covers_tag(t))
+    assert not uncovered, (
+        "runtime serve tags unknown to analysis/protocol.py "
+        f"(extend the extractor or fix the tag): {uncovered}"
+    )

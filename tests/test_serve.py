@@ -406,3 +406,148 @@ def test_staleness_and_served_index_monotonic(stack):
     assert served == sorted(served)  # never regresses
     lat = srv.latency_percentiles()
     assert lat["n"] == 3 and lat["p99_ms"] >= lat["p50_ms"] > 0
+
+
+# ---- fleet satellites: deadlines, gossip, miss counters ---------------------
+
+
+def test_score_timeout_flag_surfaces_typed_error_on_stalled_scorer(stack):
+    """``serve_request_timeout_ms`` is the default deadline for every
+    in-process ``score`` call: a wedged scorer surfaces as the typed
+    ServeTimeoutError (a TimeoutError subclass, so pre-fleet callers keep
+    working) instead of blocking the caller forever."""
+    import time as _time
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.serve import ScoreServer, ServeTimeoutError
+
+    st = stack
+    st.publish_base()
+    st.follower.poll_once()
+
+    real_score = st.scorer.score_records
+
+    def stalled(*a, **k):
+        _time.sleep(0.6)  # wedged longer than the flag deadline below
+        return real_score(*a, **k)
+
+    st.scorer.score_records = stalled
+    srv = ScoreServer(st.follower, st.scorer, SCHEMA)
+    srv.start()
+    prev = config.get_flag("serve_request_timeout_ms")
+    config.set_flag("serve_request_timeout_ms", 100.0)
+    timeouts0 = STAT_GET("serve.request_timeouts")
+    try:
+        with pytest.raises(ServeTimeoutError):
+            srv.score(st.probe[:8])  # no explicit timeout: the flag rules
+        assert STAT_GET("serve.request_timeouts") == timeouts0 + 1
+        # the builtin-compatibility contract
+        with pytest.raises(TimeoutError):
+            srv.score(st.probe[:8])
+    finally:
+        config.set_flag("serve_request_timeout_ms", prev)
+        st.scorer.score_records = real_score
+        srv.stop()
+
+
+def test_fleet_view_drains_reanchoring_follower_and_readmits(stack):
+    """Staleness gossip across a forced epoch re-anchor mid-serve: the
+    fleet view marks the behind-the-flip follower "reanchor" (out of
+    rotation) while a peer already serves the new epoch, readmits it once
+    its own re-anchor lands, and the per-rank staleness log stays monotone
+    per version — (epoch, delta_idx) strictly increases even though the
+    raw delta index regresses at the flip."""
+    from paddlebox_tpu import config
+    from paddlebox_tpu.serve.fleet import FleetView
+
+    st = stack
+    fol = st.follower
+    view = FleetView([1, 2])
+    prev = config.get_flag("serve_health_dead_s")
+    config.set_flag("serve_health_dead_s", 60.0)  # no dead marks in-test
+    try:
+
+        def beat(rank, snap, state):
+            b = dict(snap)
+            b["state"] = state
+            b["queue_depth"] = 0
+            view.observe(rank, b)
+
+        st.publish_base()
+        fol.poll_once()
+        beat(1, fol.health_snapshot(), "ready")
+        beat(2, fol.health_snapshot(), "ready")  # peer at the same position
+        assert view.status(1) == "ready"
+
+        st.publish_delta(lo=120)
+        fol.poll_once()
+        beat(1, fol.health_snapshot(), "ready")
+        beat(2, fol.health_snapshot(), "ready")
+
+        # ---- forced epoch re-anchor mid-serve: the peer (rank 2) has
+        # already applied the re-anchored base; rank 1 still gossips the
+        # old epoch -> drained from rotation without any drain command
+        st.mgr.ownership_epoch = 1
+        st.publish_base()
+        old_snap = dict(fol.health_snapshot())  # rank 1: epoch 0, delta 1
+        fol.poll_once()  # rank 1 re-anchors (epoch 1, delta 0)
+        new_snap = fol.health_snapshot()
+        assert new_snap["ownership_epoch"] == 1 and new_snap["epoch_reanchors"] == 1
+        beat(2, new_snap, "ready")  # the peer leads the flip
+        beat(1, old_snap, "ready")  # rank 1's gossip is still pre-flip
+        assert view.status(1) == "reanchor"  # epoch-behind: not queried
+        assert view.queryable() == [2]
+
+        # a follower announcing reanchoring=True is equally out
+        mid = dict(new_snap)
+        mid["reanchoring"] = True
+        beat(1, mid, "reanchor")
+        assert view.status(1) == "reanchor"
+
+        # ---- re-anchor lands: readmitted
+        beat(1, fol.health_snapshot(), "ready")
+        assert view.status(1) == "ready"
+        assert sorted(view.queryable()) == [1, 2]
+
+        # ---- staleness gauge monotone per version across the flip
+        log = view.staleness_log[1]
+        positions = [(e, d) for e, d, _ in log]
+        assert positions == sorted(positions)
+        assert positions[-1][0] == 1  # the new epoch is in the log
+        assert all(s >= 0 for _, _, s in log)
+        # the raw delta index DID regress at the flip (1 -> 0): only the
+        # (epoch, delta) ordering keeps the gauge monotone
+        deltas = [d for _, d in positions]
+        assert deltas != sorted(deltas)
+    finally:
+        config.set_flag("serve_health_dead_s", prev)
+
+
+def test_zero_row_misses_are_counted_and_exported(stack):
+    """Satellite for the silent-miss fix: a lookup over keys the published
+    model never saw still scores (zero rows), but bumps ``serve.key_misses``
+    by the exact miss count, and the next commit snapshots the cumulative
+    counter into ``serve.key_misses_at_commit``."""
+    st = stack
+    fol = st.follower
+    st.publish_base()
+    fol.poll_once()
+    v = fol.version()
+
+    misses0 = STAT_GET("serve.key_misses")
+    bogus = np.array([2**63 + 5, 2**63 + 7, 2**63 + 11], dtype=np.uint64)
+    rows, n_miss = v.lookup_rows(bogus)
+    assert n_miss == 3 and not rows.any()
+    assert STAT_GET("serve.key_misses") == misses0 + 3
+
+    # a mixed batch counts only the genuinely missing keys
+    known = v.keys[:2]
+    mixed = np.concatenate([known, bogus[:1]])
+    _, n_miss2 = v.lookup_rows(mixed)
+    assert n_miss2 == 1
+    assert STAT_GET("serve.key_misses") == misses0 + 4
+
+    # the next commit exports the cumulative counter as a gauge
+    st.publish_delta(lo=120)
+    fol.poll_once()
+    assert STAT_GET("serve.key_misses_at_commit") == STAT_GET("serve.key_misses")

@@ -1,0 +1,349 @@
+"""Networked serving fleet tests: framing, gossip, drain, and the FLT008
+recovery contracts for the three serve fault sites.
+
+The contracts pinned here (mirrors of what chaos_probe --serve-fleet
+drives at soak scale):
+
+- ``serve.request_recv``: a request frame lost after transport delivery is
+  counted and the CLIENT's retry/hedge budget absorbs it — the caller
+  still gets a bitwise-correct answer.
+- ``serve.fleet_stage``: a torn stage fetch never advances the stage
+  watermark, so followers can never observe a partial version; the retry
+  is idempotent and catches up.
+- ``serve.drain``: a dropped drain command is counted and the client
+  re-sends until the follower's own gossip confirms the state — drain
+  and admit are idempotent end to end.
+
+Plus the degradation tentpole pieces that don't need a soak: typed
+load-shedding past ``serve_shed_queue_depth``, and hedged re-dispatch
+rescuing a silent follower inside the request deadline.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import serve_soak as ss
+
+from paddlebox_tpu import config
+from paddlebox_tpu.parallel.transport import TcpTransport
+from paddlebox_tpu.serve import (
+    FleetClient,
+    FleetFollower,
+    FleetStage,
+    Follower,
+    Scorer,
+    ServeOverloadError,
+    table_source,
+)
+from paddlebox_tpu.train import read_watermark
+from paddlebox_tpu.utils.faultinject import (
+    InjectedFault,
+    fail_always,
+    fail_once,
+    inject,
+)
+from paddlebox_tpu.utils.monitor import STAT_GET
+
+_FAST = {
+    "transport_heartbeat_s": 0.05,
+    "transport_backoff_s": 0.01,
+    "serve_health_beat_s": 0.05,
+    "serve_health_dead_s": 1.0,
+    "serve_hedge_ms": 100.0,
+    "serve_client_retries": 4,
+    "serve_client_backoff_s": 0.02,
+    "serve_request_timeout_ms": 15000.0,
+}
+
+
+@pytest.fixture(autouse=True)
+def _fast_fleet_flags():
+    prev = {n: config.get_flag(n) for n in _FAST}
+    for n, v in _FAST.items():
+        config.set_flag(n, v)
+    yield
+    for n, v in prev.items():
+        config.set_flag(n, v)
+
+
+class MiniFleet:
+    """A 1-host fleet for tests: producer + shared stage + N networked
+    followers (each with its OWN Scorer so one can be stalled) + client."""
+
+    def __init__(self, tmp, n_followers=2):
+        self.tmp = str(tmp)
+        self.root = os.path.join(self.tmp, "ckpt")
+        self.stage_dir = os.path.join(self.tmp, "stage")
+        self.rng = np.random.default_rng(0)
+        self.table, self.ds, self.cfg, self.trainer, self.mgr = ss.make_stack(
+            self.root
+        )
+        self.pass0 = os.path.join(self.tmp, "pass-0.txt")
+        self.lines = ss.write_pass_file(self.rng, self.pass0, 96, 1)
+        self.probe_lines = self.lines[:16]
+        self.n_passes = 0
+
+        self.stage = FleetStage(self.root, self.stage_dir)
+        self.stage_stop = threading.Event()
+        self.stage_thread = threading.Thread(
+            target=self.stage.run, args=(self.stage_stop, 0.02), daemon=True
+        )
+        self.stage_thread.start()
+
+        eps = [f"127.0.0.1:{p}" for p in ss._free_ports(n_followers + 1)]
+        self.client_tp = TcpTransport(0, eps, timeout=30.0)
+        self.ranks = list(range(1, n_followers + 1))
+        self.fleet = {}
+        for r in self.ranks:
+            tp = TcpTransport(r, eps, timeout=30.0)
+            fol, scorer = ss.make_follower(self.stage_dir, self.cfg)
+            ff = FleetFollower(tp, 0, fol, scorer, ss.SCHEMA, poll_interval_s=0.02)
+            ff.start()
+            self.fleet[r] = (tp, ff)
+        self.client = FleetClient(self.client_tp, self.ranks, ss.SCHEMA)
+        self.client.start()
+
+    def publish(self):
+        """Train one pass and publish (base first, deltas after)."""
+        path = self.pass0
+        if self.n_passes:
+            path = os.path.join(self.tmp, f"pass-{self.n_passes}.txt")
+            ss.write_pass_file(self.rng, path, 96, 1 + self.n_passes * 120)
+        self.ds.set_filelist([path])
+        self.ds.load_into_memory()
+        self.ds.begin_pass(round_to=8)
+        self.trainer.train_pass(self.ds)
+        self.ds.end_pass(self.trainer.trained_table_device())
+        self.table.drain_pending()
+        if self.n_passes == 0:
+            self.mgr.save_base(ss.DATE, self.table, self.trainer)
+        else:
+            self.mgr.save_delta(ss.DATE, self.table, self.trainer)
+        self.n_passes += 1
+
+    def reference(self):
+        """Trainer-direct probe scores (the bitwise-parity truth)."""
+        _tp, ff = self.fleet[self.ranks[0]]
+        probe = [ss.parse_line(ln, ss.SCHEMA) for ln in self.probe_lines]
+        return ff.server.scorer.score_records(
+            probe, ss.SCHEMA, table_source(ss.LAYOUT, self.table),
+            self.trainer.params, self.trainer.opt_state,
+        )
+
+    def wait_queryable(self, want, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if set(self.client.view.queryable()) >= set(want):
+                return
+            time.sleep(0.02)
+        raise AssertionError(
+            f"fleet never became queryable: want {sorted(want)}, "
+            f"view {self.client.view.snapshot()}"
+        )
+
+    def close(self):
+        self.client.stop()
+        for tp, ff in self.fleet.values():
+            ff.stop()
+            tp.close()
+        self.client_tp.close()
+        self.stage_stop.set()
+        self.stage_thread.join(timeout=10)
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    mf = MiniFleet(tmp_path)
+    yield mf
+    mf.close()
+
+
+# ---- FLT008 recovery contracts ---------------------------------------------
+
+
+def test_request_recv_fault_absorbed_by_client_retry(fleet):
+    """Fault site ``serve.request_recv``: the frame is consumed off the
+    wire and then lost — counted, and the client's retry/hedge budget gets
+    the caller a bitwise-correct answer anyway."""
+    fleet.publish()
+    fleet.wait_queryable(fleet.ranks)
+    ref = fleet.reference()
+    errors0 = STAT_GET("serve.request_recv_errors")
+
+    with inject(fail_once("serve.request_recv")) as plan:
+        preds, meta = fleet.client.score_lines(fleet.probe_lines[:8], timeout=15)
+    assert plan.failures("serve.request_recv") == 1
+    assert STAT_GET("serve.request_recv_errors") == errors0 + 1
+    np.testing.assert_array_equal(preds, ref[:8])
+    assert meta["delta_idx"] == 0
+
+
+def test_fleet_stage_fault_never_surfaces_partial_version(tmp_path):
+    """Fault site ``serve.fleet_stage``: a torn stage fetch leaves the
+    stage watermark unwritten (followers keep their last version), and the
+    idempotent retry catches the stage up bitwise."""
+    root = os.path.join(str(tmp_path), "ckpt")
+    stage_dir = os.path.join(str(tmp_path), "stage")
+    rng = np.random.default_rng(0)
+    table, ds, cfg, trainer, mgr = ss.make_stack(root)
+    path = os.path.join(str(tmp_path), "p0.txt")
+    lines = ss.write_pass_file(rng, path, 96, 1)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=8)
+    trainer.train_pass(ds)
+    ds.end_pass(trainer.trained_table_device())
+    table.drain_pending()
+    mgr.save_base(ss.DATE, table, trainer)
+
+    stage = FleetStage(root, stage_dir)
+    with inject(fail_always("serve.fleet_stage", times=2)) as plan:
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                stage.stage_once()
+            # the torn fetch never advanced the stage watermark: a
+            # follower tailing the stage sees NO version, not a partial one
+            assert read_watermark(stage_dir) is None
+        assert stage.stage_once() is True  # healed retry is idempotent
+    assert plan.failures("serve.fleet_stage") == 2
+    assert read_watermark(stage_dir) == read_watermark(root)
+
+    # and the staged chain actually serves: bitwise parity vs the trainer
+    fol, scorer = ss.make_follower(stage_dir, cfg)
+    assert fol.poll_once() is True
+    probe = [ss.parse_line(ln, ss.SCHEMA) for ln in lines[:8]]
+    from paddlebox_tpu.serve import version_source
+
+    v = fol.version()
+    got = scorer.score_records(
+        probe, ss.SCHEMA, version_source(ss.LAYOUT, v), v.params, v.opt_state
+    )
+    ref = scorer.score_records(
+        probe, ss.SCHEMA, table_source(ss.LAYOUT, table),
+        trainer.params, trainer.opt_state,
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_drain_fault_client_resends_until_gossip_confirms(fleet):
+    """Fault site ``serve.drain``: the first drain command is consumed and
+    dropped — counted — and the client's re-send loop converges: the
+    follower drains (refuses new work), the view stops routing to it, and
+    admit restores it. Both commands are idempotent."""
+    fleet.publish()
+    fleet.wait_queryable(fleet.ranks)
+    victim = fleet.ranks[0]
+    errors0 = STAT_GET("serve.drain_errors")
+
+    with inject(fail_once("serve.drain")) as plan:
+        assert fleet.client.drain(victim, wait_s=10.0) is True
+    assert plan.failures("serve.drain") == 1
+    assert STAT_GET("serve.drain_errors") == errors0 + 1
+    assert fleet.client.view.status(victim) in ("draining", "drained")
+    _tp, ff = fleet.fleet[victim]
+    assert ff.draining
+
+    # while drained, requests are served — by the OTHER follower only
+    for _ in range(4):
+        _preds, meta = fleet.client.score_lines(fleet.probe_lines[:8], timeout=15)
+        assert meta["src"] != victim
+
+    # drain is idempotent; admit restores rotation
+    assert fleet.client.drain(victim, wait_s=10.0) is True
+    assert fleet.client.admit(victim, wait_s=10.0) is True
+    assert not ff.draining
+    fleet.wait_queryable(fleet.ranks)
+
+
+# ---- graceful degradation --------------------------------------------------
+
+
+def test_overload_shed_is_typed_and_counted(tmp_path):
+    """Past ``serve_shed_queue_depth`` the in-process front-end refuses
+    with the typed ServeOverloadError (retriable on another follower)
+    instead of growing the backlog, and counts every shed."""
+    root = os.path.join(str(tmp_path), "ckpt")
+    rng = np.random.default_rng(0)
+    table, ds, cfg, trainer, mgr = ss.make_stack(root)
+    path = os.path.join(str(tmp_path), "p0.txt")
+    lines = ss.write_pass_file(rng, path, 96, 1)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    ds.begin_pass(round_to=8)
+    trainer.train_pass(ds)
+    ds.end_pass(trainer.trained_table_device())
+    table.drain_pending()
+    mgr.save_base(ss.DATE, table, trainer)
+    fol, scorer = ss.make_follower(root, cfg)
+    fol.poll_once()
+    probe = [ss.parse_line(ln, ss.SCHEMA) for ln in lines[:8]]
+
+    from paddlebox_tpu.serve import ScoreServer
+
+    real = scorer.score_records
+
+    def stalled(*a, **k):
+        time.sleep(0.3)
+        return real(*a, **k)
+
+    scorer.score_records = stalled
+    srv = ScoreServer(fol, scorer, ss.SCHEMA)
+    srv.start()
+    prev = config.get_flag("serve_shed_queue_depth")
+    config.set_flag("serve_shed_queue_depth", 1)
+    shed0 = STAT_GET("serve.shed_requests")
+    try:
+        pendings = [srv.submit(probe)]  # soaks up the batcher
+        time.sleep(0.05)
+        pendings.append(srv.submit(probe))  # sits in the queue (depth 1)
+        with pytest.raises(ServeOverloadError):
+            for _ in range(8):
+                pendings.append(srv.submit(probe))
+        assert STAT_GET("serve.shed_requests") > shed0
+        for p in pendings:  # the admitted work still completes
+            assert p.result(10.0).shape == (8,)
+    finally:
+        config.set_flag("serve_shed_queue_depth", prev)
+        scorer.score_records = real
+        srv.stop()
+
+
+def test_hedge_rescues_silent_follower(fleet):
+    """A follower that accepts a request and then stalls past
+    ``serve_hedge_ms`` does not consume the whole deadline: the client
+    re-dispatches to the second follower and the first answer wins."""
+    fleet.publish()
+    fleet.wait_queryable(fleet.ranks)
+    ref = fleet.reference()
+
+    slow_rank = fleet.ranks[0]
+    _tp, slow_ff = fleet.fleet[slow_rank]
+    real = slow_ff.server.scorer.score_records
+
+    def stalled(*a, **k):
+        time.sleep(1.5)  # >> serve_hedge_ms (100ms)
+        return real(*a, **k)
+
+    slow_ff.server.scorer.score_records = stalled
+    hedges0 = STAT_GET("serve.hedges")
+    try:
+        # round-robin guarantees the slow rank is primary within 2 requests
+        t0 = time.monotonic()
+        for _ in range(2):
+            preds, _meta = fleet.client.score_lines(fleet.probe_lines[:8], timeout=15)
+            np.testing.assert_array_equal(preds, ref[:8])
+        assert STAT_GET("serve.hedges") > hedges0
+        # the hedge answered well inside the stall, not after it
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        slow_ff.server.scorer.score_records = real

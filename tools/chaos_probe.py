@@ -458,6 +458,54 @@ def run_serve(args):
     return 0 if ok else 1
 
 
+def run_serve_fleet(args):
+    """Fleet churn soak under injected serve faults (``--serve-fleet``):
+    the full networked day — N followers over one shared stage, follower
+    kill + drain/admit + rejoin during concurrent publishes — run with
+    faults firing at all three serve sites (a lost request, a torn stage
+    fetch, a dropped drain command). The acceptance gate is unchanged:
+    zero client-visible failures, bitwise parity live and offline, drain
+    honored, single disk fetch per publish — the client's retry/hedge
+    budget and the stager's idempotent retry must absorb every fault.
+
+      JAX_PLATFORMS=cpu python tools/chaos_probe.py --serve-fleet [--json]
+    """
+    import serve_soak
+
+    from paddlebox_tpu.utils.faultinject import fail_nth, inject
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        with inject(
+            fail_nth("serve.request_recv", 5),
+            fail_nth("serve.request_recv", 40),
+            fail_nth("serve.fleet_stage", 2),
+            fail_nth("serve.drain", 1),
+        ) as plan:
+            report = serve_soak.run_fleet_soak(
+                tmpdir,
+                n_followers=max(2, args.ranks - 1),
+                # the churn script (kill@2, drain@3, admit+rejoin@4) needs
+                # at least one clean publish after the rejoin
+                passes=max(args.passes, 6),
+                rows=args.rows,
+                qps=30.0,
+                probe_n=32,
+            )
+    faults = {
+        "serve.request_recv": plan.failures("serve.request_recv"),
+        "serve.fleet_stage": plan.failures("serve.fleet_stage"),
+        "serve.drain": plan.failures("serve.drain"),
+    }
+    ok = report["ok"] and all(n > 0 for n in faults.values())
+    report = {
+        "mode": "serve-fleet",
+        "faults_fired": faults,
+        "soak": report,
+        "ok": bool(ok),
+    }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if ok else 1
+
 
 def run_proto_check(args):
     """Membership-protocol model check (``--proto-check``): explore the
@@ -1658,6 +1706,13 @@ def main(argv=None):
                          "skip a corrupted published delta with an alarm, "
                          "keep serving the last good version bitwise, and "
                          "catch up once the delta is repaired")
+    ap.add_argument("--serve-fleet", action="store_true",
+                    help="fleet churn soak under injected serve faults: "
+                         "the networked serving day (kill + drain/admit + "
+                         "rejoin over a shared stage) with lost requests, "
+                         "a torn stage fetch, and a dropped drain command "
+                         "injected — zero client-visible failures and "
+                         "bitwise parity must survive all of it")
     ap.add_argument("--ici-wire", action="store_true",
                     help="A/B the frequency-adaptive ICI wire: mesh-trainer "
                          "days over one zipf-keyed day in fp32 / bf16 / "
@@ -1681,6 +1736,8 @@ def main(argv=None):
         return run_proto_check(args)
     if args.ici_wire:
         return run_ici_wire(args)
+    if args.serve_fleet:
+        return run_serve_fleet(args)
     if args.serve:
         return run_serve(args)
     if args.wedge_backend:

@@ -18,12 +18,20 @@ The report carries p50/p99 score latency, achieved QPS, per-version
 train-to-serve staleness, and the parity verdict — the acceptance gate of
 the serving tentpole (docs/SERVING.md).
 
-Run:  python tools/serve_soak.py --passes 6 --qps 40 [--json report.json]
+``--fleet N`` runs the networked variant instead: N followers behind PBTX
+framing share one staged download (FleetStage), a FleetClient load-balances
+with retries + hedging, and the day includes follower kill, drain/admit,
+and rejoin while publishes keep landing — the fault-tolerant-serving
+acceptance gate (zero client-visible failures, bitwise parity live and
+offline, single disk fetch per publish independent of N).
+
+Run:  python tools/serve_soak.py --passes 6 --qps 40 [--fleet 3] [--json report.json]
 Exit: 0 on full parity + no request errors, 1 otherwise.
 """
 import argparse
 import json
 import os
+import socket
 import sys
 import tempfile
 import threading
@@ -37,11 +45,23 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 import optax
 
+from paddlebox_tpu import config
 from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
 from paddlebox_tpu.utils.fs import atomic_write
 from paddlebox_tpu.data.parser import parse_line
 from paddlebox_tpu.models import DeepFM
-from paddlebox_tpu.serve import Follower, ScoreServer, Scorer, table_source, version_source
+from paddlebox_tpu.parallel.transport import TcpTransport
+from paddlebox_tpu.serve import (
+    Follower,
+    FleetClient,
+    FleetFollower,
+    FleetStage,
+    ScoreServer,
+    Scorer,
+    ServeRequestError,
+    table_source,
+    version_source,
+)
 from paddlebox_tpu.table import HostSparseTable, SparseOptimizerConfig, ValueLayout
 from paddlebox_tpu.train import CheckpointManager, CTRTrainer, TrainStepConfig
 from paddlebox_tpu.utils.monitor import STAT_GET
@@ -242,19 +262,325 @@ def run_soak(workdir, passes=6, rows=400, qps=40.0, probe_n=32):
     return report
 
 
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+_FLEET_FLAGS = {
+    # soak-speed gossip/transport so churn converges inside seconds
+    "transport_heartbeat_s": 0.05,
+    "transport_backoff_s": 0.01,
+    "serve_health_beat_s": 0.05,
+    "serve_health_dead_s": 0.5,
+    "serve_hedge_ms": 150.0,
+    "serve_client_retries": 4,
+    "serve_client_backoff_s": 0.02,
+    "serve_request_timeout_ms": 10000.0,
+}
+
+
+def run_fleet_soak(workdir, n_followers=3, passes=6, rows=400, qps=30.0, probe_n=32):
+    """The networked day with churn: kill follower N after pass 2, drain
+    follower 2 after pass 3 (admit after pass 4), rejoin N as a new
+    incarnation after pass 4 — all while publishes land and the client
+    keeps scoring. Returns the report dict (``ok`` is the gate)."""
+    root = os.path.join(workdir, "ckpt")
+    stage_dir = os.path.join(workdir, "stage")
+    rng = np.random.default_rng(0)
+    prev_flags = {n: config.get_flag(n) for n in _FLEET_FLAGS}
+    for n, v in _FLEET_FLAGS.items():
+        config.set_flag(n, v)
+    try:
+        return _run_fleet_soak(
+            workdir, root, stage_dir, rng, n_followers, passes, rows, qps, probe_n
+        )
+    finally:
+        for n, v in prev_flags.items():
+            config.set_flag(n, v)
+
+
+def _run_fleet_soak(workdir, root, stage_dir, rng, n_followers, passes, rows, qps, probe_n):
+    table, ds, cfg, trainer, mgr = make_stack(root)
+    model = DeepFM(S, LAYOUT.pull_width, LAYOUT.embedx_dim, hidden=(8,))
+    scorer = Scorer(model, cfg)  # ONE compiled program serves the whole fleet
+
+    pass0_path = os.path.join(workdir, "pass-0.txt")
+    pass0_lines = write_pass_file(rng, pass0_path, rows, 1)
+    probe_lines = pass0_lines[:probe_n]
+    probe = [parse_line(ln, SCHEMA) for ln in probe_lines]
+
+    def run_pass(lo, path=None):
+        if path is None:
+            path = os.path.join(workdir, f"pass-{lo}.txt")
+            write_pass_file(rng, path, rows, lo)
+        ds.set_filelist([path])
+        ds.load_into_memory()
+        ds.begin_pass(round_to=8)
+        trainer.train_pass(ds)
+        ds.end_pass(trainer.trained_table_device())
+        table.drain_pending()
+
+    reference = {}
+
+    def capture_reference(idx):
+        reference[idx] = scorer.score_records(
+            probe, SCHEMA, table_source(LAYOUT, table), trainer.params, trainer.opt_state
+        )
+
+    # ---- transports: rank 0 = client, 1..N = followers -------------------
+    eps = [f"127.0.0.1:{p}" for p in _free_ports(n_followers + 1)]
+    client_tp = TcpTransport(0, eps, timeout=30.0)
+    follower_ranks = list(range(1, n_followers + 1))
+
+    # one stager mirrors origin -> stage for the WHOLE host
+    stage = FleetStage(root, stage_dir)
+    stage_stop = threading.Event()
+    stage_thread = threading.Thread(
+        target=stage.run, args=(stage_stop, 0.02), daemon=True
+    )
+    stage_thread.start()
+
+    # per-(incarnation) committed-version capture for the offline parity sweep
+    captured = []  # (name, follower, {delta_idx: version})
+
+    def make_fleet_follower(rank, name):
+        tp = TcpTransport(rank, eps, timeout=30.0)
+        tr = CTRTrainer(DeepFM(S, LAYOUT.pull_width, LAYOUT.embedx_dim, hidden=(8,)),
+                        cfg, dense_opt=optax.adam(1e-2))
+        fol = Follower(stage_dir, LAYOUT, OPT, n_host_shards=4, trainer=tr)
+        caps = {}
+        orig_commit = fol.scoring.commit
+
+        def commit_and_capture(*a, **k):
+            v = orig_commit(*a, **k)
+            caps[v.delta_idx] = v
+            return v
+
+        fol.scoring.commit = commit_and_capture
+        captured.append((name, fol, caps))
+        ff = FleetFollower(tp, 0, fol, scorer, SCHEMA, poll_interval_s=0.02)
+        ff.start()
+        return tp, ff
+
+    fleet = {}  # rank -> (tp, ff); current incarnation only
+    for r in follower_ranks:
+        fleet[r] = make_fleet_follower(r, f"rank{r}")
+
+    client = FleetClient(client_tp, follower_ranks, SCHEMA)
+    client.start()
+
+    # ---- load generator --------------------------------------------------
+    stop_load = threading.Event()
+    client_errors = []
+    live_results = []  # (t_sent, src, delta_idx, k, preds)
+    requests_sent = [0]
+
+    def load_gen():
+        period = 2.0 / qps  # two generator threads share the target rate
+        while not stop_load.is_set():
+            t0 = time.perf_counter()
+            if client.view.queryable():
+                k = int(rng.integers(0, probe_n - 8))
+                t_sent = time.monotonic()
+                try:
+                    preds, meta = client.score_lines(probe_lines[k : k + 8], timeout=10)
+                    requests_sent[0] += 1
+                    live_results.append(
+                        (t_sent, meta["src"], meta["delta_idx"], k, preds)
+                    )
+                except ServeRequestError as e:
+                    client_errors.append(repr(e))
+                except Exception as e:  # noqa: BLE001 — soak must report, not die
+                    client_errors.append(repr(e))
+            left = period - (time.perf_counter() - t0)
+            if left > 0:
+                time.sleep(left)
+
+    clients = [threading.Thread(target=load_gen, daemon=True) for _ in range(2)]
+    t_start = time.perf_counter()
+    for c in clients:
+        c.start()
+
+    # ---- the training day with churn ------------------------------------
+    kill_rank = follower_ranks[-1]
+    drain_rank = follower_ranks[1] if n_followers > 1 else follower_ranks[0]
+    timeline = []
+    drain_window = [None, None]  # (confirmed_at, admit_sent_at) monotonic
+    for p in range(passes):
+        lo = 1 + p * 120
+        run_pass(lo, path=pass0_path if p == 0 else None)
+        if p == 0:
+            mgr.save_base(DATE, table, trainer)
+        else:
+            mgr.save_delta(DATE, table, trainer)
+        capture_reference(p)
+        time.sleep(0.3)  # let the stage + fleet chase the watermark
+        if p == 2:
+            tp, ff = fleet.pop(kill_rank)
+            tp.close()  # abrupt: in-flight requests to it are lost
+            ff.stop()
+            timeline.append({"pass": p, "event": f"killed rank {kill_rank}"})
+        elif p == 3:
+            ok = client.drain(drain_rank, wait_s=10.0)
+            drain_window[0] = time.monotonic()
+            timeline.append(
+                {"pass": p, "event": f"drained rank {drain_rank}", "confirmed": ok}
+            )
+        elif p == 4:
+            drain_window[1] = time.monotonic()
+            ok = client.admit(drain_rank, wait_s=10.0)
+            timeline.append(
+                {"pass": p, "event": f"admitted rank {drain_rank}", "confirmed": ok}
+            )
+            fleet[kill_rank] = make_fleet_follower(kill_rank, f"rank{kill_rank}b")
+            timeline.append({"pass": p, "event": f"rejoined rank {kill_rank}"})
+
+    # ---- convergence: every live follower reaches the head ---------------
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if all(
+            ff.follower.version().delta_idx == passes - 1
+            for _, ff in fleet.values()
+        ):
+            break
+        time.sleep(0.05)
+    time.sleep(0.5)  # a few more serves against the final fleet
+    stop_load.set()
+    for c in clients:
+        c.join(timeout=10)
+    elapsed = time.perf_counter() - t_start
+    fleet_view = client.view.snapshot()
+    staleness_log = {r: list(v) for r, v in client.view.staleness_log.items()}
+    client.stop()
+    for tp, ff in fleet.values():
+        ff.stop()
+        tp.close()
+    client_tp.close()
+    stage_stop.set()
+    stage_thread.join(timeout=10)
+
+    # ---- live parity: every answered request must match the reference ----
+    live_parity = {"checked": 0, "mismatched": 0, "unknown_version": 0}
+    for _t, _src, idx, k, preds in live_results:
+        ref = reference.get(idx)
+        if ref is None:
+            live_parity["unknown_version"] += 1
+            continue
+        live_parity["checked"] += 1
+        if not np.array_equal(preds, ref[k : k + 8]):
+            live_parity["mismatched"] += 1
+
+    # ---- offline parity: every version any incarnation committed ---------
+    offline = {"checked": 0, "mismatched": [], "heads": {}, "cold_commits": 0}
+    for name, _fol, caps in captured:
+        offline["heads"][name] = max(caps) if caps else None
+        for idx, v in sorted(caps.items()):
+            if v.params is None:
+                # a mid-catch-up commit on a fresh joiner: dense pairs with
+                # the chain head, so these are cold (never queryable) and
+                # carry no dense to score with
+                offline["cold_commits"] += 1
+                continue
+            got = scorer.score_records(
+                probe, SCHEMA, version_source(LAYOUT, v), v.params, v.opt_state
+            )
+            offline["checked"] += 1
+            if not np.array_equal(got, reference[idx]):
+                offline["mismatched"].append((name, idx))
+
+    # ---- drain honored: nothing SENT inside the window served by drain_rank
+    drained_served = 0
+    if drain_window[0] is not None and drain_window[1] is not None:
+        # +0.1s grace: finish-in-flight means a request dispatched just
+        # before confirmation may legitimately still answer from the rank
+        drained_served = sum(
+            1 for t, src, *_ in live_results
+            if src == drain_rank and drain_window[0] + 0.1 < t < drain_window[1]
+        )
+
+    lat = client.latency_percentiles()
+    achieved_qps = requests_sent[0] / elapsed if elapsed > 0 else 0.0
+    rejoined_head = max(
+        (max(caps) for name, _f, caps in captured if name.endswith("b") and caps),
+        default=None,
+    )
+    report = {
+        "fleet": n_followers,
+        "passes": passes,
+        "elapsed_s": round(elapsed, 3),
+        "requests": requests_sent[0],
+        "achieved_qps": round(achieved_qps, 2),
+        "latency": lat,
+        "client_errors": client_errors[:5],
+        "retries": STAT_GET("serve.client_retries"),
+        "hedges": STAT_GET("serve.hedges"),
+        "hedge_wasted": STAT_GET("serve.hedge_wasted"),
+        "shed": STAT_GET("serve.shed_requests"),
+        "late_responses": STAT_GET("serve.late_responses"),
+        "request_recv_faults": STAT_GET("serve.request_recv_errors"),
+        "drains": STAT_GET("serve.drains"),
+        "stage_fetches": STAT_GET("serve.fleet_stage_fetches"),
+        "timeline": timeline,
+        "fleet_view_at_end": {str(r): s for r, s in fleet_view.items()},
+        "staleness_log": {
+            str(r): [
+                {"epoch": e, "delta_idx": d, "staleness_s": round(s, 4)}
+                for e, d, s in log
+            ]
+            for r, log in staleness_log.items()
+        },
+        "live_parity": live_parity,
+        "offline_parity": {
+            "checked": offline["checked"],
+            "mismatched": offline["mismatched"],
+            "heads": offline["heads"],
+        },
+        "drained_rank_served_during_window": drained_served,
+        "ok": (
+            not client_errors
+            and requests_sent[0] > 0
+            and live_parity["checked"] > 0
+            and live_parity["mismatched"] == 0
+            and live_parity["unknown_version"] == 0
+            and not offline["mismatched"]
+            and offline["heads"].get("rank1") == passes - 1
+            and rejoined_head == passes - 1
+            and drained_served == 0
+            # single disk fetch per publish, independent of fleet size:
+            # at most one snapshot + one dense file per pass
+            and STAT_GET("serve.fleet_stage_fetches") <= 2 * passes
+            and all(s == "ready" for s in fleet_view.values())
+        ),
+    }
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--passes", type=int, default=6, help="publishes in the day (1 base + N-1 deltas)")
     ap.add_argument("--rows", type=int, default=400, help="training rows per pass")
     ap.add_argument("--qps", type=float, default=40.0, help="target score QPS per client thread")
     ap.add_argument("--probe", type=int, default=32, help="probe records for the parity gate")
+    ap.add_argument("--fleet", type=int, default=0, help="networked fleet size (0 = in-process single-follower soak)")
     ap.add_argument("--json", help="write the report to this path")
     args = ap.parse_args()
 
     with tempfile.TemporaryDirectory() as workdir:
-        report = run_soak(
-            workdir, passes=args.passes, rows=args.rows, qps=args.qps, probe_n=args.probe
-        )
+        if args.fleet > 0:
+            report = run_fleet_soak(
+                workdir, n_followers=args.fleet, passes=args.passes,
+                rows=args.rows, qps=args.qps, probe_n=args.probe,
+            )
+        else:
+            report = run_soak(
+                workdir, passes=args.passes, rows=args.rows, qps=args.qps, probe_n=args.probe
+            )
     print(json.dumps(report, indent=2))
     if args.json:
         with atomic_write(args.json) as f:

@@ -338,4 +338,35 @@ assert r.returncode == 1 and "VIOLATION I4" in r.stdout, \
 print(f"[13] protocol verification ok: incremental lint clean, model "
       f"fixpoint {_pcl['states']} states / {_pcl['transitions']} "
       f"transitions with zero violations, broken variant caught on I4")
+# --- 14. serving fleet under churn + injected faults --------------------
+# The networked serving day: N followers over one shared stage, a
+# follower killed, another drained and readmitted, the killed rank
+# rejoining as a new incarnation — all during concurrent publishes and
+# with faults injected at the three serve sites (lost request, torn
+# stage fetch, dropped drain command). The gate mirrors the committed
+# SOAK_SERVEFLEET.json headline: zero client-visible failures, bitwise
+# parity on every served version, drain honored, and a single disk
+# fetch per publish independent of fleet size.
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "chaos_probe.py"),
+     "--serve-fleet", "--json"],
+    capture_output=True, text=True, timeout=600)
+assert r.returncode == 0, f"serve-fleet soak red:\n{r.stdout}{r.stderr}"
+_sf = _json.loads(r.stdout.strip().splitlines()[-1])
+assert _sf["ok"] and _sf["soak"]["ok"], _sf
+assert all(n > 0 for n in _sf["faults_fired"].values()), _sf
+_sk = _sf["soak"]
+assert not _sk["client_errors"] and _sk["live_parity"]["mismatched"] == 0, _sk
+assert _sk["drained_rank_served_during_window"] == 0, _sk
+_committed = os.path.join(_here, os.pardir, "SOAK_SERVEFLEET.json")
+if os.path.exists(_committed):
+    with open(_committed) as _f:  # pbox-lint: disable=IO004
+        _ref = _json.load(_f)
+    assert _ref["ok"] and not _ref["client_errors"], \
+        "committed SOAK_SERVEFLEET.json records a red run"
+print(f"[14] serve fleet ok: {_sk['fleet']} followers, "
+      f"{_sk['requests']} requests / 0 failures under kill+drain+rejoin, "
+      f"{_sk['hedges']} hedge(s), faults fired {_sf['faults_fired']}, "
+      f"live parity {_sk['live_parity']['checked']}/0 mismatched, "
+      f"{_sk['stage_fetches']} stage fetches for {_sk['passes']} passes")
 print("VERIFY DRIVE PASS")
