@@ -586,6 +586,31 @@ def main():
             "live_ranks": int(STAT_GET("membership.live_ranks")),
             "joins_total": int(STAT_GET("membership.joins_total")),
         },
+        # serving plane (serve/): miss ladder + device hot tier + the SLO
+        # latency series — a pure-training bench leaves these at zero; the
+        # serving soaks (tools/serve_soak.py [--device-tier]) move them
+        "serve": {
+            "key_misses": int(STAT_GET("serve.key_misses")),
+            "device_tier_rows": int(STAT_GET("serve.device_tier_rows")),
+            "device_tier_builds": int(STAT_GET("serve.device_tier_builds")),
+            "device_tier_hits": int(STAT_GET("serve.device_tier_hits")),
+            "device_tier_misses": int(STAT_GET("serve.device_tier_misses")),
+            "device_tier_hit_rate": round(
+                STAT_GET("serve.device_tier_hits")
+                / max(
+                    1.0,
+                    STAT_GET("serve.device_tier_hits")
+                    + STAT_GET("serve.device_tier_misses"),
+                ),
+                4,
+            ),
+            "lb_rerouted": int(STAT_GET("serve.lb_rerouted")),
+            "request_ms": (
+                _all_histograms()["serve.request_ms"].summary((0.5, 0.99))
+                if "serve.request_ms" in _all_histograms()
+                else None
+            ),
+        },
         # pass-prepare pad sweep (native pbx_block_stats counter sweep):
         # must stay a small fraction of train_pass_s at any pass size
         "prepare_s": round(getattr(trainer, "last_prepare_s", -1.0), 3),

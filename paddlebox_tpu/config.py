@@ -328,6 +328,48 @@ define_flag(
     "(empty: the FleetStage caller must pass an explicit directory)",
 )
 
+
+def _validate_device_scoring_tier(v: str) -> None:
+    if v not in ("off", "on"):
+        raise ValueError(
+            f"device_scoring_tier must be 'off' or 'on', got {v!r}"
+        )
+
+
+define_flag(
+    "device_scoring_tier",
+    "off",
+    "mesh-sharded device-resident hot-key scoring tier: 'on' builds a "
+    "NamedSharding-placed copy of the hottest rows at every version "
+    "commit (decayed-show >= device_tier_hot_show) and answers serve "
+    "lookups from it through the sharded-pull path, falling back to the "
+    "host TableVersion.lookup_rows only on tier misses; 'off' (the "
+    "ablation) is bitwise-identical to the host-only serving path",
+    validator=_validate_device_scoring_tier,
+)
+define_flag(
+    "device_tier_hot_show",
+    1.0,
+    "decayed-show threshold a row must clear at commit time to enter the "
+    "device scoring tier (same shows_peek signal the adaptive ICI wire "
+    "uses; lower admits more of the tail, higher keeps HBM for the head)",
+)
+define_flag(
+    "device_tier_capacity",
+    65536,
+    "max rows the device scoring tier holds per version; when more rows "
+    "clear device_tier_hot_show, the hottest ones win (top-k by decayed "
+    "show) and the rest serve from the host path",
+)
+define_flag(
+    "serve_lb_least_loaded",
+    True,
+    "fleet-client load balancing: weigh the round-robin pick against the "
+    "next candidate by gossiped queue depth (least-loaded-of-two, "
+    "reroutes counted under serve.lb_rerouted); False is the pure "
+    "round-robin ablation",
+)
+
 # --- metrics ---
 define_flag("auc_num_buckets", 1_000_000, "AUC wuauc bucket table size (reference box_wrapper.h:61)")
 define_flag("auc_runner_pool_size", 10_000, "AucRunner candidate reservoir capacity per pool")

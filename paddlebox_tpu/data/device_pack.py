@@ -238,6 +238,46 @@ def _route_sharded(
     )
 
 
+def route_serve_requests(
+    owner: np.ndarray,
+    local_rank: np.ndarray,
+    n_devices: int,
+    bucket: int,
+    pad_rank: int,
+):
+    """Serve-tier hit keys -> static sharded-pull request buckets.
+
+    ``owner[i]`` is the mesh shard holding hit key i, ``local_rank[i]`` its
+    row within that shard's device block. Keys split round-robin across the
+    ``n_devices`` requesting devices (one host request exercises every
+    chip), then bucket per owner shard exactly like :func:`_route_sharded`:
+    K rounds to ``bucket`` so the compiled collective family stays bounded,
+    and slot K-1 of every bucket is guaranteed padding (-> ``pad_rank``,
+    the tier's reserved zero row).
+
+    Returns ``(req_ranks int32 [n_dev, n_dev, K], pos int64 [m], K)`` where
+    ``pos[i]`` is key i's flat row in the pulled ``[n_dev, n_dev*K, width]``
+    output (device-major, then bucket position s*K + j).
+    """
+    m = len(owner)
+    if m == 0:
+        K = bucket
+        req = np.full((n_devices, n_devices, K), pad_rank, dtype=np.int32)
+        return req, np.zeros(0, dtype=np.int64), K
+    dev = np.arange(m, dtype=np.int64) % n_devices
+    grp = dev * n_devices + owner
+    order = np.argsort(grp, kind="stable")
+    counts = np.bincount(grp, minlength=n_devices * n_devices)
+    K = max(_round_bucket(int(counts.max()) + 1, bucket), bucket)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    slot = np.arange(m, dtype=np.int64) - starts[grp[order]]
+    req = np.full((n_devices, n_devices, K), pad_rank, dtype=np.int32)
+    req[dev[order], owner[order], slot] = local_rank[order]
+    pos = np.empty(m, dtype=np.int64)
+    pos[order] = dev[order] * (n_devices * K) + owner[order] * K + slot
+    return req, pos, K
+
+
 def pack_batch_sharded(
     batch: SlotBatch,
     ws: PassWorkingSet,

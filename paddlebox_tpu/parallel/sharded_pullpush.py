@@ -190,6 +190,26 @@ def sharded_pull(
     return resp_back.reshape(n * K, -1).astype(jnp.float32)
 
 
+def sharded_serve_pull(
+    table_local: jnp.ndarray,  # [cap, width] this shard's hot-tier rows
+    req_ranks: jnp.ndarray,  # int32 [n_shards, K] this device's requests
+    axis_name: str = "dp",
+) -> jnp.ndarray:
+    """Serve-side pull over the device scoring tier. [n_shards*K, width].
+
+    Same request routing and bucket-position contract as :func:`sharded_pull`
+    (output row s*K + j answers request slot j of shard s), but the rows
+    return VERBATIM: no embedx gating, no CVM scaling, and fp32 on the wire
+    regardless of ``ici_wire_dtype`` — the hot tier stores exact copies of
+    the committed version's rows and the serving parity gate is bitwise, so
+    the value path must be a pure routed gather.
+    """
+    n, K = req_ranks.shape
+    req_recv = lax.all_to_all(req_ranks, axis_name, 0, 0, tiled=True)  # [n, K]
+    resp = jnp.take(table_local, req_recv.reshape(-1), axis=0).reshape(n, K, -1)
+    return _a2a(resp, axis_name).reshape(n * K, -1)
+
+
 def sharded_push(
     table_local: jnp.ndarray,  # [cap, width]
     req_ranks: jnp.ndarray,  # int32 [n_shards, K]

@@ -16,7 +16,11 @@ from paddlebox_tpu.serve.fleet import (
     ServeRequestError,
 )
 from paddlebox_tpu.serve.follower import Follower
-from paddlebox_tpu.serve.scoring_table import ScoringTable, TableVersion
+from paddlebox_tpu.serve.scoring_table import (
+    DeviceScoringTier,
+    ScoringTable,
+    TableVersion,
+)
 from paddlebox_tpu.serve.server import (
     ScoreServer,
     Scorer,
@@ -27,6 +31,7 @@ from paddlebox_tpu.serve.server import (
 )
 
 __all__ = [
+    "DeviceScoringTier",
     "Follower",
     "ScoringTable",
     "TableVersion",

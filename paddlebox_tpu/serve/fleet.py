@@ -584,14 +584,35 @@ class FleetView:
     def pick(self, avoid: Sequence[int] = ()) -> Optional[int]:
         """Round-robin over queryable followers, preferring ones not in
         ``avoid``; falls back to an avoided-but-queryable one rather than
-        failing (retrying the same follower beats not retrying)."""
+        failing (retrying the same follower beats not retrying).
+
+        With ``serve_lb_least_loaded`` on, the round-robin choice is
+        weighed against the NEXT rotation candidate by the queue depth
+        each follower last gossiped (least-loaded-of-two: near-uniform
+        spread when depths tie, hot-spot avoidance when they don't);
+        taking the second candidate over the rotation's own is counted
+        under ``serve.lb_rerouted``. Flag off is the pure round-robin
+        ablation, bitwise the historical pick order."""
         q = self.queryable()
         if not q:
             return None
         preferred = [r for r in q if r not in set(avoid)] or q
         with self._lock:
             self._rr += 1
-            return preferred[self._rr % len(preferred)]
+            first = preferred[self._rr % len(preferred)]
+            if len(preferred) < 2 or not config.get_flag(
+                "serve_lb_least_loaded"
+            ):
+                return first
+            second = preferred[(self._rr + 1) % len(preferred)]
+            b1 = self._beats.get(first)
+            b2 = self._beats.get(second)
+            d1 = 0 if b1 is None else int(b1.get("queue_depth", 0))
+            d2 = 0 if b2 is None else int(b2.get("queue_depth", 0))
+            if d2 < d1:
+                STAT_ADD("serve.lb_rerouted")
+                return second
+            return first
 
     def snapshot(self) -> Dict[int, str]:
         return self._statuses()

@@ -177,6 +177,7 @@ class Follower:
         it concurrently with the poller."""
         v = self.version()
         applied = self._applied
+        tier = v.device_tier
         return {
             "delta_idx": v.delta_idx,
             "date": v.date,
@@ -189,6 +190,11 @@ class Follower:
                 None if v.published_unix is None
                 else max(0.0, time.time() - v.published_unix)
             ),
+            # per-rank device-tier telemetry: rows the served version holds
+            # on-mesh and its lookup hit/miss tally (0/0/0 = host-only)
+            "tier_rows": 0 if tier is None else int(tier.n_rows),
+            "tier_hits": 0 if tier is None else int(tier.hits),
+            "tier_misses": 0 if tier is None else int(tier.misses),
         }
 
     def poll_once(self) -> bool:
@@ -316,6 +322,12 @@ class Follower:
             if len(keys)
             else np.zeros((0, self.layout.width), dtype=np.float32)
         )
+        hotness = None
+        if len(keys) and config.get_flag("device_scoring_tier") == "on":
+            # decayed-show hotness for the device tier: a pure staging-table
+            # peek (the adaptive ICI wire's signal), so opting in cannot
+            # perturb the applied state
+            hotness = self._staging.shows_peek(keys)
         self.scoring.commit(
             keys,
             rows,
@@ -323,6 +335,7 @@ class Follower:
             delta_idx=delta_idx,
             decay_epoch=self._staging.decay_epochs,
             published_unix=wm.get("published_unix"),
+            hotness=hotness,
             # the version carries the dense pair: scorers read params off
             # the version, so sparse+dense swap atomically together
             params=None if self.trainer is None else self.trainer.params,

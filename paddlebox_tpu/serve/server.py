@@ -81,13 +81,25 @@ class _RowSource:
 
 def version_source(layout, version: TableVersion) -> _RowSource:
     """Row source over an immutable served version; misses (keys the
-    published model has never seen) pull the zero row and are counted."""
+    published model has never seen) pull the zero row and are counted.
 
-    def pull(keys: np.ndarray) -> np.ndarray:
-        rows, n_miss = version.lookup_rows(keys)
-        if n_miss:
-            STAT_ADD("serve.miss_keys", n_miss)
-        return rows
+    Versions carrying a device tier pull through the miss-fallback ladder
+    (mesh-sharded hot rows first, host rows on tier miss) — bitwise-equal
+    rows either way, so the compiled scorer never knows which path fed it.
+    """
+
+    if version.device_tier is not None:
+        def pull(keys: np.ndarray) -> np.ndarray:
+            rows, _, n_miss = version.lookup_rows_tiered(keys)
+            if n_miss:
+                STAT_ADD("serve.miss_keys", n_miss)
+            return rows
+    else:
+        def pull(keys: np.ndarray) -> np.ndarray:
+            rows, n_miss = version.lookup_rows(keys)
+            if n_miss:
+                STAT_ADD("serve.miss_keys", n_miss)
+            return rows
 
     return _RowSource(layout, pull)
 
@@ -332,6 +344,10 @@ class ScoreServer:
                 lat_ms = (t_done - req.t_submit) * 1000.0
                 self.latency_hist.observe(lat_ms)
                 STAT_OBSERVE("serve.latency_ms", lat_ms)
+                # the SLO-facing per-request series: obs_report verdicts
+                # key on serve.request_ms (one sample per request, both
+                # the in-process and fleet-follower paths land here)
+                STAT_OBSERVE("serve.request_ms", lat_ms)
                 self.served_indices.append(v.delta_idx)
         for req in reqs:
             req.done.set()

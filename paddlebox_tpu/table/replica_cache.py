@@ -132,6 +132,13 @@ class ReplicaCache:
         # between two commits is the miss volume the OUTGOING version
         # served, which is what a per-version miss-rate dashboard needs
         STAT_SET("serve.key_misses_at_commit", float(STAT_GET("serve.key_misses")))
+        # same snapshot for the device hot tier's fallback volume, so the
+        # per-version dashboards split "not hot enough for the tier" from
+        # "never published" without differencing two raw counters
+        STAT_SET(
+            "serve.device_tier_misses_at_commit",
+            float(STAT_GET("serve.device_tier_misses")),
+        )
 
 
 def pull_cache_value(cache: "jnp.ndarray", ids: "jnp.ndarray") -> "jnp.ndarray":

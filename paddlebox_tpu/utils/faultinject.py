@@ -153,6 +153,15 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             previous state, and the client re-sends until
                             the health gossip confirms (drain/admit are
                             idempotent)
+    serve.tier_build        serve/scoring_table.py  build_device_tier, at
+                            the start of the device hot-tier build inside
+                            commit() — a failure models a follower dying
+                            mid-tier-build: the commit aborts before the
+                            swap so no partial tier (and no new version)
+                            is ever visible, the old version keeps
+                            serving bitwise, and the healed retry commits
+                            the same version+tier bitwise
+                            (tests/test_serve_shard.py pins it)
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -211,6 +220,7 @@ KNOWN_SITES = (
     "serve.request_recv",
     "serve.fleet_stage",
     "serve.drain",
+    "serve.tier_build",
 )
 
 

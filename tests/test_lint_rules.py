@@ -337,6 +337,7 @@ class TestStatNames:
 
             def f(name, v):
                 STAT_OBSERVE("serve.latency_ms", v)  # ok
+                STAT_OBSERVE("serve.request_ms", v)  # ok (the SLO series)
                 STAT_OBSERVE("Bad-Hist", v)
                 STAT_OBSERVE(name, v)
         """)

@@ -1,0 +1,264 @@
+"""Mesh-sharded scoring tier tests (PR 19).
+
+The gates the device-resident hot-key tier must hold:
+
+- tiered lookups are BITWISE-equal to the host ``TableVersion.lookup_rows``
+  at every request-shape bucket boundary (empty batch, exactly
+  ``serve_key_bucket``, bucket+1, all-miss, all-hit, mixed), with exact
+  ``serve.device_tier_hits`` / ``serve.device_tier_misses`` /
+  ``serve.key_misses`` counter deltas;
+- the tier installs under the SAME atomic swap as the host version: a
+  crash injected mid-tier-build (fault site ``serve.tier_build``) leaves
+  the old version — object identity and scores — untouched, and the
+  healed retry commits bitwise (FLT008 recovery contract);
+- ``device_scoring_tier=off`` (and hotness=None) is bitwise-identical to
+  the host-only path: no tier object, no device work;
+- end-to-end: a follower with the tier on serves scores bitwise-equal to
+  trainer-direct scoring, gossips per-rank tier stats, and feeds the
+  ``serve.request_ms`` histogram (the obs_report SLO series);
+- the fleet client's least-loaded-of-two pick reroutes on gossiped queue
+  depth (counted under ``serve.lb_rerouted``) and degrades to pure
+  round-robin with ``serve_lb_least_loaded=False``.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import config
+from paddlebox_tpu.serve import FleetView, ScoreServer
+from paddlebox_tpu.serve.scoring_table import ScoringTable
+from paddlebox_tpu.utils.faultinject import InjectedFault, fail_once, inject
+from paddlebox_tpu.utils.monitor import STAT_GET, STAT_HIST
+
+from tests.test_serve import DATE, SCHEMA, PublishStack
+
+BUCKET = 16
+WIDTH = 6
+
+
+@pytest.fixture
+def _tier_flags():
+    names = (
+        "serve_key_bucket",
+        "serve_row_bucket",
+        "device_scoring_tier",
+        "device_tier_hot_show",
+        "device_tier_capacity",
+        "serve_lb_least_loaded",
+    )
+    prev = {n: config.get_flag(n) for n in names}
+    config.set_flag("serve_key_bucket", BUCKET)
+    config.set_flag("serve_row_bucket", 8)
+    yield
+    for n, v in prev.items():
+        config.set_flag(n, v)
+
+
+def _committed_version(hotness=True):
+    """A synthetic version: 64 keys, even-indexed ones hot (shows=2)."""
+    rng = np.random.default_rng(7)
+    keys = np.sort(
+        rng.choice(100_000, 64, replace=False).astype(np.uint64)
+    )
+    rows = rng.standard_normal((64, WIDTH)).astype(np.float32)
+    shows = np.zeros(64, dtype=np.float32)
+    shows[::2] = 2.0
+    st = ScoringTable(WIDTH)
+    v = st.commit(
+        keys,
+        rows,
+        date=DATE,
+        delta_idx=0,
+        decay_epoch=0,
+        hotness=shows if hotness else None,
+    )
+    hot = keys[::2]
+    cold = keys[1::2]
+    absent = (np.uint64(2**63) + np.arange(40, dtype=np.uint64)).astype(
+        np.uint64
+    )
+    return st, v, hot, cold, absent
+
+
+# ---- bucket-boundary parity + exact miss split -----------------------------
+
+
+@pytest.mark.parametrize(
+    "case",
+    ["empty", "bucket", "bucket_plus_1", "all_miss", "all_hit", "mixed"],
+)
+def test_tiered_lookup_bitwise_and_counter_split(_tier_flags, case):
+    _, v, hot, cold, absent = _committed_version()
+    assert v.device_tier is not None and v.device_tier.n_rows == 32
+    q, want = {
+        # (hits, tier_misses, key_misses)
+        "empty": (np.zeros(0, dtype=np.uint64), (0, 0, 0)),
+        "bucket": (hot[:BUCKET], (BUCKET, 0, 0)),
+        "bucket_plus_1": (hot[: BUCKET + 1], (BUCKET + 1, 0, 0)),
+        "all_miss": (absent[:12], (0, 12, 12)),
+        "all_hit": (hot, (len(hot), 0, 0)),
+        "mixed": (
+            np.concatenate([hot[:10], cold[:10], absent[:5]]),
+            (10, 15, 5),
+        ),
+    }[case]
+    ref, ref_miss = v.lookup_rows(q)  # host path (bumps serve.key_misses)
+    before = {
+        n: STAT_GET(n)
+        for n in (
+            "serve.device_tier_hits",
+            "serve.device_tier_misses",
+            "serve.key_misses",
+        )
+    }
+    got, n_tier_miss, n_key_miss = v.lookup_rows_tiered(q)
+    np.testing.assert_array_equal(ref, got)  # bitwise, zero-rows included
+    hits, tier_misses, key_misses = want
+    assert (n_tier_miss, n_key_miss) == (tier_misses, key_misses)
+    assert ref_miss == key_misses  # host path agrees on true misses
+    assert STAT_GET("serve.device_tier_hits") - before["serve.device_tier_hits"] == hits
+    assert (
+        STAT_GET("serve.device_tier_misses")
+        - before["serve.device_tier_misses"]
+        == tier_misses
+    )
+    assert STAT_GET("serve.key_misses") - before["serve.key_misses"] == key_misses
+
+
+def test_capacity_truncation_keeps_hottest(_tier_flags):
+    config.set_flag("device_tier_capacity", 8)
+    _, v, hot, _, _ = _committed_version()
+    # only 8 of the 32 hot rows fit; every served row is still bitwise
+    assert v.device_tier.n_rows == 8
+    ref, _ = v.lookup_rows(hot)
+    got, n_tier_miss, n_key_miss = v.lookup_rows_tiered(hot)
+    np.testing.assert_array_equal(ref, got)
+    assert n_tier_miss == len(hot) - 8 and n_key_miss == 0
+
+
+def test_ablation_off_builds_no_tier(_tier_flags):
+    _, v, hot, cold, _ = _committed_version(hotness=False)
+    assert v.device_tier is None
+    q = np.concatenate([hot[:5], cold[:5]])
+    rows, n_tier_miss, n_key_miss = v.lookup_rows_tiered(q)
+    ref, _ = v.lookup_rows(q)
+    np.testing.assert_array_equal(ref, rows)
+    assert (n_tier_miss, n_key_miss) == (0, 0)
+
+
+# ---- serve.tier_build: kill mid-tier-build, FLT008 contract ----------------
+
+
+def test_kill_mid_tier_build_keeps_old_version_bitwise(_tier_flags):
+    st, v0, hot, _, _ = _committed_version()
+    probe = np.concatenate([hot, v0.keys[1::2]])
+    before = v0.lookup_rows(probe)[0]
+
+    rng = np.random.default_rng(11)
+    keys2 = np.sort(rng.choice(100_000, 80, replace=False).astype(np.uint64))
+    rows2 = rng.standard_normal((80, WIDTH)).astype(np.float32)
+    shows2 = np.full(80, 2.0, dtype=np.float32)
+    kw = dict(date=DATE, delta_idx=1, decay_epoch=0, hotness=shows2)
+    with inject(fail_once("serve.tier_build")) as plan:
+        with pytest.raises(InjectedFault):
+            st.commit(keys2, rows2, **kw)
+        assert plan.failures("serve.tier_build") == 1
+        # no partial tier, no partial version: same object, same rows
+        v1 = st.version()
+        assert v1 is v0 and v1.delta_idx == 0
+        np.testing.assert_array_equal(before, v1.lookup_rows(probe)[0])
+        assert st.committed_indices() == [0]
+        # healed retry (same plan, budget spent) lands the commit bitwise
+        v2 = st.commit(keys2, rows2, **kw)
+    assert v2.delta_idx == 1 and v2.device_tier is not None
+    assert v2.device_tier.n_rows == 80
+    ref, _ = v2.lookup_rows(keys2)
+    got, _, _ = v2.lookup_rows_tiered(keys2)
+    np.testing.assert_array_equal(ref, got)
+    np.testing.assert_array_equal(ref, rows2)
+
+
+# ---- end-to-end: follower parity, gossip, request_ms -----------------------
+
+
+def test_follower_device_tier_parity_gossip_and_request_ms(
+    _tier_flags, tmp_path
+):
+    config.set_flag("device_scoring_tier", "on")
+    config.set_flag("device_tier_hot_show", 0.5)
+    st = PublishStack(tmp_path)
+    fol = st.follower
+    st.publish_base()
+    ref0 = st.trainer_scores()
+    assert fol.poll_once() is True
+    v0 = fol.version()
+    assert v0.device_tier is not None and v0.device_tier.n_rows > 0
+    np.testing.assert_array_equal(ref0, st.follower_scores(v0))
+
+    st.publish_delta(lo=120)
+    ref1 = st.trainer_scores()
+    assert fol.poll_once() is True
+    v1 = fol.version()
+    assert v1.device_tier is not None and v1.device_tier is not v0.device_tier
+    np.testing.assert_array_equal(ref1, st.follower_scores(v1))
+    assert v1.device_tier.hits > 0  # the parity probe ran through the tier
+
+    # per-rank tier stats ride the health gossip beat
+    snap = fol.health_snapshot()
+    assert snap["tier_rows"] == v1.device_tier.n_rows
+    assert snap["tier_hits"] == v1.device_tier.hits
+    assert snap["tier_misses"] == v1.device_tier.misses
+
+    # the SLO histogram: one serve.request_ms sample per served request
+    h_before = STAT_HIST("serve.request_ms")
+    n_before = 0 if h_before is None else h_before.count
+    srv = ScoreServer(fol, st.scorer, SCHEMA)
+    srv.start()
+    try:
+        preds = srv.score(st.probe, timeout=60.0)
+    finally:
+        srv.stop()
+    np.testing.assert_array_equal(ref1, preds)
+    h = STAT_HIST("serve.request_ms")
+    assert h is not None and h.count == n_before + 1
+
+
+# ---- fleet client load balancing: least-loaded-of-two ----------------------
+
+
+def _ready_beat(queue_depth):
+    return {
+        "state": "ready",
+        "warm": True,
+        "delta_idx": 0,
+        "ownership_epoch": 0,
+        "queue_depth": queue_depth,
+    }
+
+
+def test_pick_least_loaded_of_two_reroutes_and_counts(_tier_flags):
+    view = FleetView([1, 2])
+    view.observe(1, _ready_beat(queue_depth=50))
+    view.observe(2, _ready_beat(queue_depth=0))
+    before = STAT_GET("serve.lb_rerouted")
+    picks = [view.pick() for _ in range(10)]
+    # every rotation landing on the loaded rank 1 reroutes to idle rank 2
+    assert picks == [2] * 10
+    assert STAT_GET("serve.lb_rerouted") - before == 5
+    # equal depths: no reroute, plain rotation
+    view.observe(1, _ready_beat(queue_depth=0))
+    base = STAT_GET("serve.lb_rerouted")
+    assert sorted(view.pick() for _ in range(2)) == [1, 2]
+    assert STAT_GET("serve.lb_rerouted") == base
+
+
+def test_pick_flag_off_is_pure_round_robin(_tier_flags):
+    config.set_flag("serve_lb_least_loaded", False)
+    view = FleetView([1, 2])
+    view.observe(1, _ready_beat(queue_depth=10_000))
+    view.observe(2, _ready_beat(queue_depth=0))
+    before = STAT_GET("serve.lb_rerouted")
+    picks = [view.pick() for _ in range(4)]
+    # the ablation ignores load entirely: strict alternation
+    assert picks in ([1, 2, 1, 2], [2, 1, 2, 1])
+    assert STAT_GET("serve.lb_rerouted") == before

@@ -458,6 +458,119 @@ def run_serve(args):
     return 0 if ok else 1
 
 
+def run_serve_shard(args):
+    """Mesh-sharded tier crash probe (``--serve-shard``): a follower with
+    the device scoring tier ON takes an injected crash mid-tier-build
+    (fault site ``serve.tier_build``) while applying a fresh delta. The
+    FLT008 contract under test: the commit aborts whole — the previously
+    served version (object identity, its tier, its scores) is untouched
+    and no partial tier is ever visible — and the healed retry lands the
+    same delta bitwise with the tier rebuilt.
+
+      JAX_PLATFORMS=cpu python tools/chaos_probe.py --serve-shard [--json]
+    """
+    import serve_soak
+
+    from paddlebox_tpu import config
+    from paddlebox_tpu.data.parser import parse_line
+    from paddlebox_tpu.serve import table_source, version_source
+    from paddlebox_tpu.utils.faultinject import InjectedFault, fail_once, inject
+
+    prev = {
+        n: config.get_flag(n)
+        for n in ("device_scoring_tier", "device_tier_hot_show")
+    }
+    config.set_flag("device_scoring_tier", "on")
+    config.set_flag("device_tier_hot_show", 0.0)  # every published row is hot
+    try:
+        with tempfile.TemporaryDirectory() as tmpdir:
+            root = os.path.join(tmpdir, "ckpt")
+            table, ds, cfg, trainer, mgr = serve_soak.make_stack(root)
+            fol, scorer = serve_soak.make_follower(root, cfg)
+            rng = np.random.default_rng(args.seed)
+            date = serve_soak.DATE
+
+            p0 = os.path.join(tmpdir, "pass-0.txt")
+            lines = serve_soak.write_pass_file(rng, p0, args.rows, 1)
+            probe = [parse_line(ln, serve_soak.SCHEMA) for ln in lines[:16]]
+
+            def one_pass(lo, path=None):
+                if path is None:
+                    path = os.path.join(tmpdir, f"pass-{lo}.txt")
+                    serve_soak.write_pass_file(rng, path, args.rows, lo)
+                ds.set_filelist([path])
+                ds.load_into_memory()
+                ds.begin_pass(round_to=8)
+                trainer.train_pass(ds)
+                ds.end_pass(trainer.trained_table_device())
+                table.drain_pending()
+
+            def follower_scores(v):
+                return scorer.score_records(
+                    probe, serve_soak.SCHEMA,
+                    version_source(serve_soak.LAYOUT, v), v.params, v.opt_state,
+                )
+
+            one_pass(1, path=p0)
+            mgr.save_base(date, table, trainer)
+            assert fol.poll_once()
+            v0 = fol.version()
+            tier0 = v0.device_tier
+            tier_on = tier0 is not None and tier0.n_rows > 0
+            good = follower_scores(v0)
+
+            one_pass(120)
+            mgr.save_delta(date, table, trainer)
+            with inject(fail_once("serve.tier_build")) as plan:
+                crashed = False
+                try:
+                    fol.poll_once()
+                except InjectedFault:
+                    crashed = True
+                v_mid = fol.version()
+                held = (
+                    crashed
+                    and v_mid is v0
+                    and v_mid.device_tier is tier0
+                    and np.array_equal(follower_scores(v_mid), good)
+                )
+                # healed retry inside the same plan (fault budget spent):
+                # staging re-apply is idempotent, the tier rebuilds
+                caught_up = fol.poll_once()
+            fired = plan.failures("serve.tier_build")
+            v1 = fol.version()
+            ref = scorer.score_records(
+                probe, serve_soak.SCHEMA,
+                table_source(serve_soak.LAYOUT, table),
+                trainer.params, trainer.opt_state,
+            )
+            recovered = (
+                caught_up
+                and v1.delta_idx == 1
+                and v1.device_tier is not None
+                and v1.device_tier.n_rows > 0
+                and np.array_equal(follower_scores(v1), ref)
+            )
+    finally:
+        for n, v in prev.items():
+            config.set_flag(n, v)
+
+    ok = tier_on and held and recovered and fired == 1
+    report = {
+        "mode": "serve-shard",
+        "tier_on_base": bool(tier_on),
+        "tier_build_faults_fired": int(fired),
+        "old_version_held_bitwise": bool(held),
+        "healed_retry_caught_up": bool(caught_up),
+        "final_served_idx": v1.delta_idx,
+        "final_tier_rows": 0 if v1.device_tier is None else v1.device_tier.n_rows,
+        "parity_after_heal_bitwise": bool(recovered),
+        "ok": bool(ok),
+    }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if ok else 1
+
+
 def run_serve_fleet(args):
     """Fleet churn soak under injected serve faults (``--serve-fleet``):
     the full networked day — N followers over one shared stage, follower
@@ -1713,6 +1826,13 @@ def main(argv=None):
                          "a torn stage fetch, and a dropped drain command "
                          "injected — zero client-visible failures and "
                          "bitwise parity must survive all of it")
+    ap.add_argument("--serve-shard", action="store_true",
+                    help="mesh-sharded tier crash probe: a follower with "
+                         "the device scoring tier on takes an injected "
+                         "crash mid-tier-build (serve.tier_build) — the "
+                         "old version must keep serving bitwise with no "
+                         "partial tier, and the healed retry must land "
+                         "the delta bitwise with the tier rebuilt")
     ap.add_argument("--ici-wire", action="store_true",
                     help="A/B the frequency-adaptive ICI wire: mesh-trainer "
                          "days over one zipf-keyed day in fp32 / bf16 / "
@@ -1736,6 +1856,8 @@ def main(argv=None):
         return run_proto_check(args)
     if args.ici_wire:
         return run_ici_wire(args)
+    if args.serve_shard:
+        return run_serve_shard(args)
     if args.serve_fleet:
         return run_serve_fleet(args)
     if args.serve:

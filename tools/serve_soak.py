@@ -25,10 +25,20 @@ and rejoin while publishes keep landing — the fault-tolerant-serving
 acceptance gate (zero client-visible failures, bitwise parity live and
 offline, single disk fetch per publish independent of N).
 
-Run:  python tools/serve_soak.py --passes 6 --qps 40 [--fleet 3] [--json report.json]
+``--device-tier`` runs the mesh-sharded-scoring A/B instead: the SAME day
+twice — host-only (``device_scoring_tier=off``) then device-tier on — with
+bitwise parity required inside each leg AND between the legs (the off
+ablation must be bitwise-identical), followed by a lookup-throughput
+microbench (large synthetic version, hot-key query mix at hit rate >= 0.9)
+comparing ``TableVersion.lookup_rows`` host-only against the tiered path.
+The committed report is SOAK_SERVESHARD.json; the platform is stamped
+because on a CPU mesh the numbers are a proxy for the TPU target.
+
+Run:  python tools/serve_soak.py --passes 6 --qps 40 [--fleet 3 | --device-tier] [--json report.json]
 Exit: 0 on full parity + no request errors, 1 otherwise.
 """
 import argparse
+import hashlib
 import json
 import os
 import socket
@@ -114,6 +124,16 @@ def run_soak(workdir, passes=6, rows=400, qps=40.0, probe_n=32):
     """The full concurrent day; returns the report dict (see module doc)."""
     root = os.path.join(workdir, "ckpt")
     rng = np.random.default_rng(0)
+    # counters are process-global and the A/B driver runs two days in one
+    # process, so the report carries deltas over this day only
+    tier_stats0 = {
+        n: STAT_GET(n)
+        for n in (
+            "serve.device_tier_hits",
+            "serve.device_tier_misses",
+            "serve.device_tier_builds",
+        )
+    }
     table, ds, cfg, trainer, mgr = make_stack(root)
     fol, scorer = make_follower(root, cfg)
 
@@ -172,11 +192,15 @@ def run_soak(workdir, passes=6, rows=400, qps=40.0, probe_n=32):
     t_gen = [0.0]
 
     def load_gen():
+        # own rng: the shared one feeds write_pass_file from the main
+        # thread, and concurrent draws here would make the training day
+        # nondeterministic (the --device-tier A/B compares two days bitwise)
+        lg_rng = np.random.default_rng(1234)
         period = 1.0 / qps
         while not stop.is_set():
             t0 = time.perf_counter()
             if fol.version().params is not None:  # serving is warm
-                k = int(rng.integers(0, probe_n - 8))
+                k = int(lg_rng.integers(0, probe_n - 8))
                 try:
                     srv.score(probe[k : k + 8], timeout=30)
                     requests_sent[0] += 1
@@ -235,6 +259,7 @@ def run_soak(workdir, passes=6, rows=400, qps=40.0, probe_n=32):
 
     lat = srv.latency_percentiles()
     achieved_qps = requests_sent[0] / elapsed if elapsed > 0 else 0.0
+    head_tier = head.device_tier
     report = {
         "passes": passes,
         "rows_per_pass": rows,
@@ -242,6 +267,22 @@ def run_soak(workdir, passes=6, rows=400, qps=40.0, probe_n=32):
         "requests": requests_sent[0],
         "achieved_qps": round(achieved_qps, 2),
         "latency": lat,
+        # the producer-truth fingerprint per pass: two runs of the same day
+        # (off vs on) must agree on every one of these for the ablation to
+        # count as bitwise-identical
+        "reference_sha": {
+            str(i): hashlib.sha256(reference[i].tobytes()).hexdigest()
+            for i in sorted(reference)
+        },
+        "device_tier": {
+            "head_rows": 0 if head_tier is None else head_tier.n_rows,
+            "builds": STAT_GET("serve.device_tier_builds")
+            - tier_stats0["serve.device_tier_builds"],
+            "hits": STAT_GET("serve.device_tier_hits")
+            - tier_stats0["serve.device_tier_hits"],
+            "misses": STAT_GET("serve.device_tier_misses")
+            - tier_stats0["serve.device_tier_misses"],
+        },
         "staleness_s": [
             {"delta_idx": i, "lag_s": round(lag, 4)} for i, lag in srv.staleness
         ],
@@ -257,6 +298,154 @@ def run_soak(workdir, passes=6, rows=400, qps=40.0, probe_n=32):
             and head.delta_idx == passes - 1
             and not client_errors
             and requests_sent[0] > 0
+        ),
+    }
+    return report
+
+
+_TIER_FLAGS = ("device_scoring_tier", "device_tier_hot_show", "device_tier_capacity")
+
+
+def _bench_tier_lookup(n_rows, n_hot, width, batch, iters, hot_frac=0.95):
+    """Lookup-throughput microbench: one large committed version, a hot
+    query mix, host ``lookup_rows`` vs tiered ``lookup_rows_tiered``.
+
+    The tier holds ``n_hot`` of ``n_rows`` published rows; queries draw
+    ``hot_frac`` of each batch from the hot set (tier hit rate ~= hot_frac,
+    the >= 0.9 regime the headline claims). Every timed path is also
+    checked bitwise against the host answer.
+    """
+    from paddlebox_tpu.serve.scoring_table import ScoringTable
+
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 2**62, int(n_rows * 1.2), dtype=np.uint64))[
+        :n_rows
+    ]
+    rows = rng.standard_normal((len(keys), width)).astype(np.float32)
+    hot_idx = np.sort(rng.choice(len(keys), n_hot, replace=False))
+    hotness = np.zeros(len(keys), dtype=np.float32)
+    hotness[hot_idx] = 2.0
+
+    kw = dict(date=DATE, delta_idx=0, decay_epoch=0)
+    v_host = ScoringTable(width).commit(keys, rows, **kw)  # hotness=None
+    config.set_flag("device_tier_capacity", n_hot)
+    config.set_flag("device_tier_hot_show", 1.0)
+    v_tier = ScoringTable(width).commit(keys, rows, hotness=hotness, **kw)
+    tier = v_tier.device_tier
+    if tier is None:
+        return {"mesh": "unavailable", "throughput_ok": False}
+
+    hot_keys = keys[hot_idx]
+    cold_keys = np.delete(keys, hot_idx)
+    n_hot_q = int(batch * hot_frac)
+    batches = [
+        np.concatenate(
+            [
+                rng.choice(hot_keys, n_hot_q),
+                rng.choice(cold_keys, batch - n_hot_q),
+            ]
+        )
+        for _ in range(iters)
+    ]
+
+    # warmup compiles the bucketed collective and touches both row arrays
+    for q in batches[:2]:
+        v_host.lookup_rows(q)
+        v_tier.lookup_rows_tiered(q)
+    ref, _ = v_host.lookup_rows(batches[0])
+    got, _, _ = v_tier.lookup_rows_tiered(batches[0])
+    bitwise = bool(np.array_equal(ref, got))
+
+    t0 = time.perf_counter()
+    for q in batches:
+        v_host.lookup_rows(q)
+    host_s = time.perf_counter() - t0
+
+    hits0, miss0 = tier.hits, tier.misses
+    t0 = time.perf_counter()
+    for q in batches:
+        v_tier.lookup_rows_tiered(q)
+    tier_s = time.perf_counter() - t0
+    d_hits = tier.hits - hits0
+    d_miss = tier.misses - miss0
+    hit_rate = d_hits / max(1, d_hits + d_miss)
+
+    n_keys = batch * iters
+    host_kps = n_keys / host_s if host_s > 0 else 0.0
+    tier_kps = n_keys / tier_s if tier_s > 0 else 0.0
+    return {
+        "rows": int(len(keys)),
+        "hot_rows": tier.n_rows,
+        "width": width,
+        "batch": batch,
+        "iters": iters,
+        "hit_rate": round(hit_rate, 4),
+        "host_keys_per_s": round(host_kps),
+        "tier_keys_per_s": round(tier_kps),
+        "speedup": round(tier_kps / host_kps, 3) if host_kps else None,
+        "bitwise_equal": bitwise,
+        "throughput_ok": bool(bitwise and hit_rate >= 0.9 and tier_kps >= host_kps),
+    }
+
+
+def run_device_tier_ab(
+    workdir,
+    passes=6,
+    rows=400,
+    qps=40.0,
+    probe_n=32,
+    bench_rows=500_000,
+    bench_hot=65_536,
+    bench_batch=8192,
+    bench_iters=30,
+):
+    """The mesh-sharded-scoring headline: same day host-only then
+    device-tier, bitwise inside and ACROSS the legs, plus the lookup
+    microbench. Returns the SOAK_SERVESHARD report dict."""
+    prev = {n: config.get_flag(n) for n in _TIER_FLAGS}
+    try:
+        config.set_flag("device_scoring_tier", "off")
+        host_leg = run_soak(
+            os.path.join(workdir, "host"), passes=passes, rows=rows, qps=qps,
+            probe_n=probe_n,
+        )
+        config.set_flag("device_scoring_tier", "on")
+        # every trained key qualifies: the probe set must ride the tier
+        config.set_flag("device_tier_hot_show", 0.0)
+        tier_leg = run_soak(
+            os.path.join(workdir, "tier"), passes=passes, rows=rows, qps=qps,
+            probe_n=probe_n,
+        )
+        bench = _bench_tier_lookup(
+            bench_rows, bench_hot, LAYOUT.pull_width, bench_batch, bench_iters
+        )
+    finally:
+        for n, v in prev.items():
+            config.set_flag(n, v)
+
+    ablation_bitwise = host_leg["reference_sha"] == tier_leg["reference_sha"]
+    tier_used = (
+        tier_leg["device_tier"]["builds"] == passes
+        and tier_leg["device_tier"]["head_rows"] > 0
+        and tier_leg["device_tier"]["hits"] > 0
+    )
+    report = {
+        "mode": "device_tier_ab",
+        "platform": jax.default_backend(),
+        "mesh_devices": jax.device_count(),
+        "passes": passes,
+        "host_leg": host_leg,
+        "tier_leg": tier_leg,
+        "ablation_bitwise_identical": ablation_bitwise,
+        "tier_used": tier_used,
+        "lookup_bench": bench,
+        "ok": (
+            host_leg["ok"]
+            and tier_leg["ok"]
+            and host_leg["device_tier"]["builds"] == 0
+            and ablation_bitwise
+            and tier_used
+            and bench.get("throughput_ok", False)
         ),
     }
     return report
@@ -382,11 +571,14 @@ def _run_fleet_soak(workdir, root, stage_dir, rng, n_followers, passes, rows, qp
     requests_sent = [0]
 
     def load_gen():
+        # own rng, same reason as run_soak: keep the training day
+        # deterministic by never touching the shared rng off-thread
+        lg_rng = np.random.default_rng(1234)
         period = 2.0 / qps  # two generator threads share the target rate
         while not stop_load.is_set():
             t0 = time.perf_counter()
             if client.view.queryable():
-                k = int(rng.integers(0, probe_n - 8))
+                k = int(lg_rng.integers(0, probe_n - 8))
                 t_sent = time.monotonic()
                 try:
                     preds, meta = client.score_lines(probe_lines[k : k + 8], timeout=10)
@@ -568,11 +760,23 @@ def main():
     ap.add_argument("--qps", type=float, default=40.0, help="target score QPS per client thread")
     ap.add_argument("--probe", type=int, default=32, help="probe records for the parity gate")
     ap.add_argument("--fleet", type=int, default=0, help="networked fleet size (0 = in-process single-follower soak)")
+    ap.add_argument("--device-tier", action="store_true", help="mesh-sharded scoring A/B: host-only vs device-tier day + lookup microbench")
+    ap.add_argument("--bench-rows", type=int, default=500_000, help="synthetic version size for the lookup microbench")
+    ap.add_argument("--bench-hot", type=int, default=65_536, help="hot rows held by the tier in the microbench")
+    ap.add_argument("--bench-batch", type=int, default=8192, help="keys per lookup batch in the microbench")
+    ap.add_argument("--bench-iters", type=int, default=30, help="timed batches per leg in the microbench")
     ap.add_argument("--json", help="write the report to this path")
     args = ap.parse_args()
 
     with tempfile.TemporaryDirectory() as workdir:
-        if args.fleet > 0:
+        if args.device_tier:
+            report = run_device_tier_ab(
+                workdir, passes=args.passes, rows=args.rows, qps=args.qps,
+                probe_n=args.probe, bench_rows=args.bench_rows,
+                bench_hot=args.bench_hot, bench_batch=args.bench_batch,
+                bench_iters=args.bench_iters,
+            )
+        elif args.fleet > 0:
             report = run_fleet_soak(
                 workdir, n_followers=args.fleet, passes=args.passes,
                 rows=args.rows, qps=args.qps, probe_n=args.probe,

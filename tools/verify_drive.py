@@ -369,4 +369,47 @@ print(f"[14] serve fleet ok: {_sk['fleet']} followers, "
       f"{_sk['hedges']} hedge(s), faults fired {_sf['faults_fired']}, "
       f"live parity {_sk['live_parity']['checked']}/0 mismatched, "
       f"{_sk['stage_fetches']} stage fetches for {_sk['passes']} passes")
+# --- 15. mesh-sharded scoring: device-tier A/B + crash probe ------------
+# The --device-tier A/B runs the SAME serving day host-only and with the
+# device-resident hot tier on, requiring bitwise parity inside each leg
+# AND between them (the off ablation is bitwise-identical), plus the
+# lookup microbench at hit rate >= 0.9; SOAK_SERVESHARD.json is the
+# committed record of the full-size gate and must itself be green. The
+# --serve-shard probe then crashes a follower mid-tier-build
+# (serve.tier_build) and requires the old version to keep serving
+# bitwise with no partial tier, the healed retry landing bitwise.
+_ss_path = os.path.join(os.path.dirname(_here), "SOAK_SERVESHARD.json")
+assert os.path.exists(_ss_path), "SOAK_SERVESHARD.json missing from the repo"
+with open(_ss_path) as _f:
+    _ss = _json.load(_f)
+assert _ss["ok"] and _ss["ablation_bitwise_identical"] and _ss["tier_used"], _ss
+assert _ss["lookup_bench"]["bitwise_equal"], _ss["lookup_bench"]
+assert _ss["lookup_bench"]["hit_rate"] >= 0.9, _ss["lookup_bench"]
+assert (
+    _ss["lookup_bench"]["tier_keys_per_s"] >= _ss["lookup_bench"]["host_keys_per_s"]
+), _ss["lookup_bench"]
+with tempfile.TemporaryDirectory() as ab_dir:
+    _ab = serve_soak.run_device_tier_ab(
+        ab_dir, passes=3, rows=200, qps=25.0, probe_n=16,
+        bench_rows=120_000, bench_hot=16_384, bench_batch=4096, bench_iters=8,
+    )
+assert _ab["host_leg"]["ok"] and _ab["tier_leg"]["ok"], _ab
+assert _ab["ablation_bitwise_identical"] and _ab["tier_used"], _ab
+assert _ab["lookup_bench"]["bitwise_equal"], _ab["lookup_bench"]
+# the short-form bench is too small to re-gate throughput; the committed
+# full-size artifact above carries that claim
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "chaos_probe.py"),
+     "--serve-shard", "--json"],
+    capture_output=True, text=True, timeout=600)
+assert r.returncode == 0, f"serve-shard probe red:\n{r.stdout}{r.stderr}"
+_sp = _json.loads(r.stdout.strip().splitlines()[-1])
+assert _sp["ok"] and _sp["old_version_held_bitwise"], _sp
+assert _sp["tier_build_faults_fired"] == 1 and _sp["parity_after_heal_bitwise"], _sp
+print(f"[15] mesh-sharded scoring ok: A/B ablation bitwise over "
+      f"{_ab['passes']} passes (tier {_ab['tier_leg']['device_tier']['hits']} "
+      f"hit(s)), committed bench {_ss['lookup_bench']['speedup']}x at hit rate "
+      f"{_ss['lookup_bench']['hit_rate']} on {_ss['platform']}, crash probe "
+      f"held old version bitwise and healed to tier of "
+      f"{_sp['final_tier_rows']} row(s)")
 print("VERIFY DRIVE PASS")
