@@ -370,6 +370,53 @@ define_flag(
     "round-robin ablation",
 )
 
+# --- streaming plane (train/stream.py) ---
+def _validate_positive(v) -> None:
+    if not v > 0:
+        raise ValueError(f"flag value must be > 0, got {v!r}")
+
+
+def _validate_stretch(v) -> None:
+    if not v >= 1:
+        raise ValueError(f"stream_backlog_max_stretch must be >= 1, got {v!r}")
+
+
+define_flag(
+    "stream_micro_pass_s",
+    60.0,
+    "time budget per streaming micro-pass: the StreamSupervisor collects "
+    "tailed records for this long, then cuts them into one pass and "
+    "publishes a delta through the normal watermark path (the minute-level "
+    "cadence of ROADMAP item 2; the freshness SLO is roughly this plus "
+    "train+publish+poll time)",
+    validator=_validate_positive,
+)
+define_flag(
+    "stream_poll_interval_s",
+    1.0,
+    "tail-follow poll period inside a micro-pass window: how often the "
+    "DirectoryTailer re-scans the append-only dataset dir for grown or "
+    "new files",
+    validator=_validate_positive,
+)
+define_flag(
+    "stream_compact_every",
+    60,
+    "micro-deltas between chain compactions: every N streamed publishes "
+    "the manager folds base+delta-0001..N into one compact snapshot so a "
+    "late follower's catch-up applies O(hours) artifacts, not O(minutes-"
+    "since-base) (CheckpointManager.compact; <= 1 disables)",
+)
+define_flag(
+    "stream_backlog_max_stretch",
+    8.0,
+    "graceful-degradation cap on the micro-pass cadence: when a cut takes "
+    "longer than its budget (ingest backlog), the effective window doubles "
+    "per overrun (counted under stream.backlog_stretches) up to budget * "
+    "this factor, and shrinks back once cuts run under half budget",
+    validator=_validate_stretch,
+)
+
 # --- metrics ---
 define_flag("auc_num_buckets", 1_000_000, "AUC wuauc bucket table size (reference box_wrapper.h:61)")
 define_flag("auc_runner_pool_size", 10_000, "AucRunner candidate reservoir capacity per pool")

@@ -46,7 +46,7 @@ from paddlebox_tpu.train.checkpoint import (
     validate_watermark,
     verify_snapshot,
 )
-from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_SET
+from paddlebox_tpu.utils.monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 
 logger = logging.getLogger(__name__)
 
@@ -88,14 +88,36 @@ def apply_published_chain(
         return None
     validate_watermark(wm)
     base_crc = wm["base"].get("manifest_crc")
-    if not verify_chain_link(root, wm["base"]["path"], base_crc, require_manifest):
-        raise DeltaLineageError(
-            f"base snapshot {wm['base']['path']!r} under {root} failed "
-            "CRC verification"
-        )
-    table.load(os.path.join(root, wm["base"]["path"]))
     idx = int(wm["delta_idx"])
-    for i in range(1, idx + 1):
+    # compact fast path: a published fold of base+delta-0001..covers loads
+    # in one verified link (bitwise-equal to replaying the prefix), so a
+    # streaming chain costs a joiner O(post-fold tail), not O(minutes-
+    # since-base). A torn fold falls back to the full chain — it is an
+    # optimization, never the only copy.
+    start = 1
+    comp = wm.get("compact")
+    if comp is not None:
+        if verify_chain_link(
+            root, comp["path"], comp.get("manifest_crc"), require_manifest
+        ):
+            table.load(os.path.join(root, comp["path"]))
+            STAT_ADD("serve.compact_fastforwards")
+            start = int(comp["covers"]) + 1
+        else:
+            logger.warning(
+                "compact snapshot %s failed CRC — falling back to the "
+                "full chain", comp["path"],
+            )
+    if start == 1:
+        if not verify_chain_link(
+            root, wm["base"]["path"], base_crc, require_manifest
+        ):
+            raise DeltaLineageError(
+                f"base snapshot {wm['base']['path']!r} under {root} failed "
+                "CRC verification"
+            )
+        table.load(os.path.join(root, wm["base"]["path"]))
+    for i in range(start, idx + 1):
         entry = wm["deltas"][i - 1]
         if not verify_chain_link(
             root, entry["path"], entry.get("manifest_crc"), require_manifest
@@ -250,15 +272,32 @@ class Follower:
         advanced = False
         if not same_lineage:
             # new day or re-published base: the old chain's epochs and rows
-            # are not comparable — rebuild staging from scratch
-            if not self._verify(wm["base"]["path"], base_crc, "base"):
-                return False
-            self._staging = self._fresh_staging()
-            self._staging.load(os.path.join(self.root, wm["base"]["path"]))
-            if idx == 0:
-                self._load_dense(wm)
-            self._commit(wm, delta_idx=0, base_crc=base_crc)
-            advanced = True
+            # are not comparable — rebuild staging from scratch. A published
+            # compact fold fast-forwards the rebuild to delta `covers` in
+            # one load (bitwise-equal to replaying the prefix it covers);
+            # a torn fold falls back to the classic base walk.
+            comp = wm.get("compact")
+            anchored = False
+            if comp is not None and self._verify(
+                comp["path"], comp.get("manifest_crc"), "compact"
+            ):
+                covers = int(comp["covers"])
+                self._staging = self._fresh_staging()
+                self._staging.load(os.path.join(self.root, comp["path"]))
+                STAT_ADD("serve.compact_fastforwards")
+                if covers == idx:
+                    self._load_dense(wm)
+                self._commit(wm, delta_idx=covers, base_crc=base_crc)
+                advanced = anchored = True
+            if not anchored:
+                if not self._verify(wm["base"]["path"], base_crc, "base"):
+                    return False
+                self._staging = self._fresh_staging()
+                self._staging.load(os.path.join(self.root, wm["base"]["path"]))
+                if idx == 0:
+                    self._load_dense(wm)
+                self._commit(wm, delta_idx=0, base_crc=base_crc)
+                advanced = True
         start = self._applied["delta_idx"] + 1
         for i in range(start, idx + 1):
             entry = wm["deltas"][i - 1]
@@ -350,6 +389,19 @@ class Follower:
         STAT_SET("serve.applied_delta_idx", delta_idx)
         STAT_SET("serve.ownership_epoch", int(wm.get("ownership_epoch", 0)))
         STAT_ADD("serve.applies")
+        # end-to-end freshness (the streaming-plane SLO): when the trainer
+        # is a StreamSupervisor the watermark carries the ingest timestamp
+        # of the OLDEST record in the publish; committing the chain head
+        # means that record is now servable, so sample event→served
+        # latency here. Mid-chain catch-up commits are skipped — they
+        # serve older state and would double-count the head's interval.
+        stream = wm.get("stream")
+        if stream is not None and delta_idx == int(wm["delta_idx"]):
+            oldest = stream.get("oldest_unix")
+            if oldest is not None:
+                STAT_OBSERVE(
+                    "serve.freshness_s", max(0.0, time.time() - float(oldest))
+                )
 
     def _load_dense(self, wm: Dict[str, Any]) -> None:
         dense = wm.get("dense")

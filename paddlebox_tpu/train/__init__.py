@@ -23,6 +23,11 @@ from paddlebox_tpu.train.supervisor import (
     PassSupervisor,
     RetryPolicy,
 )
+from paddlebox_tpu.train.stream import (
+    DirectoryTailer,
+    StreamLineageError,
+    StreamSupervisor,
+)
 from paddlebox_tpu.train.trainer import CTRTrainer
 
 __all__ = [
@@ -48,4 +53,7 @@ __all__ = [
     "PassRejected",
     "PassSupervisor",
     "RetryPolicy",
+    "DirectoryTailer",
+    "StreamLineageError",
+    "StreamSupervisor",
 ]

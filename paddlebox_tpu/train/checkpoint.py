@@ -199,9 +199,12 @@ def validate_watermark(wm: Dict[str, Any]) -> None:
         raise DeltaLineageError(f"watermark delta_idx {idx} is negative")
     # one chain, one ownership epoch: entries published under different
     # epochs cover different key ranges and must never compose
+    chain_entries = [wm["base"]] + list(wm["deltas"])
+    if isinstance(wm.get("compact"), dict):
+        chain_entries.append(wm["compact"])
     epochs = {
         e.get("ownership_epoch")
-        for e in [wm["base"]] + list(wm["deltas"])
+        for e in chain_entries
         if isinstance(e, dict) and "ownership_epoch" in e
     }
     if len(epochs) > 1:
@@ -220,6 +223,22 @@ def validate_watermark(wm: Dict[str, Any]) -> None:
             f"watermark delta chain {deltas} is out of lineage — "
             f"delta_idx {idx} requires exactly {want} (ordered, gap-free)"
         )
+    comp = wm.get("compact")
+    if comp is not None:
+        # optional fast-forward artifact: a fold of base+delta-0001..covers.
+        # It substitutes for a chain PREFIX, so it must name a link the
+        # chain actually has — otherwise a follower could fast-forward past
+        # state this watermark never published.
+        try:
+            covers = int(comp["covers"])
+            cpath = comp["path"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise DeltaLineageError(f"malformed compact entry {comp!r}: {e}") from e
+        if not 1 <= covers <= idx or cpath != f"{date}/compact-{covers:04d}":
+            raise DeltaLineageError(
+                f"compact entry {comp!r} is out of lineage for {date!r} at "
+                f"delta_idx {idx}"
+            )
 
 
 class CheckpointManager:
@@ -235,6 +254,12 @@ class CheckpointManager:
         # a follower (or a joining rank) can see the fleet size a chain
         # was published under without parsing ownership maps.
         self.live_ranks: Optional[list] = None
+        # streaming-plane provenance (train/stream.py): when the publisher
+        # is a StreamSupervisor it stamps {"cut_seq", "oldest_unix",
+        # "records"} here before each save so the watermark carries the
+        # ingest timestamp of the oldest record in the publish — the
+        # follower turns that into the serve.freshness_s histogram.
+        self.stream_meta: Optional[Dict[str, Any]] = None
         os.makedirs(root, exist_ok=True)
 
     # ---- paths -----------------------------------------------------------
@@ -264,7 +289,13 @@ class CheckpointManager:
     def prev_cursor(self) -> Optional[Dict[str, Any]]:
         return self._read_cursor(self._prev_cursor_path())
 
-    def _write_cursor(self, date: str, delta_idx: int, dense: Optional[str]) -> None:
+    def _write_cursor(
+        self,
+        date: str,
+        delta_idx: int,
+        dense: Optional[str],
+        compact: Optional[int] = None,
+    ) -> None:
         cur = {
             "date": date,
             "delta_idx": delta_idx,
@@ -272,6 +303,10 @@ class CheckpointManager:
         }
         if dense is not None:
             cur["dense"] = dense  # the dense file this sparse state pairs with
+        if compact:
+            # newest fold of base+delta-0001..compact; carried forward by
+            # save_delta, reset by save_base (a new chain has no fold yet)
+            cur["compact"] = int(compact)
         # keep the superseded cursor as the fallback anchor: if every
         # artifact of the NEW state later verifies torn (bit rot, torn
         # copy), resume() can still land on the previous consistent state
@@ -328,6 +363,17 @@ class CheckpointManager:
                 "path": f"{date}/{dense}",
                 "crc32": _file_crc32(dpath) if os.path.exists(dpath) else None,
             }
+        comp = int(cur.get("compact") or 0)
+        if comp >= 1:
+            rel = f"{date}/compact-{comp:04d}"
+            wm["compact"] = {
+                "path": rel,
+                "covers": comp,
+                "manifest_crc": _manifest_crc(os.path.join(self.root, rel)),
+                "ownership_epoch": epoch,
+            }
+        if self.stream_meta is not None:
+            wm["stream"] = dict(self.stream_meta)
         with atomic_write(self._latest_path()) as f:
             json.dump(wm, f)
         STAT_ADD("ckpt_watermark_publishes")
@@ -425,7 +471,9 @@ class CheckpointManager:
             dense = f"dense-{idx:04d}.npz"
             trainer.save_dense(os.path.join(day, dense))
         _fault_fire("checkpoint.save")  # window: all durable, cursor stale
-        self._write_cursor(date, delta_idx=idx, dense=dense)
+        self._write_cursor(
+            date, delta_idx=idx, dense=dense, compact=cur.get("compact")
+        )
         table.clear_touched()  # delta committed: keys count as saved now
         # retire dense files older than the previous cursor (keep one back
         # for safety against torn reads of cursor.json readers) — but never
@@ -449,6 +497,78 @@ class CheckpointManager:
                     )
         return path
 
+    # ---- compaction ------------------------------------------------------
+
+    def compact(self, date: str, scratch: HostSparseTable) -> Optional[str]:
+        """Fold base + delta-0001..N into one full snapshot ``compact-NNNN``.
+
+        The streaming plane publishes a delta per micro-pass, so a chain
+        grows O(minutes-since-base) links; the fold caps follower catch-up
+        and trainer resume at one full load + the post-fold tail. The fold
+        is an exact sequential replay of the chain into ``scratch`` (a
+        fresh, EMPTY table with the live table's layout/opt/shards): each
+        delta apply performs its own decay catch-up step exactly as a
+        follower would, so the materialized state — published via
+        ``save_base`` as a full kind="base" snapshot — is bitwise-equal to
+        applying the chain, by construction. (A touched-keys re-snapshot
+        would NOT be: per-micro-pass decay is stepwise fp32 ``v*r*r*...``,
+        not one ``v*r**n``.)
+
+        Crash discipline mirrors save_delta (fault site ``ckpt.compact``):
+        the fold publishes atomically under ``compact-NNNN`` and only then
+        does the cursor (and watermark) name it — any crash leaves the old
+        chain servable bitwise, and a healed retry refolds to the identical
+        artifact. Like ``save_delta`` it refuses to straddle an ownership-
+        epoch flip: a fold of a pre-flip chain is state no current trainer
+        holds. Old delta dirs are NOT deleted (the uncompacted chain stays
+        valid; lineage validation is unchanged).
+
+        Returns the published dir, or None when there is nothing new to
+        fold (idempotent).
+        """
+        cur = self.cursor()
+        if cur is None or cur["date"] != date:
+            raise RuntimeError(
+                f"no chain for date {date!r} to compact — save_base first"
+            )
+        if int(cur.get("ownership_epoch", 0)) != int(self.ownership_epoch):
+            raise MembershipEpochError(
+                f"chain for {date!r} was published under ownership epoch "
+                f"{cur.get('ownership_epoch', 0)} but this rank is now at "
+                f"epoch {self.ownership_epoch} — a compact must not "
+                "straddle a membership flip (save_base re-anchors first)"
+            )
+        n = int(cur["delta_idx"])
+        if n < 1 or int(cur.get("compact") or 0) >= n:
+            return None
+        _fault_fire("ckpt.compact")  # window: nothing read yet
+        day = self._day(date)
+        links = [os.path.join(day, "base")] + [
+            os.path.join(day, f"delta-{i:04d}") for i in range(1, n + 1)
+        ]
+        for link in links:
+            # CRC-pinned replay: folding a torn link would LAUNDER the
+            # corruption into a snapshot that then verifies clean
+            if not verify_snapshot(link):
+                raise DeltaLineageError(
+                    f"refusing to compact over torn chain link {link!r}"
+                )
+        scratch.load(links[0])
+        for link in links[1:]:
+            scratch.apply_delta(link)
+        _fault_fire("ckpt.compact")  # window: folded in memory, unpublished
+        comp_dir = os.path.join(day, f"compact-{n:04d}")
+        self._publish_snapshot(scratch.save_base, comp_dir)
+        _fault_fire("ckpt.compact")  # window: published, cursor stale
+        # re-read: the chain may have grown while we folded — the fold
+        # still covers exactly n, the tail stays as deltas
+        cur = self.cursor() or cur
+        self._write_cursor(
+            cur["date"], cur["delta_idx"], cur.get("dense"), compact=n
+        )
+        STAT_ADD("ckpt_compactions")
+        return comp_dir
+
     # ---- resume ----------------------------------------------------------
 
     def _consistent_state(self, cur: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -456,10 +576,22 @@ class CheckpointManager:
         reachable from it (possibly a shorter delta chain), or None when
         even the base is torn/missing."""
         day = self._day(cur["date"])
-        if not verify_snapshot(os.path.join(day, "base")):
+        # a verified compact fold substitutes for the chain PREFIX it
+        # covers, so it rescues states the classic walk cannot reach: a
+        # torn base, or a torn mid-chain delta <= covers. When both paths
+        # are whole they load bitwise-identical state (compact invariant);
+        # the fold is preferred because it applies fewer links.
+        covers = int(cur.get("compact") or 0)
+        comp_ok = covers >= 1 and verify_snapshot(
+            os.path.join(day, f"compact-{covers:04d}")
+        )
+        if comp_ok:
+            m = covers
+        elif verify_snapshot(os.path.join(day, "base")):
+            m = 0
+        else:
             return None
-        m = 0
-        for i in range(1, cur["delta_idx"] + 1):
+        for i in range(m + 1, cur["delta_idx"] + 1):
             if not verify_snapshot(os.path.join(day, f"delta-{i:04d}")):
                 break  # deltas apply in order: a torn link truncates the chain
             m = i
@@ -473,7 +605,7 @@ class CheckpointManager:
                 if os.path.exists(os.path.join(day, name)):
                     dense = name
                     break
-        return {
+        state = {
             "date": cur["date"],
             "delta_idx": m,
             "dense": dense,
@@ -482,6 +614,11 @@ class CheckpointManager:
             # predates the last ownership flip (membership.py)
             "ownership_epoch": int(cur.get("ownership_epoch", 0)),
         }
+        if comp_ok:
+            # load compact-NNNN in place of base + delta-0001..NNNN;
+            # absent when no verified fold is in play
+            state["compact"] = covers
+        return state
 
     def resume(self, table: HostSparseTable, trainer=None) -> Optional[Dict[str, Any]]:
         """Rebuild the newest durable state into ``table`` (+ trainer dense).
@@ -520,9 +657,16 @@ class CheckpointManager:
                     "failed manifest verification"
                 )
         day = self._day(state["date"])
+        comp = int(state.get("compact") or 0)
         _fault_fire("checkpoint.load")
-        table.load(os.path.join(day, "base"))
-        for i in range(1, state["delta_idx"] + 1):
+        if comp >= 1:
+            # the fold is a full kind="base" snapshot of base+delta-0001..
+            # comp — bitwise-equal to replaying that prefix, loaded in one
+            table.load(os.path.join(day, f"compact-{comp:04d}"))
+            STAT_ADD("ckpt_compact_resumes")
+        else:
+            table.load(os.path.join(day, "base"))
+        for i in range(comp + 1, state["delta_idx"] + 1):
             _fault_fire("checkpoint.load")
             table.apply_delta(os.path.join(day, f"delta-{i:04d}"))
         # per-save dense file named in the cursor; "dense.npz" is the

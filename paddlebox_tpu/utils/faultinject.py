@@ -162,6 +162,33 @@ Catalog of wired sites (see docs/ROBUSTNESS.md for the recovery matrix):
                             serving bitwise, and the healed retry commits
                             the same version+tier bitwise
                             (tests/test_serve_shard.py pins it)
+    stream.tail_read        train/stream.py  DirectoryTailer.poll, before
+                            each append-only file's new byte range is read
+                            — an injected failure is an unreadable tail
+                            chunk: the file's cursor position does not
+                            advance (counted under stream.tail_read_errors)
+                            and the next poll re-reads the SAME bytes, so
+                            a transient read flake never drops a record
+    stream.cut_publish      train/stream.py  StreamSupervisor._cut, twice
+                            per micro-pass cut (hit counts select a crash
+                            window): after the cut intent + spool are
+                            durable but before the pass trains/publishes,
+                            and after the delta published but before the
+                            stream cursor commits — the recovery contract
+                            is exactly-once: a restart replays the durable
+                            spool when the delta never published, and
+                            rolls the cursor forward without retraining
+                            when it did (zero records lost or replayed,
+                            tests/test_stream.py pins both windows)
+    ckpt.compact            train/checkpoint.py  CheckpointManager.compact,
+                            three windows (nothing read yet / chain folded
+                            into the scratch table but unpublished /
+                            compact dir published but cursor stale) — a
+                            crash in ANY window leaves the old base+delta
+                            chain untouched and fully servable bitwise
+                            (the compact dir publishes via the same
+                            tmp+rename discipline as every snapshot), and
+                            the healed retry folds the same chain bitwise
 
 A site fires via :func:`fire`; when no plan is installed that is a single
 global read, so production paths pay nothing. Tests install a
@@ -221,6 +248,9 @@ KNOWN_SITES = (
     "serve.fleet_stage",
     "serve.drain",
     "serve.tier_build",
+    "stream.tail_read",
+    "stream.cut_publish",
+    "ckpt.compact",
 )
 
 

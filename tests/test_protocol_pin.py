@@ -148,6 +148,62 @@ def test_runtime_control_tags_are_covered_by_extraction(pkg_model):
     )
 
 
+def test_stream_boundary_tags_are_covered_by_extraction(pkg_model):
+    """Run the streaming micro-pass boundary rounds (cut + confirm, the
+    PR 20 vocabulary) live on a 2-rank cluster and check every control
+    tag against the static extraction — same contract as the membership
+    capture above."""
+    from paddlebox_tpu.train.stream import stream_cut_round, stream_confirm_round
+    from paddlebox_tpu.train.supervisor import EpochCoordinator
+
+    eps = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+    tps = [TcpTransport(r, eps, timeout=30.0) for r in range(2)]
+    seen = set()
+    lock = threading.Lock()
+    for tp in tps:
+        orig = tp.send
+
+        def send(dst, tag, payload, _orig=orig):
+            with lock:
+                seen.add(tag)
+            return _orig(dst, tag, payload)
+
+        tp.send = send
+
+    def run(rank):
+        tp = tps[rank]
+        try:
+            coord = EpochCoordinator(tp)
+            ok, _ = stream_cut_round(coord, 1)
+            assert ok
+            ok, _ = stream_confirm_round(coord, 1)
+            assert ok
+            # epoch fencing: the round after a revert rides the bumped
+            # suffix, exactly like every other verdict exchange
+            coord.advance()
+            ok, _ = stream_cut_round(coord, 2)
+            assert ok
+            tp.barrier("stream-pin-done")
+        finally:
+            tp.close()
+
+    _run_ranks(run, 2)
+
+    control = {t for t in seen if t.startswith(CONTROL_PREFIXES)}
+    for family in ("ctl:verdict:stream-cut:", "ctl:verdict:stream-confirm:"):
+        assert any(t.startswith(family) for t in control), (
+            f"round exercise produced no {family!r} frames: {sorted(seen)}"
+        )
+    assert any(t.startswith("ctl:verdict:stream-cut:2@e1") for t in control), (
+        f"epoch fence missing from the post-advance cut round: {sorted(seen)}"
+    )
+    uncovered = sorted(t for t in control if not pkg_model.covers_tag(t))
+    assert not uncovered, (
+        "runtime stream tags unknown to analysis/protocol.py "
+        f"(extend the extractor or fix the tag): {uncovered}"
+    )
+
+
 # ---- stat-name drift --------------------------------------------------------
 
 

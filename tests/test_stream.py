@@ -1,0 +1,438 @@
+"""Streaming plane: tail-follow ingestion, crash-safe cuts, compaction.
+
+Pins the recovery CONTRACTS of the PR 20 streaming tentpole
+(train/stream.py + CheckpointManager.compact), not just that code runs:
+
+- partial-tail holdback: an incomplete last line of a still-appending file
+  is held for the next poll, never consumed torn or quarantined;
+- ``stream.tail_read`` (FLT008): a failed tail read holds the position —
+  the healed retry re-reads the same bytes, zero records lost;
+- ``stream.cut_publish`` (FLT008): a crash in EITHER cut window recovers
+  exactly-once — the restarted stream's table is bitwise-identical to an
+  uninterrupted twin (no record dropped, none replayed);
+- ``ckpt.compact`` (FLT008): a crash in any compact window leaves the old
+  chain servable bitwise, and the healed retry folds bitwise;
+- compacted-chain resume and follower catch-up are bitwise-equal to the
+  uncompacted chain;
+- streaming-off ablation: the classic file-list pass mode over the same
+  records is bitwise-identical to the streamed cuts;
+- a forced mid-stream ownership re-anchor pauses the cut, re-anchors on a
+  fresh base, and resumes from the cursor (digest equal to a no-flip twin);
+- backlog past budget stretches cadence (``stream.backlog_stretches``)
+  instead of crashing, and shrinks back when the backlog drains.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+import optax
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from paddlebox_tpu import config
+from paddlebox_tpu.data import BoxPSDataset, SlotInfo, SlotSchema
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.serve.follower import Follower, apply_published_chain
+from paddlebox_tpu.table import (
+    HostSparseTable,
+    SparseOptimizerConfig,
+    ValueLayout,
+)
+from paddlebox_tpu.train import CheckpointManager, CTRTrainer, TrainStepConfig
+from paddlebox_tpu.train.stream import (
+    DirectoryTailer,
+    StreamLineageError,
+    StreamSupervisor,
+)
+from paddlebox_tpu.train.supervisor import HealthGates, PassSupervisor
+from paddlebox_tpu.utils.faultinject import InjectedFault, fail_nth, inject
+from paddlebox_tpu.utils.monitor import STAT_GET, STAT_HIST
+
+S, B = 4, 16
+DATE = "20260807"
+LAYOUT = ValueLayout(embedx_dim=4)
+OPT = SparseOptimizerConfig(
+    embedx_threshold=0.0, show_clk_decay=0.97, shrink_threshold=0.0
+)
+SCHEMA = SlotSchema(
+    [SlotInfo("label", type="float", dense=True, dim=1)]
+    + [SlotInfo(f"s{i}") for i in range(S)],
+    label_slot="label",
+)
+
+
+def _digest(table) -> str:
+    """sha256 over the key-sorted full snapshot: bitwise table identity."""
+    k = np.sort(table.keys())
+    v = table.pull_or_create(k)
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(k).tobytes())
+    h.update(np.ascontiguousarray(v).tobytes())
+    return h.hexdigest()
+
+
+def _build(root):
+    table = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    ds = BoxPSDataset(SCHEMA, table, batch_size=B, shuffle_mode="none")
+    cfg = TrainStepConfig(
+        num_slots=S, batch_size=B, layout=LAYOUT, sparse_opt=OPT,
+        auc_buckets=100,
+    )
+    model = DeepFM(S, LAYOUT.pull_width, LAYOUT.embedx_dim, hidden=(8,))
+    tr = CTRTrainer(model, cfg, dense_opt=optax.adam(1e-2))
+    tr.init_params(jax.random.PRNGKey(0))
+    mgr = CheckpointManager(str(root))
+    # micro-passes are tiny by construction: the trailing-AUC gate has no
+    # signal at this scale (same knob chaos_probe uses)
+    sup = PassSupervisor(
+        ds, tr, checkpoint=mgr, gates=HealthGates(auc_min_history=99)
+    )
+    return table, tr, mgr, sup
+
+
+def _chunk_lines(rng, rows, lo):
+    lines = []
+    for _ in range(rows):
+        keys = rng.integers(lo, lo + 200, S)
+        lines.append(
+            f"1 {float(keys[0] % 2)} " + " ".join(f"1 {k}" for k in keys)
+        )
+    return lines
+
+
+def _append(stream_dir, name, lines, partial=None):
+    # fixture writer emulating the upstream log appender
+    # pbox-lint: disable=IO004
+    with open(os.path.join(str(stream_dir), name), "a") as f:
+        f.write("\n".join(lines) + "\n")
+        if partial is not None:
+            f.write(partial)  # mid-record flush: no trailing newline
+        f.flush()
+
+
+CHUNKS = [(24, 0), (24, 100), (24, 200), (24, 300)]
+
+
+def _stream_leg(root, stream_dir, chunks=CHUNKS, compact_every=0, seed=7):
+    """Uninterrupted streaming run: one appended chunk per step()."""
+    table, tr, mgr, sup = _build(root)
+    st = StreamSupervisor(
+        sup, str(stream_dir), DATE, pattern="*.txt",
+        compact_every=compact_every,
+    )
+    rng = np.random.default_rng(seed)
+    for rows, lo in chunks:
+        _append(stream_dir, "a.txt", _chunk_lines(rng, rows, lo))
+        assert st.step() is not None
+    return table, mgr, st
+
+
+# ---------------------------------------------------------------------------
+# DirectoryTailer: partial-tail holdback + append-only verification
+
+
+def test_partial_tail_line_held_back_not_quarantined(tmp_path):
+    t = DirectoryTailer(str(tmp_path), pattern="*.txt")
+    _append(tmp_path, "a.txt", ["rec-1", "rec-2"], partial="rec-3-torn-prefi")
+    lines, _ = t.poll()
+    # only the COMPLETE lines came out; the torn record stayed private
+    assert lines == ["rec-1", "rec-2"]
+    off = t.positions["a.txt"]["offset"]
+    assert off == len(b"rec-1\nrec-2\n")
+    # a poll while the writer is still mid-flush consumes nothing
+    assert t.poll()[0] == []
+    # the writer finishes the record (and appends another): the ONCE-torn
+    # line arrives whole, exactly once
+    # pbox-lint: disable=IO004
+    with open(tmp_path / "a.txt", "a") as f:
+        f.write("x\nrec-4\n")
+    lines, _ = t.poll()
+    assert lines == ["rec-3-torn-prefix", "rec-4"]
+
+
+def test_tailer_resume_detects_rewritten_history(tmp_path):
+    t = DirectoryTailer(str(tmp_path), pattern="*.txt")
+    _append(tmp_path, "a.txt", ["rec-1", "rec-2"])
+    t.poll()
+    cursor = t.snapshot_positions()
+    # same-length rewrite of consumed bytes: offset still fits, CRC must not
+    # pbox-lint: disable=IO004
+    with open(tmp_path / "a.txt", "w") as f:
+        f.write("REC-1\nREC-2\n")
+    t2 = DirectoryTailer(str(tmp_path), pattern="*.txt")
+    with pytest.raises(StreamLineageError):
+        t2.resume(cursor)
+
+
+# ---------------------------------------------------------------------------
+# stream.tail_read (FLT008): a failed read holds the position — the healed
+# retry re-reads the SAME bytes, so a transient I/O error costs latency,
+# never records.
+
+
+def test_tail_read_fault_holds_position_zero_loss(tmp_path):
+    t = DirectoryTailer(str(tmp_path), pattern="*.txt")
+    _append(tmp_path, "a.txt", ["rec-1", "rec-2"])
+    with inject(fail_nth("stream.tail_read", 1)) as plan:
+        errs0 = STAT_GET("stream.tail_read_errors")
+        lines, _ = t.poll()
+        assert plan.failures("stream.tail_read") == 1
+        assert lines == []  # the read failed: nothing consumed
+        assert t.positions["a.txt"]["offset"] == 0  # position held
+        assert STAT_GET("stream.tail_read_errors") == errs0 + 1
+        # healed retry (same plan): the SAME bytes come out — zero loss
+        lines, _ = t.poll()
+        assert lines == ["rec-1", "rec-2"]
+
+
+# ---------------------------------------------------------------------------
+# stream.cut_publish (FLT008): crash in either cut window, restart from
+# disk, and the table is bitwise-identical to an uninterrupted twin.
+# Window 1 (hit 1): intent durable, nothing trained -> the restart replays
+# the durable spool (zero loss). Window 2 (hit 2): delta published, stream
+# cursor stale -> the restart finalizes WITHOUT retraining (zero dup).
+
+
+@pytest.mark.parametrize("hit,stat", [(1, "stream.replays"),
+                                      (2, "stream.replays_skipped")])
+def test_cut_crash_window_recovers_exactly_once(tmp_path, hit, stat):
+    clean_root, clean_stream = tmp_path / "c", tmp_path / "cs"
+    kill_root, kill_stream = tmp_path / "k", tmp_path / "ks"
+    for d in (clean_root, clean_stream, kill_root, kill_stream):
+        d.mkdir()
+    clean_table, _, _ = _stream_leg(clean_root, clean_stream)
+
+    table, tr, mgr, sup = _build(kill_root)
+    st = StreamSupervisor(sup, str(kill_stream), DATE, pattern="*.txt",
+                          compact_every=0)
+    rng = np.random.default_rng(7)
+    for i, (rows, lo) in enumerate(CHUNKS):
+        _append(kill_stream, "a.txt", _chunk_lines(rng, rows, lo))
+        if i == 1:
+            with inject(fail_nth("stream.cut_publish", hit)) as plan:
+                with pytest.raises(InjectedFault):
+                    st.step()
+                assert plan.failures("stream.cut_publish") == 1
+            before = STAT_GET(stat)
+            # "restart": rebuild the whole stack from durable state only
+            table, tr, mgr, sup = _build(kill_root)
+            mgr.resume(table, tr)
+            st = StreamSupervisor(sup, str(kill_stream), DATE,
+                                  pattern="*.txt", compact_every=0)
+            assert STAT_GET(stat) == before + 1
+            continue  # the crashed cut's records are recovered, not re-cut
+        st.step()
+    assert st.cut_seq == len(CHUNKS)
+    assert _digest(table) == _digest(clean_table)
+    # and the published chain agrees with the live table
+    ft = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    apply_published_chain(str(kill_root), ft)
+    assert _digest(ft) == _digest(clean_table)
+
+
+# ---------------------------------------------------------------------------
+# ckpt.compact (FLT008): a crash in ANY compact window leaves the old
+# chain servable bitwise; the healed retry folds bitwise.
+
+
+@pytest.mark.parametrize("hit", [1, 2, 3])
+def test_compact_crash_leaves_old_chain_servable_bitwise(tmp_path, hit):
+    root, stream = tmp_path / "r", tmp_path / "s"
+    root.mkdir(); stream.mkdir()
+    table, mgr, st = _stream_leg(root, stream, compact_every=0)
+    want = _digest(table)
+    with inject(fail_nth("ckpt.compact", hit)) as plan:
+        with pytest.raises(InjectedFault):
+            st.mgr.compact(
+                DATE, HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+            )
+        assert plan.failures("ckpt.compact") == 1
+    # old chain still resumes bitwise (cursor never named a torn fold)
+    t2, _, mgr2, _ = _build(root)
+    state = mgr2.resume(t2)
+    assert _digest(t2) == want
+    # healed retry folds; the folded resume is bitwise-equal too
+    assert mgr.compact(
+        DATE, HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    ) is not None
+    t3, _, mgr3, _ = _build(root)
+    state = mgr3.resume(t3)
+    assert int(state.get("compact") or 0) == len(CHUNKS) - 1
+    assert _digest(t3) == want
+
+
+# ---------------------------------------------------------------------------
+# compaction invariants: compacted resume and follower catch-up are
+# bitwise-equal to the uncompacted chain, and catch-up applies O(tail).
+
+
+def test_compacted_chain_bitwise_and_catchup_bounded(tmp_path):
+    plain_root, plain_stream = tmp_path / "p", tmp_path / "ps"
+    comp_root, comp_stream = tmp_path / "c", tmp_path / "cs"
+    for d in (plain_root, plain_stream, comp_root, comp_stream):
+        d.mkdir()
+    plain_table, plain_mgr, _ = _stream_leg(plain_root, plain_stream)
+    comp_table, comp_mgr, _ = _stream_leg(
+        comp_root, comp_stream, compact_every=3
+    )
+    want = _digest(plain_table)
+    assert _digest(comp_table) == want  # compaction never perturbs training
+    cur = comp_mgr.cursor()
+    covers = int(cur.get("compact") or 0)
+    assert covers == 3 and cur["delta_idx"] == len(CHUNKS) - 1
+
+    # trainer resume through the fold == uncompacted resume, bitwise
+    t_plain, _, m_plain, _ = _build(plain_root)
+    m_plain.resume(t_plain)
+    t_comp, _, m_comp, _ = _build(comp_root)
+    state = m_comp.resume(t_comp)
+    assert int(state.get("compact")) == covers
+    assert _digest(t_plain) == want and _digest(t_comp) == want
+
+    # follower catch-up fast-forwards through the fold: one compact load
+    # + the post-fold tail, not the whole minute-level chain
+    ff0 = STAT_GET("serve.compact_fastforwards")
+    ft = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    pos = apply_published_chain(str(comp_root), ft)
+    assert STAT_GET("serve.compact_fastforwards") == ff0 + 1
+    assert pos["delta_idx"] == cur["delta_idx"]
+    assert _digest(ft) == want
+
+    # a polling Follower takes the same fast path: commits = 1 (fold head)
+    # + tail deltas, far fewer than the chain length as the day grows
+    fol = Follower(str(comp_root), LAYOUT, OPT, n_host_shards=2)
+    applies0 = STAT_GET("serve.applies")
+    assert fol.poll_once()
+    applies = STAT_GET("serve.applies") - applies0
+    assert applies == (cur["delta_idx"] - covers) + 1
+    # the stream watermark stamps freshness at the chain-head commit
+    hist = STAT_HIST("serve.freshness_s")
+    assert hist is not None and hist.count > 0
+
+
+# ---------------------------------------------------------------------------
+# streaming-off ablation: the classic file-list pass mode over the same
+# records is bitwise-identical to the streamed cuts.
+
+
+def test_streaming_off_ablation_bitwise(tmp_path):
+    s_root, s_stream = tmp_path / "s", tmp_path / "ss"
+    c_root = tmp_path / "c"
+    for d in (s_root, s_stream, c_root):
+        d.mkdir()
+    s_table, _, _ = _stream_leg(s_root, s_stream)
+
+    # classic mode: one file per pass, save_base then save_delta — the
+    # exact records each cut spooled, replayed as a file list
+    table, tr, mgr, sup = _build(c_root)
+    rng = np.random.default_rng(7)
+    for i, (rows, lo) in enumerate(CHUNKS):
+        path = str(c_root / f"pass-{i}.txt")
+        # pbox-lint: disable=IO004
+        with open(path, "w") as f:
+            f.write("\n".join(_chunk_lines(rng, rows, lo)) + "\n")
+        sup.run_pass([path], date=DATE, save="base" if i == 0 else "delta")
+    assert _digest(table) == _digest(s_table)
+
+
+# ---------------------------------------------------------------------------
+# elastic composition: a forced ownership re-anchor mid-stream pauses the
+# cut, re-anchors on a fresh base under the new epoch, and the stream
+# resumes from its cursor — digest equal to a twin that never flipped.
+
+
+def test_forced_reanchor_mid_stream_resumes_from_cursor(tmp_path):
+    plain_root, plain_stream = tmp_path / "p", tmp_path / "ps"
+    flip_root, flip_stream = tmp_path / "f", tmp_path / "fs"
+    for d in (plain_root, plain_stream, flip_root, flip_stream):
+        d.mkdir()
+    plain_table, _, _ = _stream_leg(plain_root, plain_stream)
+
+    table, tr, mgr, sup = _build(flip_root)
+    st = StreamSupervisor(sup, str(flip_stream), DATE, pattern="*.txt",
+                          compact_every=0)
+    rng = np.random.default_rng(7)
+    for i, (rows, lo) in enumerate(CHUNKS):
+        if i == 2:
+            # ownership flip lands between cuts (what the elastic death/
+            # join handlers do): the next save must re-anchor, not extend
+            mgr.ownership_epoch += 1
+            sup._force_base = True
+        _append(flip_stream, "a.txt", _chunk_lines(rng, rows, lo))
+        st.step()
+    cur = mgr.cursor()
+    # cut 3 re-anchored: a fresh base (delta_idx counts from 0 again)
+    # under the new epoch, then cut 4 extended it as delta-0001
+    assert int(cur["ownership_epoch"]) == 1
+    assert cur["delta_idx"] == 1
+    assert st.cut_seq == len(CHUNKS)  # no cut lost to the flip
+    assert _digest(table) == _digest(plain_table)
+    # the published chain under the new epoch is followable end-to-end
+    ft = HostSparseTable(LAYOUT, OPT, n_shards=2, seed=0)
+    pos = apply_published_chain(str(flip_root), ft)
+    assert pos["ownership_epoch"] == 1
+    assert _digest(ft) == _digest(plain_table)
+
+
+# ---------------------------------------------------------------------------
+# backlog degradation: cuts that overrun the budget stretch the cadence
+# (counted), capped at the flag, and shrink back once the backlog drains.
+
+
+def test_backlog_stretches_cadence_and_recovers(tmp_path):
+    import threading
+
+    root, stream = tmp_path / "r", tmp_path / "s"
+    root.mkdir(); stream.mkdir()
+    table, tr, mgr, sup = _build(root)
+    clk = {"t": 0.0}
+    st = StreamSupervisor(
+        sup, str(stream), DATE, pattern="*.txt",
+        micro_pass_s=1.0, poll_interval_s=0.25, compact_every=0,
+        clock=lambda: clk["t"],
+    )
+    # every cut "takes" 3x its window: _train_publish is wrapped to charge
+    # fake time, simulating ingest backlog without wall-clock sleeps
+    real_tp = st._train_publish
+
+    def slow_tp(*a, **kw):
+        out = real_tp(*a, **kw)
+        clk["t"] += 3.0 * st.micro_pass_s * st._stretch
+        return out
+
+    st._train_publish = slow_tp
+    rng = np.random.default_rng(7)
+    stop = threading.Event()
+
+    def sleep_fn(dt):
+        clk["t"] += max(dt, 0.05)
+        if st.cut_seq >= 3:
+            stop.set()
+        else:  # the upstream appender outruns the (slow) cuts
+            _append(stream, "a.txt", _chunk_lines(rng, 16, 100 * st.cut_seq))
+
+    before = STAT_GET("stream.backlog_stretches")
+    st.run(stop, sleep=sleep_fn)
+    assert st.cut_seq >= 3
+    assert STAT_GET("stream.backlog_stretches") > before
+    assert st._stretch <= float(config.get_flag("stream_backlog_max_stretch"))
+    # drained: fast cuts shrink the window back toward the budget
+    stretched = st._stretch
+    assert stretched > 1.0
+    st._train_publish = real_tp
+    stop2 = threading.Event()
+    goal = st.cut_seq + 2
+
+    def sleep_fast(dt):
+        clk["t"] += max(dt, 0.05)
+        if st.cut_seq >= goal:
+            stop2.set()
+        else:
+            _append(stream, "a.txt", _chunk_lines(rng, 16, 900 + st.cut_seq))
+
+    st.run(stop2, sleep=sleep_fast)
+    assert st._stretch < stretched

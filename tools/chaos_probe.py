@@ -59,6 +59,7 @@ AUC must be bitwise-equal to a FRESH (N-1)-rank run of the same day:
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import sys
@@ -454,6 +455,170 @@ def run_serve(args):
         "parity_after_repair_bitwise": bool(recovered),
         "ok": bool(ok),
     }
+    print(json.dumps(report, indent=None if args.json else 2))
+    return 0 if ok else 1
+
+
+def run_stream(args):
+    """Streaming-plane fault sweep (``--stream``): seeded faults on ALL
+    THREE streaming sites — ``stream.tail_read`` (read error holds the
+    cursor, zero loss), ``stream.cut_publish`` (kill in the durable-intent
+    window, restart replays the spool exactly once), and ``ckpt.compact``
+    (kill mid-fold leaves the old chain servable; the healed retry folds
+    bitwise). Every site must FIRE, and the final table must be
+    bitwise-identical to an uninterrupted clean twin over the same
+    records.
+
+      JAX_PLATFORMS=cpu python tools/chaos_probe.py --stream [--json]
+    """
+    import serve_soak
+
+    from paddlebox_tpu.table import HostSparseTable
+    from paddlebox_tpu.train.stream import StreamSupervisor
+    from paddlebox_tpu.train.supervisor import HealthGates, PassSupervisor
+    from paddlebox_tpu.utils.faultinject import InjectedFault, fail_nth, inject
+    from paddlebox_tpu.utils.monitor import STAT_GET
+
+    date = serve_soak.DATE
+    chunks = 4
+
+    def digest(table):
+        k = np.sort(table.keys())
+        v = table.pull_or_create(k)
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(k).tobytes())
+        h.update(np.ascontiguousarray(v).tobytes())
+        return h.hexdigest()
+
+    def build(root, stream_dir, resume=False):
+        table, ds, cfg, trainer, mgr = serve_soak.make_stack(root)
+        sup = PassSupervisor(
+            ds, trainer, checkpoint=mgr,
+            gates=HealthGates(auc_min_history=99),
+        )
+        if resume:
+            mgr.resume(table, trainer)  # before recovery replays the spool
+        st = StreamSupervisor(
+            sup, stream_dir, date, pattern="*.txt", compact_every=0,
+        )
+        return table, trainer, mgr, st
+
+    def append(stream_dir, rng, lo):
+        lines = []
+        for _ in range(args.rows):
+            keys = rng.integers(lo, lo + 200, 4)
+            lines.append(
+                f"1 {float(keys[0] % 2)} " + " ".join(f"1 {k}" for k in keys)
+            )
+        # the upstream appender the tailer follows
+        # pbox-lint: disable=IO004
+        with open(os.path.join(stream_dir, "events.txt"), "a") as f:
+            f.write("\n".join(lines) + "\n")
+
+    fired = {}
+    with tempfile.TemporaryDirectory() as tmpdir:
+        clean_root = os.path.join(tmpdir, "clean-ckpt")
+        clean_stream = os.path.join(tmpdir, "clean-stream")
+        root = os.path.join(tmpdir, "ckpt")
+        stream_dir = os.path.join(tmpdir, "stream")
+        os.makedirs(clean_stream)
+        os.makedirs(stream_dir)
+
+        rng = np.random.default_rng(args.seed)
+        clean_table, _, _, clean_st = build(clean_root, clean_stream)
+        for c in range(chunks):
+            append(clean_stream, rng, 1 + c * 120)
+            clean_st.step()
+        want = digest(clean_table)
+
+        rng = np.random.default_rng(args.seed)  # same records, faulted leg
+        table, trainer, mgr, st = build(root, stream_dir)
+
+        # site 1: a transient read error holds the cursor — the healed
+        # retry consumes the SAME bytes (latency, never records)
+        append(stream_dir, rng, 1)
+        with inject(fail_nth("stream.tail_read", 1)) as plan:
+            no_cut = st.step()  # read swallowed, nothing consumed
+            fired["stream.tail_read"] = plan.failures("stream.tail_read")
+        tail_held = no_cut is None
+        st.step()  # healed: the chunk cuts now
+
+        # site 2: kill in the durable-intent window; the restart stack
+        # must replay the spool exactly once
+        append(stream_dir, rng, 121)
+        replays0 = STAT_GET("stream.replays")
+        with inject(fail_nth("stream.cut_publish", 1)) as plan:
+            try:
+                st.step()
+                cut_killed = False
+            except InjectedFault:
+                cut_killed = True
+            fired["stream.cut_publish"] = plan.failures("stream.cut_publish")
+        table, trainer, mgr, st = build(root, stream_dir, resume=True)
+        replayed = int(STAT_GET("stream.replays") - replays0)
+
+        for c in range(2, chunks):
+            append(stream_dir, rng, 1 + c * 120)
+            st.step()
+
+        # site 3: kill mid-fold — the cursor never names a torn fold, so
+        # the old chain resumes bitwise; the healed retry folds bitwise
+        with inject(fail_nth("ckpt.compact", 2)) as plan:
+            try:
+                mgr.compact(
+                    date,
+                    HostSparseTable(
+                        serve_soak.LAYOUT, serve_soak.OPT, n_shards=4, seed=0
+                    ),
+                )
+                compact_killed = False
+            except InjectedFault:
+                compact_killed = True
+            fired["ckpt.compact"] = plan.failures("ckpt.compact")
+        from paddlebox_tpu.train import CheckpointManager
+
+        t_held = HostSparseTable(
+            serve_soak.LAYOUT, serve_soak.OPT, n_shards=4, seed=0
+        )
+        CheckpointManager(root).resume(t_held)
+        held_bitwise = digest(t_held) == digest(table)
+        folded = mgr.compact(
+            date,
+            HostSparseTable(
+                serve_soak.LAYOUT, serve_soak.OPT, n_shards=4, seed=0
+            ),
+        ) is not None
+        t_comp = HostSparseTable(
+            serve_soak.LAYOUT, serve_soak.OPT, n_shards=4, seed=0
+        )
+        state = CheckpointManager(root).resume(t_comp)
+
+        ok = (
+            all(n >= 1 for n in fired.values())
+            and tail_held
+            and cut_killed
+            and replayed == 1
+            and compact_killed
+            and held_bitwise
+            and folded
+            and int(state.get("compact") or 0) == chunks - 1
+            and digest(table) == want
+            and digest(t_comp) == want
+        )
+        report = {
+            "mode": "stream",
+            "sites_fired": fired,
+            "tail_read_held_cursor": bool(tail_held),
+            "cut_publish_killed": bool(cut_killed),
+            "spool_replays": replayed,
+            "compact_killed": bool(compact_killed),
+            "old_chain_held_bitwise": bool(held_bitwise),
+            "healed_fold_published": bool(folded),
+            "compact_covers": int(state.get("compact") or 0),
+            "final_bitwise_vs_clean": bool(digest(table) == want),
+            "compacted_resume_bitwise": bool(digest(t_comp) == want),
+            "ok": bool(ok),
+        }
     print(json.dumps(report, indent=None if args.json else 2))
     return 0 if ok else 1
 
@@ -1845,6 +2010,11 @@ def main(argv=None):
                          "fixpoint with zero invariant violations and "
                          "every broken variant must be caught on its "
                          "invariant (tools/proto_check.py)")
+    ap.add_argument("--stream", action="store_true",
+                    help="streaming-plane fault sweep: seeded faults on "
+                         "stream.tail_read, stream.cut_publish and "
+                         "ckpt.compact (all must fire), recovery bitwise "
+                         "vs an uninterrupted clean twin")
     ap.add_argument("--json", action="store_true", help="machine output only")
     args = ap.parse_args(argv)
 
@@ -1860,6 +2030,8 @@ def main(argv=None):
         return run_serve_shard(args)
     if args.serve_fleet:
         return run_serve_fleet(args)
+    if args.stream:
+        return run_stream(args)
     if args.serve:
         return run_serve(args)
     if args.wedge_backend:

@@ -68,6 +68,13 @@ MODEL_TAGS = {
     "join_announce": "ctl:join:announce",
     "join_offer": "ctl:join:offer:",
     "migrate": "migrate:",
+    # streaming micro-pass boundary (train/stream.py): the cut and confirm
+    # rounds are verdict-family exchanges (epoch-fenced allgathers), so
+    # the vote/deliver/decide transitions of this model cover them — the
+    # single-rank durability half (two-phase stream cursor) is pinned by
+    # the FLT008 crash-window tests in tests/test_stream.py instead.
+    "stream_cut": "ctl:verdict:stream-cut:",
+    "stream_confirm": "ctl:verdict:stream-confirm:",
 }
 
 MapT = namedtuple("MapT", "epoch ranges")  # ranges: ((owner, lo, hi), ...)

@@ -753,6 +753,241 @@ def _run_fleet_soak(workdir, root, stage_dir, rng, n_followers, passes, rows, qp
     return report
 
 
+def run_stream_soak(
+    workdir, cuts=8, rows=120, compact_every=4, qps=30.0, probe_n=16
+):
+    """Streaming freshness soak (the PR 20 acceptance gate): two legs over
+    the same appended record stream.
+
+    - **reference leg**: an uninterrupted StreamSupervisor consumes the
+      stream (one cut per appended chunk) — its final table digest is the
+      exactly-once oracle.
+    - **chaos leg**: the same stream with a follower serving score traffic
+      concurrently (freshness sampled at every chain-head commit) while
+      the streaming supervisor is KILLED twice mid-soak — once in each
+      ``stream.cut_publish`` crash window — and restarted from durable
+      state only (checkpoint resume + stream cursor recovery). Zero
+      records may be lost or duplicated: the digest must match the
+      reference bitwise. Compaction runs every ``compact_every`` cuts;
+      after the day a FRESH follower must catch up through the fold in
+      O(post-fold tail) applies, not O(chain).
+
+    Report: digests + bitwise verdict, recovery counters (one replay, one
+    replay-skip), ``serve.freshness_s`` p50/p99, catch-up bound, and the
+    checkpoint root (``obs/`` under it carries the metric series the
+    ``obs_report --slo`` gate reads).
+    """
+    from paddlebox_tpu.serve.follower import apply_published_chain
+    from paddlebox_tpu.train.stream import StreamSupervisor
+    from paddlebox_tpu.train.supervisor import HealthGates, PassSupervisor
+    from paddlebox_tpu.utils.faultinject import InjectedFault, fail_nth, inject
+    from paddlebox_tpu.utils.monitor import STAT_HIST
+
+    def digest(table):
+        k = np.sort(table.keys())
+        v = table.pull_or_create(k)
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(k).tobytes())
+        h.update(np.ascontiguousarray(v).tobytes())
+        return h.hexdigest()
+
+    def chunk_lines(rng, lo):
+        lines = []
+        for _ in range(rows):
+            keys = rng.integers(lo, lo + 200, S)
+            lines.append(
+                f"1 {float(keys[0] % 2)} " + " ".join(f"1 {k}" for k in keys)
+            )
+        return lines
+
+    def append(stream_dir, lines):
+        # the upstream log appender the tailer follows
+        # pbox-lint: disable=IO004
+        with open(os.path.join(stream_dir, "events.txt"), "a") as f:
+            f.write("\n".join(lines) + "\n")
+            f.flush()
+
+    def stream_stack(root, stream_dir, resume=False):
+        table, ds, cfg, trainer, mgr = make_stack(root)
+        sup = PassSupervisor(
+            ds, trainer, checkpoint=mgr,
+            gates=HealthGates(auc_min_history=99),  # micro-passes are tiny
+        )
+        if resume:
+            # restart path: the table/dense state must be restored BEFORE
+            # the stream supervisor runs cursor recovery (a spool replay
+            # trains on top of the resumed chain head)
+            mgr.resume(table, trainer)
+        st = StreamSupervisor(
+            sup, stream_dir, DATE, pattern="*.txt",
+            compact_every=compact_every,
+        )
+        return table, cfg, trainer, mgr, sup, st
+
+    # ---- reference leg: uninterrupted
+    ref_root = os.path.join(workdir, "ref-ckpt")
+    ref_stream = os.path.join(workdir, "ref-stream")
+    os.makedirs(ref_stream)
+    rng = np.random.default_rng(0)
+    ref_table, _, _, _, _, ref_st = stream_stack(ref_root, ref_stream)
+    for c in range(cuts):
+        append(ref_stream, chunk_lines(rng, 1 + c * 120))
+        ref_st.step()
+    ref_digest = digest(ref_table)
+
+    # ---- chaos leg: concurrent serve + two kill/restart cycles
+    root = os.path.join(workdir, "ckpt")
+    stream_dir = os.path.join(workdir, "stream")
+    os.makedirs(stream_dir)
+    rng = np.random.default_rng(0)  # same records as the reference leg
+    table, cfg, trainer, mgr, sup, st = stream_stack(root, stream_dir)
+    fol, scorer = make_follower(root, cfg)
+
+    stop = threading.Event()
+    poller = threading.Thread(
+        target=fol.run, args=(stop,), kwargs={"poll_interval_s": 0.02},
+        daemon=True,
+    )
+    poller.start()
+    srv = ScoreServer(fol, scorer, SCHEMA)
+    srv.start()
+    client_errors = []
+    requests_sent = [0]
+    # probe keys ride chunk 0 (same seed, same first draw): present in
+    # every published version, so a scored miss is a real serving fault
+    probe_lines = chunk_lines(np.random.default_rng(0), 1)[:probe_n]
+    probe = [parse_line(ln, SCHEMA) for ln in probe_lines]
+
+    def load_gen():
+        lg_rng = np.random.default_rng(1234)
+        period = 1.0 / qps
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            if fol.version().params is not None:
+                k = int(lg_rng.integers(0, probe_n - 8))
+                try:
+                    srv.score(probe[k : k + 8], timeout=30)
+                    requests_sent[0] += 1
+                except Exception as e:  # noqa: BLE001 — soak reports, not dies
+                    client_errors.append(repr(e))
+            left = period - (time.perf_counter() - t0)
+            if left > 0:
+                time.sleep(left)
+
+    clients = [threading.Thread(target=load_gen, daemon=True) for _ in range(2)]
+    for c in clients:
+        c.start()
+
+    # kill once in each cut crash window: cut 3 dies with the intent
+    # durable but untrained (recovery must REPLAY the spool — zero loss),
+    # cut 6 dies with the delta published but the stream cursor stale
+    # (recovery must SKIP the retrain — zero duplicates)
+    kills = {2: 1, 5: 2}  # chunk index -> cut_publish window (fault hit)
+    replays0 = STAT_GET("stream.replays")
+    skips0 = STAT_GET("stream.replays_skipped")
+    killed = []
+    for c in range(cuts):
+        append(stream_dir, chunk_lines(rng, 1 + c * 120))
+        window = kills.get(c)
+        if window is None:
+            st.step()
+            continue
+        try:
+            with inject(fail_nth("stream.cut_publish", window)):
+                st.step()
+            raise RuntimeError("injected kill did not fire")
+        except InjectedFault:
+            killed.append({"cut": c + 1, "window": window})
+        # restart: rebuild the entire producer stack from durable state
+        table, cfg, trainer, mgr, sup, st = stream_stack(
+            root, stream_dir, resume=True
+        )
+
+    # drain: the follower must reach the published chain head
+    head = mgr.cursor()
+    deadline = time.time() + 30
+    while fol.version().delta_idx < head["delta_idx"] and time.time() < deadline:
+        time.sleep(0.05)
+    time.sleep(0.2)
+    stop.set()
+    for c in clients:
+        c.join(timeout=10)
+    srv.stop()
+    poller.join(timeout=10)
+
+    chaos_digest = digest(table)
+    offline = HostSparseTable(LAYOUT, OPT, n_shards=4, seed=0)
+    pos = apply_published_chain(root, offline)
+    offline_digest = digest(offline)
+
+    # fresh-follower catch-up bound: the compact fold caps the applies at
+    # 1 (the fold) + the post-fold tail, independent of cuts-since-base
+    covers = int(head.get("compact") or 0)
+    ff0 = STAT_GET("serve.compact_fastforwards")
+    applies0 = STAT_GET("serve.applies")
+    late_fol, _ = make_follower(root, cfg)
+    late_fol.poll_once()
+    catchup_applies = STAT_GET("serve.applies") - applies0
+    fastforwarded = STAT_GET("serve.compact_fastforwards") - ff0
+
+    fresh = STAT_HIST("serve.freshness_s")
+    fresh_summary = (
+        fresh.summary((0.5, 0.99)) if fresh is not None else {"count": 0}
+    )
+    # capture the day's final counters + histograms (serve.freshness_s
+    # included) into the metric series obs_report's --slo gate reads
+    sup.metrics.snapshot("stream:final")
+
+    report = {
+        "mode": "stream",
+        "platform": jax.devices()[0].platform,
+        "cuts": cuts,
+        "rows_per_cut": rows,
+        "records_total": cuts * rows,
+        "compact_every": compact_every,
+        "kills": killed,
+        "recovery": {
+            "replays": STAT_GET("stream.replays") - replays0,
+            "replays_skipped": STAT_GET("stream.replays_skipped") - skips0,
+        },
+        "digest_reference": ref_digest,
+        "digest_chaos": chaos_digest,
+        "digest_offline_chain": offline_digest,
+        "bitwise": chaos_digest == ref_digest == offline_digest,
+        "chain": {
+            "delta_idx": int(head["delta_idx"]),
+            "compact_covers": covers,
+            "chain_len": int(head["delta_idx"]) + 1,
+        },
+        "catchup": {
+            "fresh_follower_applies": int(catchup_applies),
+            "compact_fastforwards": int(fastforwarded),
+            "bound": int(head["delta_idx"]) - covers + 1,
+        },
+        "freshness_s": fresh_summary,
+        "serving": {
+            "requests": requests_sent[0],
+            "client_errors": client_errors[:5],
+            "served_head": int(fol.version().delta_idx),
+        },
+        "backlog_stretches": STAT_GET("stream.backlog_stretches"),
+        "ckpt_root": root,
+        "ok": (
+            chaos_digest == ref_digest == offline_digest
+            and len(killed) == 2
+            and STAT_GET("stream.replays") - replays0 == 1
+            and STAT_GET("stream.replays_skipped") - skips0 == 1
+            and covers >= compact_every
+            and catchup_applies == int(head["delta_idx"]) - covers + 1
+            and fastforwarded == 1
+            and fresh_summary.get("count", 0) > 0
+            and not client_errors
+            and pos["delta_idx"] == int(head["delta_idx"])
+        ),
+    }
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--passes", type=int, default=6, help="publishes in the day (1 base + N-1 deltas)")
@@ -761,6 +996,9 @@ def main():
     ap.add_argument("--probe", type=int, default=32, help="probe records for the parity gate")
     ap.add_argument("--fleet", type=int, default=0, help="networked fleet size (0 = in-process single-follower soak)")
     ap.add_argument("--device-tier", action="store_true", help="mesh-sharded scoring A/B: host-only vs device-tier day + lookup microbench")
+    ap.add_argument("--stream", action="store_true", help="streaming micro-pass freshness soak: tail-follow day with two mid-soak kill/restart cycles + concurrent serve")
+    ap.add_argument("--cuts", type=int, default=8, help="micro-pass cuts in the streaming day (--stream)")
+    ap.add_argument("--compact-every", type=int, default=4, help="fold the delta chain every N cuts (--stream)")
     ap.add_argument("--bench-rows", type=int, default=500_000, help="synthetic version size for the lookup microbench")
     ap.add_argument("--bench-hot", type=int, default=65_536, help="hot rows held by the tier in the microbench")
     ap.add_argument("--bench-batch", type=int, default=8192, help="keys per lookup batch in the microbench")
@@ -769,7 +1007,13 @@ def main():
     args = ap.parse_args()
 
     with tempfile.TemporaryDirectory() as workdir:
-        if args.device_tier:
+        if args.stream:
+            report = run_stream_soak(
+                workdir, cuts=args.cuts, rows=args.rows,
+                compact_every=args.compact_every, qps=args.qps,
+                probe_n=args.probe,
+            )
+        elif args.device_tier:
             report = run_device_tier_ab(
                 workdir, passes=args.passes, rows=args.rows, qps=args.qps,
                 probe_n=args.probe, bench_rows=args.bench_rows,

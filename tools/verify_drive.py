@@ -412,4 +412,53 @@ print(f"[15] mesh-sharded scoring ok: A/B ablation bitwise over "
       f"{_ss['lookup_bench']['hit_rate']} on {_ss['platform']}, crash probe "
       f"held old version bitwise and healed to tier of "
       f"{_sp['final_tier_rows']} row(s)")
+# --- 16. streaming micro-passes: freshness SLO + crash sweep ------------
+# The streaming day: a tail-following supervisor cuts micro-passes on a
+# time budget, publishes minute-level deltas through the watermark, and
+# folds the chain hourly so follower catch-up stays O(tail). The gate
+# mirrors the committed SOAK_STREAM.json headline — the supervisor is
+# KILLED in both cut_publish crash windows mid-soak and the restart
+# recovers exactly-once (one spool replay, one retrain skip, digest
+# bitwise vs an uninterrupted twin) while a follower serves concurrently.
+# The freshness SLO is then gated through obs_report over the run's own
+# metric series (the --json verdicts are asserted PASS explicitly:
+# NODATA must not slip through the exit code), and the --stream probe
+# must fire ALL THREE streaming fault sites.
+_st_path = os.path.join(os.path.dirname(_here), "SOAK_STREAM.json")
+assert os.path.exists(_st_path), "SOAK_STREAM.json missing from the repo"
+with open(_st_path) as _f:
+    _sm = _json.load(_f)
+assert _sm["ok"] and _sm["bitwise"] and len(_sm["kills"]) == 2, _sm
+assert _sm["recovery"] == {"replays": 1, "replays_skipped": 1}, _sm
+assert _sm["freshness_s"]["count"] > 0, _sm
+assert _sm["catchup"]["fresh_follower_applies"] == _sm["catchup"]["bound"], _sm
+with tempfile.TemporaryDirectory() as st_dir:
+    _stk = serve_soak.run_stream_soak(
+        st_dir, cuts=6, rows=100, compact_every=3, qps=20.0, probe_n=16)
+    assert _stk["ok"] and _stk["bitwise"], _stk
+    r = subprocess.run(
+        [sys.executable, os.path.join(_here, "obs_report.py"),
+         os.path.join(_stk["ckpt_root"], "obs"),
+         "--slo", "serve.freshness_s:p99<=60", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"freshness SLO gate red:\n{r.stdout}{r.stderr}"
+    _slo = _json.loads(r.stdout.strip().splitlines()[-1])["slo"]
+    assert _slo and all(v["verdict"] == "PASS" for v in _slo), _slo
+r = subprocess.run(
+    [sys.executable, os.path.join(_here, "chaos_probe.py"),
+     "--stream", "--json"],
+    capture_output=True, text=True, timeout=600)
+assert r.returncode == 0, f"stream probe red:\n{r.stdout}{r.stderr}"
+_stp = _json.loads(r.stdout.strip().splitlines()[-1])
+assert _stp["ok"], _stp
+assert set(_stp["sites_fired"]) == {
+    "stream.tail_read", "stream.cut_publish", "ckpt.compact"}, _stp
+assert all(n >= 1 for n in _stp["sites_fired"].values()), _stp
+print(f"[16] streaming plane ok: {_stk['cuts']} cuts with 2 kills "
+      f"recovered exactly-once (bitwise), compact covers "
+      f"{_stk['chain']['compact_covers']} of {_stk['chain']['chain_len']} "
+      f"links, catch-up {_stk['catchup']['fresh_follower_applies']} "
+      f"applies (bound {_stk['catchup']['bound']}), freshness p99 "
+      f"{_slo[0]['value']:.2f}s <= 60s over {_stk['freshness_s']['count']} "
+      f"commits, probe fired {_stp['sites_fired']}")
 print("VERIFY DRIVE PASS")
